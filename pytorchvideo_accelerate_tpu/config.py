@@ -1,0 +1,284 @@
+"""Typed configuration + CLI for the framework.
+
+Replaces the reference's two-tier config system (SURVEY.md §5 "Config / flag
+system"): ``accelerate config`` YAML + env vars for infrastructure, and Python
+Fire turning ``main()``'s 26 kwargs into flags (reference ``run.py:328-427``).
+Here both tiers live in one typed dataclass tree with dotted CLI overrides
+(``--optim.lr 0.1``) plus flat aliases for every reference flag name
+(``--lr 0.1`` works too), so a reference user can bring their launch command
+across unchanged.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence
+
+
+@dataclass
+class MeshConfig:
+    """Device-mesh shape. Product of explicit axes must divide device count.
+
+    Axis semantics (parallel/mesh.py): ``data`` = data parallel (batch
+    sharding + implicit gradient psum), ``fsdp`` = parameter/optimizer-state
+    sharding (also shards the batch), ``tensor`` = tensor parallelism for
+    transformer blocks, ``context`` = sequence/context parallelism (ring
+    attention / Ulysses over the token axis).  -1 on ``data`` means "use all
+    remaining devices".
+    """
+
+    data: int = -1
+    fsdp: int = 1
+    tensor: int = 1
+    context: int = 1
+
+
+@dataclass
+class DataConfig:
+    """Data pipeline knobs (reference `run.py:140-183` + transform stack R6)."""
+
+    data_dir: str = ""
+    synthetic: bool = False  # synthetic clips (test/bench fixture; SURVEY §4.4)
+    synthetic_num_videos: int = 64
+    num_frames: int = 8  # run.py:374 default; 32 in run_slowfast_r50.sh
+    sampling_rate: int = 8
+    frames_per_second: int = 30
+    batch_size: int = 8  # per data-parallel shard, matching per-rank semantics
+    num_workers: int = 8
+    crop_size: int = 256
+    min_short_side_scale: int = 256
+    max_short_side_scale: int = 320
+    mean: tuple = (0.45, 0.45, 0.45)
+    std: tuple = (0.225, 0.225, 0.225)
+    horizontal_flip_p: float = 0.5
+    decode_audio: bool = False
+    limit_train_batches: int = -1  # run.py:385
+    limit_val_batches: int = -1
+
+
+@dataclass
+class ModelConfig:
+    """Model selection + finetuning controls (reference `run.py:105-118`)."""
+
+    name: str = "slow_r50"  # slow_r50|slowfast_r50|slowfast_r101|x3d_s|mvit_b|videomae
+    num_classes: int = 0  # 0 = infer from dataset labels (replaces run.py:185)
+    pretrained: bool = False
+    pretrained_path: str = ""  # converted torch-hub weights (models/convert.py)
+    freeze_backbone: bool = False  # run.py:108,116 semantics via optax masking
+    slowfast_alpha: int = 4
+    dropout_rate: float = 0.5
+    # Transformer-family extras (MViT/VideoMAE); ignored by CNNs.
+    attention: str = "dense"  # dense|ring|ulysses (parallel/ring_attention.py)
+    mask_ratio: float = 0.9  # VideoMAE pretrain tube-mask ratio
+
+
+@dataclass
+class OptimConfig:
+    """Optimizer/schedule (reference `run.py:192-195`)."""
+
+    optimizer: str = "sgd"
+    lr: float = 0.1
+    momentum: float = 0.9
+    weight_decay: float = 1e-4
+    gradient_accumulation_steps: int = 1  # default 4 in reference launch recipe
+    num_epochs: int = 4
+    schedule: str = "cosine"  # cosine (CosineAnnealingLR semantics) | constant
+    warmup_steps: int = 0
+    label_smoothing: float = 0.0
+    grad_clip_norm: float = 0.0  # 0 = off
+
+
+@dataclass
+class CheckpointConfig:
+    """Checkpoint/resume (reference `run.py:123-133, 203-224, 276-325`)."""
+
+    output_dir: str = "."
+    # "epoch" | integer string | "" (off) — exact reference parsing semantics.
+    checkpointing_steps: str = ""
+    # path | "auto" (scan output_dir for latest — fixes run.py:208-212 dead
+    # code) | "" (off)
+    resume_from_checkpoint: str = ""
+    max_to_keep: int = 0  # 0 = keep all (ProjectConfiguration.total_limit)
+    async_checkpoint: bool = True
+
+
+@dataclass
+class TrackingConfig:
+    """Metric logging (reference `run.py:227-231, 267-274, 306-315`)."""
+
+    with_tracking: bool = False
+    logging_dir: str = "pytorchvideo_accelerate_tpu_runs"
+    log_every: int = 10
+    # "all" resolves to every importable tracker, like accelerate
+    # tracking.py:1260-1290; individual: "tensorboard", "wandb", "jsonl".
+    trackers: str = "all"
+
+
+@dataclass
+class TrainConfig:
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
+    tracking: TrackingConfig = field(default_factory=TrackingConfig)
+
+    seed: int = 42  # run.py:138 set_seed(42); run.py:355 exposes --seed
+    # "bf16" = bf16 compute / fp32 params (TPU-native replacement for the
+    # reference's fp16 GradScaler path, SURVEY §2.3-N7); "fp32" = full fp32.
+    mixed_precision: str = "bf16"
+    cpu: bool = False  # force CPU backend (reference --cpu)
+    profile: bool = False  # jax.profiler trace of a step window (SURVEY §5)
+    profile_dir: str = "/tmp/pva_tpu_profile"
+    debug_nans: bool = False  # jax.config debug_nans (SURVEY §5 sanitizers)
+    # Multi-host control plane (jax.distributed.initialize); empty = single
+    # process or auto-detected TPU pod env.
+    coordinator_address: str = ""
+    num_processes: int = 0
+    process_id: int = -1
+
+    @property
+    def clip_duration(self) -> float:
+        """`(sampling_rate * num_frames) / fps` — reference run.py:140."""
+        d = self.data
+        return (d.sampling_rate * d.num_frames) / d.frames_per_second
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def to_json(self) -> str:
+        return json.dumps(self.to_dict(), indent=2, default=str)
+
+
+# --- CLI ------------------------------------------------------------------
+
+# Flat reference-flag aliases -> dotted path, so the reference launch command
+# (run_slowfast_r50.sh) maps 1:1 onto the new CLI.
+_REFERENCE_ALIASES = {
+    "cpu": "cpu",
+    "mixed_precision": "mixed_precision",
+    "seed": "seed",
+    "checkpointing_steps": "checkpoint.checkpointing_steps",
+    "resume_from_checkpoint": "checkpoint.resume_from_checkpoint",
+    "output_dir": "checkpoint.output_dir",
+    "with_tracking": "tracking.with_tracking",
+    "logging_dir": "tracking.logging_dir",
+    "log_every": "tracking.log_every",
+    "data_dir": "data.data_dir",
+    "num_frames": "data.num_frames",
+    "sampling_rate": "data.sampling_rate",
+    "frames_per_second": "data.frames_per_second",
+    "num_workers": "data.num_workers",
+    "batch_size": "data.batch_size",
+    "limit_train_batches": "data.limit_train_batches",
+    "limit_val_batches": "data.limit_val_batches",
+    "num_epochs": "optim.num_epochs",
+    "lr": "optim.lr",
+    "momentum": "optim.momentum",
+    "weight_decay": "optim.weight_decay",
+    "gradient_accumulation_steps": "optim.gradient_accumulation_steps",
+    "pretrained": "model.pretrained",
+    "freeze_backbone": "model.freeze_backbone",
+    "slowfast_alpha": "model.slowfast_alpha",
+    "model_name": "model.name",
+    "synthetic": "data.synthetic",
+}
+
+
+def _leaf_fields(cfg=None, prefix=""):
+    """Yield (dotted_name, default_value) pairs for every leaf field."""
+    obj = cfg if cfg is not None else TrainConfig()
+    for f in dataclasses.fields(obj):
+        v = getattr(obj, f.name)
+        if dataclasses.is_dataclass(v):
+            yield from _leaf_fields(v, prefix + f.name + ".")
+        else:
+            yield prefix + f.name, v
+
+
+def _coerce(value: str, default: Any):
+    if isinstance(default, bool):
+        if isinstance(value, bool):
+            return value
+        return value.lower() in ("1", "true", "yes", "y", "t")
+    if isinstance(default, int):
+        return int(value)
+    if isinstance(default, float):
+        return float(value)
+    if isinstance(default, tuple):
+        parts = [p for p in str(value).replace("(", "").replace(")", "").split(",") if p]
+        return tuple(type(default[0])(p) for p in parts)
+    return value
+
+
+def _set_dotted(cfg: TrainConfig, dotted: str, value: Any) -> None:
+    obj = cfg
+    parts = dotted.split(".")
+    for p in parts[:-1]:
+        obj = getattr(obj, p)
+    current = getattr(obj, parts[-1])
+    if value is None:  # bare `--flag` with no value
+        if not isinstance(current, bool):
+            raise ValueError(f"flag requires a value ({type(current).__name__})")
+        value = "true"
+    setattr(obj, parts[-1], _coerce(value, current))
+
+
+def parse_cli(argv: Optional[Sequence[str]] = None, base: Optional[TrainConfig] = None) -> TrainConfig:
+    """Parse ``--flag value`` / ``--flag=value`` / bare boolean ``--flag``.
+
+    Accepts both dotted names (``--optim.lr``) and the reference's flat flag
+    names (``--lr``), including ``--is_slowfast`` which maps onto
+    ``model.name=slowfast_r50`` for drop-in launch-script compatibility.
+    """
+    import sys
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    cfg = base or TrainConfig()
+    valid = {name for name, _ in _leaf_fields()}
+
+    i = 0
+    while i < len(argv):
+        tok = argv[i]
+        if not tok.startswith("--"):
+            raise SystemExit(f"unexpected argument: {tok}")
+        tok = tok[2:]
+        if "=" in tok:
+            key, value = tok.split("=", 1)
+            i += 1
+        else:
+            key = tok
+            if i + 1 < len(argv) and not argv[i + 1].startswith("--"):
+                value = argv[i + 1]
+                i += 2
+            else:
+                value = None  # bare flag: only valid for booleans
+                i += 1
+        key = key.replace("-", "_")
+        if key == "is_slowfast":  # reference flag (run.py:351)
+            if value is None or _coerce(value, True):
+                cfg.model.name = "slowfast_r50"
+            continue
+        if key == "pin_memory":  # reference flag (run.py:354); no TPU meaning
+            continue            # (host->HBM transfer is the runtime's job)
+        if key == "help":
+            print(usage())
+            raise SystemExit(0)
+        dotted = _REFERENCE_ALIASES.get(key, key)
+        if dotted not in valid:
+            raise SystemExit(f"unknown flag --{key} (see --help)")
+        try:
+            _set_dotted(cfg, dotted, value)
+        except (TypeError, ValueError) as e:
+            raise SystemExit(f"invalid value for --{key}: {e}")
+    return cfg
+
+
+def usage() -> str:
+    lines = ["flags (dotted or reference-style):"]
+    for name, default in _leaf_fields():
+        lines.append(f"  --{name} (default: {default!r})")
+    return "\n".join(lines)

@@ -1,0 +1,30 @@
+"""Distributed/parallel runtime: mesh construction, sharding rules, host
+control plane, and collective helpers.
+
+TPU-native replacement for the reference stack's distributed backbone
+(SURVEY.md §2.2): `PartialState`/NCCL process groups/DDP Reducer become a
+`jax.sharding.Mesh` + NamedSharding rules + XLA collectives compiled into the
+step function.
+"""
+
+from pytorchvideo_accelerate_tpu.parallel.mesh import (  # noqa: F401
+    AXIS_CONTEXT,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    BATCH_AXES,
+    make_mesh,
+)
+from pytorchvideo_accelerate_tpu.parallel.sharding import (  # noqa: F401
+    batch_sharding,
+    replicated,
+    shard_batch,
+    shard_params,
+)
+from pytorchvideo_accelerate_tpu.parallel.distributed import (  # noqa: F401
+    initialize_distributed,
+    is_main_process,
+    main_print,
+    process_count,
+    process_index,
+)

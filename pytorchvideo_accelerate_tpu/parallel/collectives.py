@@ -1,0 +1,54 @@
+"""Collective helpers.
+
+The reference funnels collectives through a Python facade
+(`accelerate/utils/operations.py:322-357` gather, `accelerator.py:3141,3178`
+reduce/broadcast) calling NCCL. TPU-native, in-graph collectives are just
+`lax.psum/pmean/all_gather/ppermute` under `shard_map` — XLA schedules them on
+ICI. This module keeps (a) thin in-graph wrappers for code written with
+`shard_map`, and (b) host-level out-of-band helpers for metric fetch across
+processes.
+
+Note the design inversion for metrics: the reference gathers *per-sample*
+predictions to every rank and feeds a stateful torchmetrics object
+(run.py:298) — which double-counts DistributedSampler padding (SURVEY §2.1).
+Here eval metrics are accumulated inside the compiled step as (correct, total)
+sums over the sharded batch (trainer/metrics.py), so there is nothing to
+gather and no padding bias.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def psum(x: Any, axis_name) -> Any:
+    return lax.psum(x, axis_name)
+
+
+def pmean(x: Any, axis_name) -> Any:
+    return lax.pmean(x, axis_name)
+
+
+def all_gather(x: Any, axis_name, axis: int = 0, tiled: bool = True) -> Any:
+    """`accelerator.gather` equivalent for shard_map code paths."""
+    return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
+
+
+def ppermute_ring(x: Any, axis_name, shift: int = 1) -> Any:
+    """Rotate values around the mesh axis ring (ring-attention building block)."""
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + shift) % n) for i in range(n)]
+    return lax.ppermute(x, axis_name, perm)
+
+
+def host_allgather(x: Any) -> Any:
+    """Out-of-band cross-process gather (DCN), for host-side logging only."""
+    if jax.process_count() == 1:
+        return jax.tree.map(lambda a: jnp.asarray(a)[None], x)
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)

@@ -1,0 +1,101 @@
+"""Multi-host control plane.
+
+Replaces the reference launch path (SURVEY §3.1): `accelerate launch` ->
+torchelastic TCPStore rendezvous -> N processes with RANK/WORLD_SIZE env vars
+-> `init_process_group("nccl")`. On TPU pods the runtime is one process per
+host; `jax.distributed.initialize` wires the DCN control plane (coordinator
+service), and device-level collectives need no further setup — they are
+compiled into the step by XLA.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Optional
+
+import jax
+
+logger = logging.getLogger("pva_tpu")
+
+_INITIALIZED = False
+
+
+def initialize_distributed(
+    coordinator_address: str = "",
+    num_processes: int = 0,
+    process_id: int = -1,
+) -> None:
+    """Initialize the multi-host control plane if configured.
+
+    Resolution order (mirrors accelerate's env-driven `_prepare_backend`,
+    state.py:755-798, but for the JAX world):
+      1. explicit args (from TrainConfig),
+      2. env vars `PVA_COORDINATOR_ADDRESS` / `PVA_NUM_PROCESSES` /
+         `PVA_PROCESS_ID` (the launch-env contract of `launch.py`),
+      3. TPU-pod auto-detection: on Cloud TPU pods,
+         `jax.distributed.initialize()` with no args self-configures from the
+         metadata server; we only call it when a pod env is detectable.
+      4. otherwise: single-process, no-op.
+    """
+    global _INITIALIZED
+    if _INITIALIZED:
+        return
+
+    coordinator_address = coordinator_address or os.environ.get("PVA_COORDINATOR_ADDRESS", "")
+    num_processes = num_processes or int(os.environ.get("PVA_NUM_PROCESSES", "0"))
+    env_pid = os.environ.get("PVA_PROCESS_ID", "")
+    if process_id < 0 and env_pid:
+        process_id = int(env_pid)
+
+    if coordinator_address and num_processes > 1:
+        if not 0 <= process_id < num_processes:
+            raise ValueError(
+                f"process_id must be in [0, {num_processes}) when a coordinator "
+                f"is configured; got {process_id}. Set PVA_PROCESS_ID or "
+                f"--process_id on every host."
+            )
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+        _INITIALIZED = True
+        logger.info(
+            "distributed: initialized process %d/%d (coordinator %s)",
+            process_id, num_processes, coordinator_address,
+        )
+    elif len(os.environ.get("TPU_WORKER_HOSTNAMES", "").split(",")) > 1:
+        # Multi-host TPU pod (>1 worker hostname): let JAX self-configure
+        # from the TPU metadata.
+        jax.distributed.initialize()
+        _INITIALIZED = True
+        logger.info("distributed: pod auto-init, process %d/%d",
+                    jax.process_index(), jax.process_count())
+
+
+def process_index() -> int:
+    return jax.process_index()
+
+
+def process_count() -> int:
+    return jax.process_count()
+
+
+def is_main_process() -> bool:
+    """`accelerator.is_main_process` equivalent (reference run.py:228)."""
+    return jax.process_index() == 0
+
+
+def main_print(*args, **kwargs) -> None:
+    """`accelerator.print` equivalent (reference run.py:205,216)."""
+    if is_main_process():
+        print(*args, **kwargs)
+
+
+def sync_global_devices(name: str = "barrier") -> None:
+    """Host-level barrier (out-of-band, DCN) — for checkpoint/teardown fences."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)

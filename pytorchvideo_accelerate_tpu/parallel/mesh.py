@@ -1,0 +1,99 @@
+"""Device-mesh construction.
+
+Replaces the reference's process-group wiring (`accelerate/state.py:755-798`
+backend selection + `torch.distributed.init_process_group`, SURVEY §3.2): in
+the TPU design there is no user-visible process group — a `jax.sharding.Mesh`
+over all devices defines every parallelism axis, and XLA lowers the
+collectives onto ICI rings / DCN links from the sharding annotations alone.
+
+Axes:
+  data    — pure data parallelism (batch sharding; gradient psum implied by
+            sharded autodiff). The only axis the reference exercises (its DDP
+            path, SURVEY §2.4).
+  fsdp    — sharded-DP: parameters/optimizer state sharded here (ZeRO/FSDP
+            equivalent, accelerate accelerator.py:1912-1948); also shards the
+            batch jointly with `data`.
+  tensor  — tensor parallelism for transformer blocks (Megatron path in the
+            backbone, accelerator.py:2506).
+  context — sequence/context parallelism: ring attention / Ulysses all-to-all
+            over the token axis (accelerate `_prepare_cp` accelerator.py:1658).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh
+
+from pytorchvideo_accelerate_tpu.config import MeshConfig
+
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_TENSOR = "tensor"
+AXIS_CONTEXT = "context"
+
+# The global batch dimension is sharded over both DP-like axes, mirroring how
+# FSDP data-sharding composes with DP in the backbone's device-mesh-aware
+# dataloader (accelerate data_loader.py:1127-1163).
+BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
+
+MESH_AXIS_NAMES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_CONTEXT)
+
+
+def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple:
+    """Resolve -1 on the data axis and validate divisibility."""
+    for name in ("fsdp", "tensor", "context"):
+        if getattr(cfg, name) < 1:
+            raise ValueError(f"mesh.{name} must be >= 1, got {getattr(cfg, name)}")
+    if cfg.data != -1 and cfg.data < 1:
+        raise ValueError(f"mesh.data must be >= 1 or -1 (infer), got {cfg.data}")
+    explicit = cfg.fsdp * cfg.tensor * cfg.context
+    data = cfg.data
+    if data == -1:
+        if n_devices % explicit != 0:
+            raise ValueError(
+                f"mesh axes fsdp*tensor*context={explicit} does not divide "
+                f"device count {n_devices}"
+            )
+        data = n_devices // explicit
+    total = data * explicit
+    if total != n_devices:
+        raise ValueError(
+            f"mesh shape ({data},{cfg.fsdp},{cfg.tensor},{cfg.context}) "
+            f"needs {total} devices, have {n_devices}"
+        )
+    return (data, cfg.fsdp, cfg.tensor, cfg.context)
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the global mesh. On TPU, `mesh_utils.create_device_mesh` picks a
+    device ordering so the inner (rightmost) axes land on physically adjacent
+    chips — keeping tensor/context collectives on fast ICI loops and the data
+    axis on the outermost rings, per the scaling-book recipe."""
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = resolve_mesh_shape(cfg, len(devices))
+    try:
+        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+    except (ValueError, AssertionError) as e:
+        # CPU simulation / odd topologies: plain row-major reshape. On a real
+        # TPU slice this forfeits the ICI-adjacency-aware ordering — warn so
+        # a degraded collective layout is observable.
+        if devices and devices[0].platform == "tpu":
+            import logging
+
+            logging.getLogger("pva_tpu").warning(
+                "create_device_mesh failed for shape %s (%s); falling back to "
+                "row-major device order — collective layout may be suboptimal",
+                shape, e,
+            )
+        device_array = np.asarray(devices).reshape(shape)
+    return Mesh(device_array, MESH_AXIS_NAMES)
+
+
+def data_shard_count(mesh: Mesh) -> int:
+    """Number of batch shards (= reference `num_processes` for pure DP)."""
+    return mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]

@@ -1,0 +1,3 @@
+"""Utilities: RNG management, logging, profiling, debug modes."""
+
+from pytorchvideo_accelerate_tpu.utils.rng import RngManager, set_seed  # noqa: F401

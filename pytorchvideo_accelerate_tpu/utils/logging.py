@@ -1,0 +1,18 @@
+"""Logging setup: one configured logger, main-process gating."""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+
+def get_logger(name: str = "pva_tpu") -> logging.Logger:
+    logger = logging.getLogger(name)
+    if not logger.handlers:
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(
+            logging.Formatter("%(asctime)s %(levelname)s %(name)s: %(message)s")
+        )
+        logger.addHandler(handler)
+        logger.setLevel(logging.INFO)
+    return logger

@@ -1,0 +1,63 @@
+"""Seeded randomness.
+
+Replaces accelerate's `set_seed` (python/numpy/torch/cuda/xla RNG, SURVEY
+§2.2-A11) and its per-iteration cross-rank RNG synchronization
+(`synchronize_rng_states` at data_loader.py:577-578). In JAX the second half
+is free: `jax.random` keys are values, identical on every process by
+construction, and per-step keys are derived with `fold_in` — so "RNG sync
+across ranks" is a non-problem, and per-step determinism survives resume by
+re-deriving from (seed, step).
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+from dataclasses import dataclass
+
+import jax
+import numpy as np
+
+
+def set_seed(seed: int) -> jax.Array:
+    """Seed host-side RNGs (python, numpy) and return the root JAX key.
+
+    Reference semantics: `set_seed(42)` at run.py:138. Host RNGs matter for
+    data-pipeline shuffling; device randomness flows from the returned key.
+    """
+    _pyrandom.seed(seed)
+    np.random.seed(seed % (2**32))
+    return jax.random.key(seed)
+
+
+@dataclass
+class RngManager:
+    """Derives all randomness from one seed.
+
+    - `step_key(step)`: dropout/drop-path key for a train step, identical on
+      every host (keys are deterministic values), distinct per step.
+    - `data_key(epoch)`: shuffle key for the data pipeline epoch.
+    - `host_key(step)`: additionally folded with process_index, for the rare
+      host-local use (e.g. per-host augmentation workers).
+    """
+
+    seed: int
+
+    def __post_init__(self):
+        # Key derivation only — global python/numpy seeding is an explicit
+        # startup action (call set_seed once in the app), not a constructor
+        # side effect, so building a second manager mid-run can't silently
+        # rewind host-side augmentation streams.
+        self.root = jax.random.key(self.seed)
+
+    def step_key(self, step) -> jax.Array:
+        return jax.random.fold_in(self.root, step)
+
+    def data_key(self, epoch: int) -> jax.Array:
+        return jax.random.fold_in(jax.random.fold_in(self.root, 0x9E3779B9), epoch)
+
+    def host_key(self, step: int) -> jax.Array:
+        return jax.random.fold_in(self.step_key(step), jax.process_index())
+
+    def numpy_epoch_seed(self, epoch: int) -> int:
+        """Deterministic numpy seed for host-side augmentation at `epoch`."""
+        return int(jax.random.randint(self.data_key(epoch), (), 0, 2**31 - 1))

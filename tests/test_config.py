@@ -1,0 +1,67 @@
+"""Config/CLI tests: reference-flag compatibility (run.py:328-427 surface)."""
+
+from pytorchvideo_accelerate_tpu.config import TrainConfig, parse_cli
+
+
+def test_defaults_match_reference():
+    cfg = TrainConfig()
+    # Reference main() defaults (run.py:328-356)
+    assert cfg.seed == 42
+    assert cfg.data.num_frames == 8
+    assert cfg.data.sampling_rate == 8
+    assert cfg.data.frames_per_second == 30
+    assert cfg.data.batch_size == 8
+    assert cfg.optim.lr == 0.1
+    assert cfg.optim.momentum == 0.9
+    assert cfg.optim.weight_decay == 1e-4
+    assert cfg.optim.num_epochs == 4
+    assert cfg.model.slowfast_alpha == 4
+
+
+def test_clip_duration_formula():
+    # run.py:140: clip_duration = sampling_rate * num_frames / fps
+    cfg = TrainConfig()
+    cfg.data.sampling_rate = 2
+    cfg.data.num_frames = 32
+    cfg.data.frames_per_second = 30
+    assert abs(cfg.clip_duration - (2 * 32) / 30) < 1e-9
+
+
+def test_reference_launch_script_flags():
+    # run_slowfast_r50.sh flags map onto the new CLI unchanged.
+    cfg = parse_cli(
+        [
+            "--mixed_precision", "fp16",
+            "--num_frames", "32",
+            "--sampling_rate", "2",
+            "--batch_size", "8",
+            "--gradient_accumulation_steps", "4",
+            "--is_slowfast", "true",
+            "--num_workers", "8",
+            "--pin_memory",  # reference-only flag: accepted + ignored
+        ],
+    )
+    assert cfg.data.num_frames == 32
+    assert cfg.data.sampling_rate == 2
+    assert cfg.optim.gradient_accumulation_steps == 4
+    assert cfg.model.name == "slowfast_r50"
+    assert cfg.mixed_precision == "fp16"
+
+
+def test_dotted_flags_and_bare_bool():
+    cfg = parse_cli(["--optim.lr", "0.05", "--tracking.with_tracking", "--mesh.fsdp=2"])
+    assert cfg.optim.lr == 0.05
+    assert cfg.tracking.with_tracking is True
+    assert cfg.mesh.fsdp == 2
+
+
+def test_unknown_flag_rejected():
+    import pytest
+
+    with pytest.raises(SystemExit):
+        parse_cli(["--definitely_not_a_flag", "1"])
+
+
+def test_tuple_coercion():
+    cfg = parse_cli(["--data.mean", "0.5,0.5,0.5"])
+    assert cfg.data.mean == (0.5, 0.5, 0.5)

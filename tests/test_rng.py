@@ -1,0 +1,42 @@
+"""RNG manager tests (accelerate set_seed + RNG-sync equivalence, SURVEY A11)."""
+
+import jax
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.utils.rng import RngManager, set_seed
+
+
+def test_set_seed_deterministic():
+    k1 = set_seed(42)
+    a = np.random.rand(3)
+    k2 = set_seed(42)
+    b = np.random.rand(3)
+    np.testing.assert_array_equal(a, b)
+    assert jax.random.uniform(k1).item() == jax.random.uniform(k2).item()
+
+
+def test_step_keys_distinct_and_reproducible():
+    m1 = RngManager(seed=7)
+    m2 = RngManager(seed=7)
+    k_a = m1.step_key(10)
+    k_b = m2.step_key(10)
+    # same (seed, step) -> same key: resume re-derives identical randomness
+    assert jax.random.uniform(k_a).item() == jax.random.uniform(k_b).item()
+    assert (
+        jax.random.uniform(m1.step_key(10)).item()
+        != jax.random.uniform(m1.step_key(11)).item()
+    )
+
+
+def test_data_key_independent_of_step_key():
+    m = RngManager(seed=7)
+    assert (
+        jax.random.uniform(m.data_key(0)).item()
+        != jax.random.uniform(m.step_key(0)).item()
+    )
+
+
+def test_numpy_epoch_seed_stable():
+    m = RngManager(seed=3)
+    assert m.numpy_epoch_seed(2) == RngManager(seed=3).numpy_epoch_seed(2)
+    assert m.numpy_epoch_seed(2) != m.numpy_epoch_seed(3)
