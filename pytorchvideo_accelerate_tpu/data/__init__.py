@@ -1,0 +1,15 @@
+"""Data pipeline: manifest indexing, clip sampling, decode, transforms,
+and the host-side loader feeding sharded clip batches to the mesh.
+
+TPU-native replacement for the reference's L3 stack (SURVEY §2.1 R6-R11):
+pytorchvideo `Kinetics` + PyAV decode + torch DataLoader workers become a
+manifest scanner, cv2 (bundled FFmpeg) decode, numpy transform stack, and a
+grain/threaded prefetch pipeline with per-host sharding and checkpointable
+iterator state.
+"""
+
+from pytorchvideo_accelerate_tpu.data.transforms import (  # noqa: F401
+    make_transform,
+    pack_pathway,
+    uniform_temporal_subsample,
+)

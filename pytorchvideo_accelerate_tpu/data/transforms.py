@@ -1,0 +1,147 @@
+"""Clip transform stack — numpy/cv2, host-side.
+
+Reproduces the reference's transform factory `make_transform`
+(run.py:68-102) exactly, as pure functions over (T, H, W, C) numpy frames
+with explicit RNG:
+
+  train: UniformTemporalSubsample(num_frames) -> Div255 ->
+         Normalize(mean=0.45, std=0.225) ->
+         RandomShortSideScale(256, 320) -> RandomCrop(256) ->
+         RandomHorizontalFlip(0.5) [-> PackPathway(alpha)]
+  val:   ... -> ShortSideScale(256) -> CenterCrop(256) [-> PackPathway]
+
+Semantics notes (golden-tested in tests/test_transforms.py):
+- UniformTemporalSubsample uses `linspace(0, T-1, n).long()` index truncation
+  (pytorchvideo semantics via run.py:82 [external]).
+- Short-side scale is bilinear (cv2.INTER_LINEAR, matching torch
+  F.interpolate(mode="bilinear", align_corners=False) to ~1e-2 abs — parity
+  asserted against installed torch-cpu in the tests).
+- RandomShortSideScale samples an integer size uniformly in [min, max]
+  inclusive.
+- PackPathway (run.py:38-65): fast = all T frames, slow = index_select of
+  T//alpha frames via the same truncated linspace.
+
+Scaling/cropping runs before normalization would be cheaper (uint8 resize),
+but the reference normalizes first — order preserved for exact behavioral
+parity, and the fused fast path (`normalize_into`) keeps it one pass.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+try:  # cv2 ships its own ffmpeg; SURVEY §2.3-N9/N10 replacement
+    import cv2
+except Exception:  # pragma: no cover - cv2 is present in the build env
+    cv2 = None
+
+
+def uniform_temporal_subsample(frames: np.ndarray, num_samples: int) -> np.ndarray:
+    """Evenly-spaced temporal subsample, truncated-linspace indices."""
+    t = frames.shape[0]
+    idx = np.linspace(0, t - 1, num_samples).astype(np.int64)
+    return frames[idx]
+
+
+def div255(frames: np.ndarray) -> np.ndarray:
+    return frames.astype(np.float32) / 255.0
+
+
+def normalize(frames: np.ndarray, mean: Sequence[float], std: Sequence[float]) -> np.ndarray:
+    mean = np.asarray(mean, np.float32)
+    std = np.asarray(std, np.float32)
+    return (frames - mean) / std
+
+
+def short_side_scale(frames: np.ndarray, size: int) -> np.ndarray:
+    """Resize so the short spatial side == `size`, bilinear, AR preserved."""
+    t, h, w = frames.shape[:3]
+    # floor, matching pytorchvideo's ShortSideScale long-side math [external]
+    if h <= w:
+        new_h, new_w = size, int(np.floor(w * size / h))
+    else:
+        new_h, new_w = int(np.floor(h * size / w)), size
+    if (new_h, new_w) == (h, w):
+        return frames
+    out = np.empty((t, new_h, new_w, frames.shape[3]), frames.dtype)
+    for i in range(t):
+        cv2.resize(frames[i], (new_w, new_h), dst=out[i], interpolation=cv2.INTER_LINEAR)
+    return out
+
+
+def random_short_side_scale(
+    frames: np.ndarray, min_size: int, max_size: int, rng: np.random.Generator
+) -> np.ndarray:
+    size = int(rng.integers(min_size, max_size + 1))
+    return short_side_scale(frames, size)
+
+
+def center_crop(frames: np.ndarray, size: int) -> np.ndarray:
+    h, w = frames.shape[1:3]
+    top = (h - size) // 2
+    left = (w - size) // 2
+    return frames[:, top : top + size, left : left + size]
+
+
+def random_crop(frames: np.ndarray, size: int, rng: np.random.Generator) -> np.ndarray:
+    h, w = frames.shape[1:3]
+    top = int(rng.integers(0, h - size + 1))
+    left = int(rng.integers(0, w - size + 1))
+    return frames[:, top : top + size, left : left + size]
+
+
+def horizontal_flip(frames: np.ndarray, p: float, rng: np.random.Generator) -> np.ndarray:
+    if rng.random() < p:
+        return frames[:, :, ::-1]
+    return frames
+
+
+def pack_pathway(frames: np.ndarray, alpha: int) -> Dict[str, np.ndarray]:
+    """SlowFast dual-rate packing (reference PackPathway, run.py:56-65):
+    fast keeps all T frames; slow takes T//alpha truncated-linspace picks."""
+    t = frames.shape[0]
+    slow_idx = np.linspace(0, t - 1, t // alpha).astype(np.int64)
+    return {"slow": frames[slow_idx], "fast": frames}
+
+
+def make_transform(
+    num_frames: int = 8,
+    training: bool = False,
+    is_slowfast: bool = False,
+    slowfast_alpha: int = 4,
+    min_short_side_scale: int = 256,
+    max_short_side_scale: int = 320,
+    crop_size: int = 256,
+    mean: Sequence[float] = (0.45, 0.45, 0.45),
+    std: Sequence[float] = (0.225, 0.225, 0.225),
+    horizontal_flip_p: float = 0.5,
+) -> Callable[[np.ndarray, Optional[np.random.Generator]], Dict[str, np.ndarray]]:
+    """Build the full clip transform (reference make_transform, run.py:68-102).
+
+    Returns `fn(frames_uint8_THWC, rng) -> {"video": ...}` or
+    `{"slow": ..., "fast": ...}` (float32, contiguous).
+    """
+
+    def transform(frames: np.ndarray, rng: Optional[np.random.Generator] = None):
+        if training and rng is None:
+            raise ValueError("training transform requires an rng")
+        x = uniform_temporal_subsample(frames, num_frames)
+        x = div255(x)
+        x = normalize(x, mean, std)
+        if training:
+            x = random_short_side_scale(
+                x, min_short_side_scale, max_short_side_scale, rng
+            )
+            x = random_crop(x, crop_size, rng)
+            x = horizontal_flip(x, horizontal_flip_p, rng)
+        else:
+            x = short_side_scale(x, min_short_side_scale)
+            x = center_crop(x, crop_size)
+        if is_slowfast:
+            out = pack_pathway(x, slowfast_alpha)
+            return {k: np.ascontiguousarray(v) for k, v in out.items()}
+        return {"video": np.ascontiguousarray(x)}
+
+    return transform

@@ -1,0 +1,150 @@
+"""Shared building blocks for 3D-CNN video backbones.
+
+Layout: all video tensors are **NDHWC** = (batch, time, height, width,
+channels) — channels-last so XLA:TPU tiles convs onto the MXU without
+transposes (the reference's torch models are NCTHW; the converter in
+models/convert.py handles the permutation). Compute dtype is bf16 by policy,
+params fp32 (SURVEY §2.3-N7: no GradScaler needed on TPU).
+
+BatchNorm semantics: under pjit data-parallelism the batch axis is one global
+sharded tensor, so batch statistics are computed over the *global* batch —
+i.e. sync-BN by construction. The reference's DDP computes per-replica stats
+(torch BN default); global stats are strictly more stable, and at the
+reference's per-replica batch of 8 the difference is one of its known DP
+quirks (SURVEY §2 "hard parts" #4) resolved in the TPU-native direction.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+Dtype = Any
+
+
+class ConvBNAct(nn.Module):
+    """conv3d -> BN -> activation, the unit both ResNet and X3D stems/stages
+    are made of (pytorchvideo's create_conv_patch_embed / Net blocks, cited
+    from the reference call sites at run.py:107,115 [external model zoo])."""
+
+    features: int
+    kernel: Tuple[int, int, int]
+    stride: Tuple[int, int, int] = (1, 1, 1)
+    groups: int = 1
+    use_bias: bool = False
+    use_bn: bool = True
+    act: Optional[Callable] = nn.relu
+    dtype: Dtype = jnp.float32
+    bn_momentum: float = 0.9  # = 1 - torch_momentum(0.1)
+    bn_eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = nn.Conv(
+            self.features,
+            kernel_size=self.kernel,
+            strides=self.stride,
+            padding=[(k // 2, k // 2) for k in self.kernel],
+            feature_group_count=self.groups,
+            use_bias=self.use_bias,
+            dtype=self.dtype,
+            name="conv",
+        )(x)
+        if self.use_bn:
+            x = nn.BatchNorm(
+                use_running_average=not train,
+                momentum=self.bn_momentum,
+                epsilon=self.bn_eps,
+                dtype=self.dtype,
+                name="norm",
+            )(x)
+        if self.act is not None:
+            x = self.act(x)
+        return x
+
+
+class Bottleneck3D(nn.Module):
+    """ResNet bottleneck with a (kt,1,1) temporal conv_a, (1,3,3) spatial
+    conv_b, (1,1,1) conv_c — the pytorchvideo `create_bottleneck_block`
+    shape used by slow_r50/slowfast (reference consumes it via torch.hub at
+    run.py:107,115)."""
+
+    features_inner: int
+    features_out: int
+    temporal_kernel: int = 1
+    spatial_stride: int = 1
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        y = ConvBNAct(
+            self.features_inner,
+            kernel=(self.temporal_kernel, 1, 1),
+            dtype=self.dtype,
+            name="conv_a",
+        )(x, train)
+        y = ConvBNAct(
+            self.features_inner,
+            kernel=(1, 3, 3),
+            stride=(1, self.spatial_stride, self.spatial_stride),
+            dtype=self.dtype,
+            name="conv_b",
+        )(y, train)
+        y = ConvBNAct(
+            self.features_out,
+            kernel=(1, 1, 1),
+            act=None,
+            dtype=self.dtype,
+            name="conv_c",
+        )(y, train)
+        if residual.shape[-1] != self.features_out or self.spatial_stride != 1:
+            residual = ConvBNAct(
+                self.features_out,
+                kernel=(1, 1, 1),
+                stride=(1, self.spatial_stride, self.spatial_stride),
+                act=None,
+                dtype=self.dtype,
+                name="branch1",
+            )(residual, train)
+        return nn.relu(residual + y)
+
+
+class ResStage(nn.Module):
+    """A stack of bottleneck blocks; the first carries the spatial stride."""
+
+    depth: int
+    features_inner: int
+    features_out: int
+    temporal_kernel: int = 1
+    spatial_stride: int = 2
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i in range(self.depth):
+            x = Bottleneck3D(
+                features_inner=self.features_inner,
+                features_out=self.features_out,
+                temporal_kernel=self.temporal_kernel,
+                spatial_stride=self.spatial_stride if i == 0 else 1,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x, train)
+        return x
+
+
+def max_pool_3d(x, window: Sequence[int], strides: Sequence[int]):
+    """3D max pool with SAME-style per-dim padding k//2 (torch MaxPool3d
+    padding=[k//2] equivalent)."""
+    pads = [(k // 2, k // 2) for k in window]
+    return nn.max_pool(
+        x, window_shape=tuple(window), strides=tuple(strides), padding=pads
+    )
+
+
+def global_avg_pool(x):
+    """Mean over (T, H, W) — AdaptiveAvgPool3d(1) equivalent."""
+    return jnp.mean(x, axis=(1, 2, 3))

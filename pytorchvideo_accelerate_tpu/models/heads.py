@@ -1,0 +1,45 @@
+"""Classification heads.
+
+`ResBasicHead` is the TPU-native equivalent of pytorchvideo's
+`create_res_basic_head`, which the reference uses to re-head both finetuners
+(run.py:109: `create_res_basic_head(in_features=2304, out_features=num_labels,
+pool=None)` for SlowFast — pooling already done by the caller — and
+run.py:117: default pooled variant for Slow-R50): pool -> dropout -> linear
+projection.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorchvideo_accelerate_tpu.models.common import global_avg_pool
+
+
+class ResBasicHead(nn.Module):
+    """Global-avg-pool (optional) -> dropout -> linear.
+
+    `pool=False` mirrors the reference's `pool=None` SlowFast head
+    (run.py:109), where the caller concatenates already-pooled pathway
+    features. The projection runs in fp32 regardless of compute dtype so
+    logits (and the softmax cross-entropy behind them) stay numerically
+    clean under bf16 — the TPU replacement for the reference's AMP
+    fp32-output patch (accelerate accelerator.py:1818-1829).
+    """
+
+    num_classes: int
+    dropout_rate: float = 0.5
+    pool: bool = True
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        if self.pool and x.ndim == 5:
+            x = global_avg_pool(x)
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="proj")(
+            x.astype(jnp.float32)
+        )
+        return x

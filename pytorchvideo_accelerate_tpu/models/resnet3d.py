@@ -1,0 +1,80 @@
+"""Slow-R50: single-pathway 3D ResNet.
+
+TPU-native re-design of the `slow_r50` backbone the reference loads from
+torch.hub (run.py:115: `make_slowr50_finetuner` -> hub `slow_r50` + head swap
+to `create_res_basic_head(in_features=2048, out_features=num_labels)`).
+Architecture (SlowFast paper's "Slow" pathway, Feichtenhofer et al. 2019,
+arXiv:1812.03982, Table 1):
+
+- stem: 1x7x7 conv stride (1,2,2) -> 64ch, BN, ReLU, 1x3x3 maxpool s(1,2,2)
+- res2..res5: bottleneck depths (3,4,6,3), outputs (256,512,1024,2048),
+  temporal conv kernels (1,1,3,3) — no temporal convs in the early stages,
+  3x1x1 in res4/res5; spatial stride 2 at each stage entry except res2
+- head: global avg pool -> dropout -> linear (heads.ResBasicHead)
+
+Input: (B, T, H, W, 3) NDHWC, normalized frames. Default T=8 (the reference's
+num_frames default, run.py:374).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorchvideo_accelerate_tpu.models.common import (
+    ConvBNAct,
+    ResStage,
+    max_pool_3d,
+)
+from pytorchvideo_accelerate_tpu.models.heads import ResBasicHead
+
+
+class SlowR50(nn.Module):
+    num_classes: int
+    depths: Tuple[int, ...] = (3, 4, 6, 3)
+    stem_features: int = 64
+    temporal_kernels: Tuple[int, ...] = (1, 1, 3, 3)
+    dropout_rate: float = 0.5
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBNAct(
+            self.stem_features,
+            kernel=(1, 7, 7),
+            stride=(1, 2, 2),
+            dtype=self.dtype,
+            name="stem",
+        )(x, train)
+        x = max_pool_3d(x, (1, 3, 3), (1, 2, 2))
+
+        features_inner = self.stem_features
+        features_out = self.stem_features * 4
+        for stage_idx, depth in enumerate(self.depths):
+            x = ResStage(
+                depth=depth,
+                features_inner=features_inner,
+                features_out=features_out,
+                temporal_kernel=self.temporal_kernels[stage_idx],
+                spatial_stride=1 if stage_idx == 0 else 2,
+                dtype=self.dtype,
+                name=f"res{stage_idx + 2}",
+            )(x, train)
+            features_inner *= 2
+            features_out *= 2
+
+        return ResBasicHead(
+            num_classes=self.num_classes,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="head",
+        )(x, train)
+
+    @staticmethod
+    def backbone_param_filter(path: Tuple[str, ...]) -> bool:
+        """True for backbone (non-head) params — drives freeze_backbone
+        masking (reference run.py:116: `blocks[:-1].requires_grad_(False)`)."""
+        return path[0] != "head"

@@ -10,13 +10,14 @@ compiled into the step by XLA.
 
 from __future__ import annotations
 
-import logging
 import os
 from typing import Optional
 
 import jax
 
-logger = logging.getLogger("pva_tpu")
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
 
 _INITIALIZED = False
 

@@ -83,9 +83,9 @@ def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = No
         # TPU slice this forfeits the ICI-adjacency-aware ordering — warn so
         # a degraded collective layout is observable.
         if devices and devices[0].platform == "tpu":
-            import logging
+            from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 
-            logging.getLogger("pva_tpu").warning(
+            get_logger("pva_tpu").warning(
                 "create_device_mesh failed for shape %s (%s); falling back to "
                 "row-major device order — collective layout may be suboptimal",
                 shape, e,

@@ -28,15 +28,21 @@ def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
-def shard_batch(mesh: Mesh, batch: Any) -> Any:
+def shard_batch(mesh: Mesh, batch: Any, micro_dim: bool = False) -> Any:
     """Place a host-global batch pytree onto the mesh, batch-dim sharded.
+
+    `micro_dim=True` for gradient-accumulation batches shaped
+    (accum, global_batch, ...): the accumulation axis stays unsharded (it is
+    scanned over in-graph) and dim 1 is the sharded batch.
 
     Single-process: `batch` holds the full global batch (numpy). Multi-host:
     each process holds its local shard and we assemble the global array from
     per-host shards (`jax.make_array_from_process_local_data`), the moral
     equivalent of per-rank DataLoader shards feeding DDP.
     """
-    sharding = batch_sharding(mesh)
+    sharding = (
+        NamedSharding(mesh, P(None, BATCH_AXES)) if micro_dim else batch_sharding(mesh)
+    )
 
     def place(x):
         x = np.asarray(x)

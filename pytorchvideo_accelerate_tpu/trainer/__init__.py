@@ -1,0 +1,12 @@
+"""Training runtime: state pytree, optimizer, compiled steps, metrics,
+checkpointing, tracking, and the epoch loop.
+
+This package is the TPU-native replacement for the reference's L5 training
+app (run.py:121-325) plus the slices of accelerate it delegates to
+(SURVEY §2.2): instead of Accelerator verbs mutating torch objects, training
+is a pure `TrainState -> TrainState` compiled step driven by a thin host loop.
+"""
+
+from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState  # noqa: F401
+from pytorchvideo_accelerate_tpu.trainer.optim import build_optimizer, build_lr_schedule  # noqa: F401
+from pytorchvideo_accelerate_tpu.trainer.steps import make_train_step, make_eval_step  # noqa: F401

@@ -1,0 +1,56 @@
+"""Host-side metric accumulators.
+
+Replaces torchmetrics' stateful `Accuracy` over gathered predictions
+(reference run.py:236,298,303-304): the compiled eval step already returns
+global masked sums, so the host accumulator is trivial arithmetic — and
+bias-free under padding (SURVEY §2.1 eval-gather quirk).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import jax
+import numpy as np
+
+
+@dataclass
+class SumMetrics:
+    """Accumulates {loss_sum, correct, count} dicts from eval steps."""
+
+    loss_sum: float = 0.0
+    correct: float = 0.0
+    count: float = 0.0
+
+    def update(self, step_out: dict) -> None:
+        # device->host transfer happens here, once per eval batch
+        self.loss_sum += float(step_out["loss_sum"])
+        self.correct += float(step_out["correct"])
+        self.count += float(step_out["count"])
+
+    def accuracy(self) -> float:
+        return self.correct / max(self.count, 1.0)
+
+    def mean_loss(self) -> float:
+        return self.loss_sum / max(self.count, 1.0)
+
+    def reset(self) -> None:
+        self.loss_sum = self.correct = self.count = 0.0
+
+
+@dataclass
+class MeanLoss:
+    """Running epoch-mean train loss (reference `total_loss` run.py:239,269)."""
+
+    total: float = 0.0
+    n: int = 0
+
+    def update(self, loss) -> None:
+        self.total += float(loss)
+        self.n += 1
+
+    def mean(self) -> float:
+        return self.total / max(self.n, 1)
+
+    def reset(self) -> None:
+        self.total, self.n = 0.0, 0

@@ -1,0 +1,165 @@
+"""Compiled train/eval steps — the hot loop.
+
+Replaces the reference's per-batch torch path (SURVEY §3.4: DDP forward ->
+cross_entropy -> scaler backward -> Reducer allreduce every micro-step ->
+optimizer/scheduler step) with one jitted function per effective step:
+
+- forward+backward via `jax.value_and_grad`, bf16 compute / fp32 params;
+- the gradient all-reduce is *implied* by differentiating a loss computed
+  over the globally-sharded batch — XLA inserts the psum and overlaps it
+  (no DDP Reducer, SURVEY §2.3-N6);
+- gradient accumulation is an in-graph `lax.scan` over micro-batches that
+  syncs ONCE per effective step — a deliberate fix of the reference's
+  allreduce-every-micro-step behavior (run.py:257, SURVEY §2.1);
+- eval metrics are accumulated in-graph as masked (loss_sum, correct, count)
+  sums, fixing the reference's padded-duplicate eval bias (run.py:298 plain
+  `gather` vs `gather_for_metrics`, SURVEY §2.1).
+
+Batch convention: dict with "video" (single-pathway) or "slow"/"fast"
+(SlowFast packing), "label" int32, optional "mask" float32 (1.0 = real
+sample, 0.0 = padding). With gradient accumulation G>1, every leaf carries a
+leading (G, B, ...) micro-step axis laid out by the data pipeline, so no
+device resharding is needed to slice micro-batches.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from pytorchvideo_accelerate_tpu.parallel.mesh import BATCH_AXES
+from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+
+
+def model_inputs(batch: dict):
+    """Map a batch dict to the model's input convention."""
+    if "slow" in batch:
+        return (batch["slow"], batch["fast"])
+    return batch["video"]
+
+
+def _constrain_batch(batch: dict, mesh, leading_micro: bool) -> dict:
+    """Pin the (global) batch dim to the DP axes inside the graph."""
+    axes = (None, BATCH_AXES) if leading_micro else (BATCH_AXES,)
+
+    def cons(x):
+        spec = P(*axes, *([None] * (x.ndim - len(axes))))
+        return lax.with_sharding_constraint(x, NamedSharding(mesh, spec))
+
+    return jax.tree.map(cons, batch)
+
+
+def _loss_and_metrics(logits, labels, mask, label_smoothing: float):
+    logits = logits.astype(jnp.float32)
+    num_classes = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    if label_smoothing > 0:
+        onehot = optax.smooth_labels(onehot, label_smoothing)
+    losses = optax.softmax_cross_entropy(logits, onehot)
+    count = mask.sum()
+    loss = (losses * mask).sum() / jnp.maximum(count, 1.0)
+    correct = ((jnp.argmax(logits, -1) == labels) * mask).sum()
+    return loss, correct, count
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    accum_steps: int = 1,
+    label_smoothing: float = 0.0,
+    lr_schedule: Optional[Callable] = None,
+) -> Callable:
+    """Build `step(state, batch, dropout_key) -> (state, metrics)`, jitted
+    with state donation (params update in place in HBM)."""
+
+    def forward_loss(params, batch_stats, batch, key):
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(batch["label"].shape, jnp.float32)
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            model_inputs(batch),
+            train=True,
+            rngs={"dropout": key},
+            mutable=["batch_stats"],
+        )
+        loss, correct, count = _loss_and_metrics(
+            logits, batch["label"], mask, label_smoothing
+        )
+        return loss, (updates["batch_stats"], correct, count)
+
+    grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+
+    def step(state: TrainState, batch: dict, key) -> tuple:
+        if accum_steps == 1:
+            batch = _constrain_batch(batch, mesh, leading_micro=False)
+            (loss, (new_stats, correct, count)), grads = grad_fn(
+                state.params, state.batch_stats, batch, key
+            )
+        else:
+            batch = _constrain_batch(batch, mesh, leading_micro=True)
+
+            def micro(carry, mb):
+                grads_acc, stats, i = carry
+                (loss_i, (stats, corr_i, cnt_i)), g = grad_fn(
+                    state.params, stats, mb, jax.random.fold_in(key, i)
+                )
+                grads_acc = jax.tree.map(jnp.add, grads_acc, g)
+                return (grads_acc, stats, i + 1), (loss_i, corr_i, cnt_i)
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, new_stats, _), (losses, corrs, cnts) = lax.scan(
+                micro, (zeros, state.batch_stats, 0), batch
+            )
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss = jnp.mean(losses)
+            correct, count = corrs.sum(), cnts.sum()
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1,
+            params=new_params,
+            batch_stats=new_stats,
+            opt_state=new_opt_state,
+        )
+        metrics = {
+            "loss": loss,
+            "accuracy": correct / jnp.maximum(count, 1.0),
+            "grad_norm": optax.global_norm(grads),
+        }
+        if lr_schedule is not None:
+            metrics["lr"] = lr_schedule(state.step)
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_eval_step(model, mesh, label_smoothing: float = 0.0) -> Callable:
+    """Build `eval_step(state, batch) -> {loss_sum, correct, count}` —
+    in-graph masked sums; the host just adds them across batches
+    (trainer/metrics.py), nothing to gather."""
+
+    def eval_step(state: TrainState, batch: dict) -> dict:
+        batch = _constrain_batch(batch, mesh, leading_micro=False)
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(batch["label"].shape, jnp.float32)
+        logits = model.apply(
+            {"params": state.params, "batch_stats": state.batch_stats},
+            model_inputs(batch),
+            train=False,
+        )
+        loss, correct, count = _loss_and_metrics(
+            logits, batch["label"], mask, label_smoothing
+        )
+        return {"loss_sum": loss * count, "correct": correct, "count": count}
+
+    return jax.jit(eval_step)

@@ -1,0 +1,32 @@
+"""The training state pytree.
+
+Everything the reference's `accelerator.save_state` collects across torch
+objects (model weights, optimizer state, scheduler counter, GradScaler —
+accelerate checkpointing.py:63-180) lives here in one explicit pytree: params,
+BN running stats, optax state (which embeds the schedule step), and the step
+counter. No scaler (bf16 needs none), no scheduler object (the schedule is a
+pure function of the step embedded in the optax chain).
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import struct
+
+
+class TrainState(struct.PyTreeNode):
+    step: jnp.ndarray  # int32 scalar: optimizer steps taken
+    params: Any
+    batch_stats: Any
+    opt_state: Any
+
+    @classmethod
+    def create(cls, params, batch_stats, tx) -> "TrainState":
+        return cls(
+            step=jnp.zeros((), jnp.int32),
+            params=params,
+            batch_stats=batch_stats,
+            opt_state=tx.init(params),
+        )

@@ -1,0 +1,115 @@
+"""Model zoo tests: shapes, param counts, head/backbone split, registry."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.config import ModelConfig
+from pytorchvideo_accelerate_tpu.models import available_models, create_model
+from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
+from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
+
+
+def _count(params):
+    return sum(np.prod(p.shape) for p in jax.tree.leaves(params))
+
+
+def test_slow_r50_forward_and_param_count():
+    model = SlowR50(num_classes=10)
+    x = jnp.zeros((2, 8, 64, 64, 3))
+    variables = model.init(jax.random.key(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 10)
+    n = _count(variables["params"])
+    # 3D ResNet-50 backbone is ~31.7M; head adds 2048*10. Sanity band.
+    assert 25e6 < n < 40e6, n
+
+
+def test_slow_r50_feature_widths():
+    """res5 output must be 2048-wide: the reference head's in_features=2048
+    (run.py:117) is an architectural invariant we must match for weight
+    porting."""
+    model = SlowR50(num_classes=4)
+    x = jnp.zeros((1, 4, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    kernel = variables["params"]["head"]["proj"]["kernel"]
+    assert kernel.shape == (2048, 4)
+
+
+def test_slowfast_forward_and_head_width():
+    model = SlowFast(num_classes=7)
+    slow = jnp.zeros((2, 2, 64, 64, 3))
+    fast = jnp.zeros((2, 8, 64, 64, 3))
+    variables = model.init(jax.random.key(0), (slow, fast))
+    out = model.apply(variables, (slow, fast))
+    assert out.shape == (2, 7)
+    # concat(2048 slow, 256 fast) = 2304 = reference in_features (run.py:109)
+    kernel = variables["params"]["head"]["proj"]["kernel"]
+    assert kernel.shape == (2304, 7)
+    n = _count(variables["params"])
+    assert 30e6 < n < 45e6, n  # slowfast_r50 ~34M
+
+
+def test_slowfast_temporal_shapes_respect_alpha():
+    """Fast T must be alpha x slow T; lateral fusion time-stride aligns them."""
+    model = SlowFast(num_classes=3, alpha=4)
+    slow = jnp.zeros((1, 2, 32, 32, 3))
+    fast = jnp.zeros((1, 8, 32, 32, 3))
+    variables = model.init(jax.random.key(0), (slow, fast))
+    out = model.apply(variables, (slow, fast))
+    assert out.shape == (1, 3)
+
+
+def test_dropout_train_mode_needs_rng():
+    model = SlowR50(num_classes=5, dropout_rate=0.5)
+    x = jnp.ones((1, 4, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    out, updates = model.apply(
+        variables,
+        x,
+        train=True,
+        rngs={"dropout": jax.random.key(1)},
+        mutable=["batch_stats"],
+    )
+    assert out.shape == (1, 5)
+    assert "batch_stats" in updates
+
+
+def test_batch_stats_update_in_train_mode():
+    model = SlowR50(num_classes=2)
+    x = jnp.ones((2, 4, 32, 32, 3)) * 3.0
+    variables = model.init(jax.random.key(0), x)
+    _, updates = model.apply(
+        variables, x, train=True,
+        rngs={"dropout": jax.random.key(1)}, mutable=["batch_stats"],
+    )
+    before = variables["batch_stats"]["stem"]["norm"]["mean"]
+    after = updates["batch_stats"]["stem"]["norm"]["mean"]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+
+
+def test_registry():
+    assert "slow_r50" in available_models()
+    assert "slowfast_r50" in available_models()
+    model = create_model(ModelConfig(name="slow_r50", num_classes=4), "bf16")
+    assert model.dtype == jnp.bfloat16
+    with pytest.raises(ValueError):
+        create_model(ModelConfig(name="nope", num_classes=4))
+
+
+def test_backbone_filter():
+    assert SlowR50.backbone_param_filter(("res2", "block0"))
+    assert not SlowR50.backbone_param_filter(("head", "proj"))
+    assert SlowFast.backbone_param_filter(("fuse_stem",))
+    assert not SlowFast.backbone_param_filter(("head",))
+
+
+def test_bf16_compute_fp32_params():
+    model = create_model(ModelConfig(name="slow_r50", num_classes=3), "bf16")
+    x = jnp.zeros((1, 4, 32, 32, 3))
+    variables = model.init(jax.random.key(0), x)
+    # params stay fp32; logits come out fp32 (head projects in fp32)
+    assert variables["params"]["stem"]["conv"]["kernel"].dtype == jnp.float32
+    out = model.apply(variables, x)
+    assert out.dtype == jnp.float32
