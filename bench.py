@@ -1,0 +1,134 @@
+#!/usr/bin/env python
+"""Benchmark harness: clips/sec/chip on the flagship training step.
+
+Prints exactly ONE JSON line to stdout:
+    {"metric": "...", "value": N, "unit": "clips/sec/chip", "vs_baseline": N}
+(everything else goes to stderr). Run on the attached TPU by default; pass
+--smoke for a CPU-sized sanity run.
+
+Workload matches the reference launch recipe (run_slowfast_r50.sh:3-12,
+SURVEY §6): SlowFast-R50, 32 frames, 256^2 crops, batch 8 per chip, bf16
+compute (standing in for the recipe's fp16 AMP), measuring the compiled
+train step (fwd+bwd+update, BN stats, metrics) end to end. `vs_baseline` is
+reported as value / published-baseline when BASELINE.json carries a number;
+the reference publishes none (SURVEY §6, "published": {}), so it defaults
+to 1.0.
+"""
+
+import argparse
+import json
+import sys
+import time
+
+
+def log(*args):
+    print(*args, file=sys.stderr, flush=True)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--model", default="slowfast_r50")
+    ap.add_argument("--batch_size", type=int, default=8)
+    ap.add_argument("--num_frames", type=int, default=32)
+    ap.add_argument("--crop", type=int, default=256)
+    ap.add_argument("--alpha", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny CPU-safe shapes for harness verification")
+    args = ap.parse_args()
+
+    if args.smoke:
+        args.batch_size, args.num_frames, args.crop = 4, 8, 64
+        args.steps, args.warmup = 3, 1
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+
+    from pytorchvideo_accelerate_tpu.config import MeshConfig, ModelConfig, OptimConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+    from pytorchvideo_accelerate_tpu.trainer import (
+        TrainState, build_optimizer, make_train_step,
+    )
+
+    devices = jax.devices()
+    n_chips = len(devices)
+    log(f"devices: {n_chips} x {devices[0].device_kind} ({devices[0].platform})")
+
+    mesh = make_mesh(MeshConfig(), devices=devices)
+    num_classes = 700  # Kinetics-700 (BASELINE.json metric)
+    model_cfg = ModelConfig(name=args.model, num_classes=num_classes,
+                            slowfast_alpha=args.alpha)
+    model = create_model(model_cfg, "bf16")
+
+    B = args.batch_size * n_chips  # global batch: bench batch is per chip
+    rng = np.random.default_rng(0)
+    if args.model.startswith("slowfast"):
+        batch = {
+            "slow": rng.standard_normal(
+                (B, args.num_frames // args.alpha, args.crop, args.crop, 3),
+                dtype=np.float32),
+            "fast": rng.standard_normal(
+                (B, args.num_frames, args.crop, args.crop, 3), dtype=np.float32),
+        }
+        sample = (jnp.zeros((1, *batch["slow"].shape[1:])),
+                  jnp.zeros((1, *batch["fast"].shape[1:])))
+    else:
+        batch = {"video": rng.standard_normal(
+            (B, args.num_frames, args.crop, args.crop, 3), dtype=np.float32)}
+        sample = jnp.zeros((1, *batch["video"].shape[1:]))
+    batch["label"] = (rng.integers(0, num_classes, B)).astype(np.int32)
+
+    log(f"global batch {B} ({args.batch_size}/chip), "
+        f"{args.num_frames} frames @ {args.crop}^2")
+
+    variables = model.init(jax.random.key(0), sample)
+    tx = build_optimizer(OptimConfig(), total_steps=args.steps + args.warmup)
+    state = TrainState.create(variables["params"], variables["batch_stats"], tx)
+    step = make_train_step(model, tx, mesh)
+    gb = shard_batch(mesh, batch)
+
+    t0 = time.perf_counter()
+    for i in range(args.warmup):
+        state, metrics = step(state, gb, jax.random.key(i))
+    jax.block_until_ready(metrics["loss"])
+    log(f"warmup ({args.warmup} steps incl. compile): "
+        f"{time.perf_counter() - t0:.1f}s")
+
+    t0 = time.perf_counter()
+    for i in range(args.steps):
+        state, metrics = step(state, gb, jax.random.key(100 + i))
+    jax.block_until_ready(metrics["loss"])
+    dt = time.perf_counter() - t0
+
+    clips_per_sec = B * args.steps / dt
+    per_chip = clips_per_sec / n_chips
+    log(f"{args.steps} steps in {dt:.2f}s -> {clips_per_sec:.2f} clips/s "
+        f"({per_chip:.2f}/chip), step time {dt / args.steps * 1e3:.1f} ms, "
+        f"final loss {float(metrics['loss']):.3f}")
+
+    baseline = None
+    try:
+        published = json.load(open("BASELINE.json")).get("published", {})
+        baseline = published.get("clips_per_sec_per_chip")
+    except Exception:
+        pass
+    vs = per_chip / baseline if baseline else 1.0
+
+    print(json.dumps({
+        "metric": f"train clips/sec/chip ({args.model}, {args.num_frames}f, "
+                  f"{args.crop}px, bf16{', smoke' if args.smoke else ''})",
+        "value": round(per_chip, 3),
+        "unit": "clips/sec/chip",
+        "vs_baseline": round(vs, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,77 @@
+"""Video decode via OpenCV (bundled FFmpeg).
+
+Replaces the reference stack's PyAV->libav decode path (SURVEY §2.3-N9:
+pytorchvideo `EncodedVideo` with `decode_audio=False`, run.py:155,164). The
+build image has no system ffmpeg binary and no PyAV; cv2's VideoCapture is
+the C++ decode engine available to every worker thread (it releases the GIL,
+so a thread pool gives real decode parallelism — see pipeline.py).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional
+
+import numpy as np
+
+try:
+    import cv2
+except Exception:  # pragma: no cover
+    cv2 = None
+
+
+@dataclass
+class VideoMeta:
+    fps: float
+    frame_count: int
+
+    @property
+    def duration(self) -> float:
+        return self.frame_count / self.fps if self.fps > 0 else 0.0
+
+
+def probe(path: str) -> VideoMeta:
+    cap = cv2.VideoCapture(path)
+    try:
+        if not cap.isOpened():
+            raise IOError(f"cannot open video: {path}")
+        fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
+        frame_count = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
+        return VideoMeta(fps=float(fps), frame_count=frame_count)
+    finally:
+        cap.release()
+
+
+def decode_span(path: str, start_sec: float, end_sec: float,
+                max_frames: Optional[int] = None) -> np.ndarray:
+    """Decode frames in [start_sec, end_sec) as (T, H, W, 3) RGB uint8.
+
+    Seeks to the start frame, then reads sequentially — the access pattern
+    clip sampling produces. Raises IOError on unreadable files; returns at
+    least one frame for any readable video (short videos yield what exists,
+    mirroring pytorchvideo's clamp-to-duration behavior [external]).
+    """
+    cap = cv2.VideoCapture(path)
+    try:
+        if not cap.isOpened():
+            raise IOError(f"cannot open video: {path}")
+        fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
+        start_frame = max(int(round(start_sec * fps)), 0)
+        end_frame = max(int(round(end_sec * fps)), start_frame + 1)
+        if max_frames is not None:
+            end_frame = min(end_frame, start_frame + max_frames)
+        if start_frame > 0:
+            cap.set(cv2.CAP_PROP_POS_FRAMES, start_frame)
+        frames = []
+        for _ in range(end_frame - start_frame):
+            ok, frame_bgr = cap.read()
+            if not ok:
+                break
+            frames.append(cv2.cvtColor(frame_bgr, cv2.COLOR_BGR2RGB))
+        if not frames:
+            raise IOError(
+                f"no frames decoded from {path} in [{start_sec:.2f}, {end_sec:.2f})s"
+            )
+        return np.stack(frames)
+    finally:
+        cap.release()

@@ -1,0 +1,63 @@
+"""Dataset manifest: dir-per-class video index.
+
+Replaces pytorchvideo's `Kinetics` path/label discovery and the reference's
+private-attribute label-count hack
+(`train_dataset.dataset._labeled_videos._paths_and_labels`, run.py:185) with
+an explicit, inspectable manifest over the same on-disk layout the reference
+README documents (README.md:17: `data_dir/{train,val}/{class}/*.mp4`).
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Dict, List, Tuple
+
+VIDEO_EXTENSIONS = (".mp4", ".avi", ".mkv", ".webm", ".mov", ".m4v")
+
+
+@dataclass(frozen=True)
+class VideoEntry:
+    path: str
+    label: int
+    label_name: str
+
+
+@dataclass
+class Manifest:
+    entries: List[VideoEntry]
+    class_names: List[str]  # sorted; index = label id
+
+    @property
+    def num_classes(self) -> int:
+        return len(self.class_names)
+
+    @property
+    def num_videos(self) -> int:
+        return len(self.entries)
+
+    def __len__(self) -> int:
+        return len(self.entries)
+
+
+def scan_directory(split_dir: str) -> Manifest:
+    """Scan `split_dir/{class}/*` into a manifest. Class ids are assigned by
+    sorted class-dir name — deterministic across hosts (pytorchvideo sorts
+    the same way [external])."""
+    if not os.path.isdir(split_dir):
+        raise FileNotFoundError(f"dataset split directory not found: {split_dir}")
+    class_names = sorted(
+        d for d in os.listdir(split_dir)
+        if os.path.isdir(os.path.join(split_dir, d)) and not d.startswith(".")
+    )
+    if not class_names:
+        raise ValueError(f"no class directories under {split_dir}")
+    entries: List[VideoEntry] = []
+    for label, name in enumerate(class_names):
+        cdir = os.path.join(split_dir, name)
+        for fname in sorted(os.listdir(cdir)):
+            if fname.lower().endswith(VIDEO_EXTENSIONS):
+                entries.append(VideoEntry(os.path.join(cdir, fname), label, name))
+    if not entries:
+        raise ValueError(f"no video files under {split_dir}")
+    return Manifest(entries=entries, class_names=class_names)

@@ -1,0 +1,285 @@
+"""Host-side clip pipeline: sources, sharded batching, prefetch, state.
+
+TPU-native replacement for the reference's loader stack (SURVEY §2.1
+R8-R10, §2.2-A4): `Kinetics` iterable dataset + `LimitDataset` + torch
+`DataLoader(num_workers=8, pin_memory)` + accelerate's `BatchSamplerShard`
+become:
+
+- a `ClipSource` (real videos via manifest+cv2, or synthetic fixture),
+- deterministic per-epoch shuffling from the shared seed (identical on all
+  hosts — no cross-rank RNG sync needed, SURVEY A11),
+- per-host index interleaving `idx[process_index::process_count]` (the
+  `DistributedSampler`/`BatchSamplerShard` equivalent, without padding
+  duplicates: val tail batches carry an explicit mask instead),
+- a thread-pool decode pool (cv2 releases the GIL; threads give native
+  decode parallelism without fork overhead) with one-batch-ahead prefetch
+  (`DataLoaderShard.__iter__` prefetch semantics, data_loader.py:576-610),
+- checkpointable iterator state {epoch, position} (extends checkpoint
+  capability A8 to data, replacing the reference's skip-batches resume at
+  run.py:246-249 with an O(1) index fast-forward).
+
+Conscious fixes of catalogued reference quirks (SURVEY §2.1): the reference's
+`LimitDataset` shares one iterator across epochs and workers (duplicated
+streams, shuffle=True shuffles nothing); here every (epoch, index) maps to an
+independent deterministic sample.
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import ThreadPoolExecutor
+from dataclasses import dataclass
+from queue import Queue
+from typing import Callable, Dict, Iterator, List, Optional
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.data import decode as decode_mod
+from pytorchvideo_accelerate_tpu.data.manifest import Manifest
+from pytorchvideo_accelerate_tpu.data.samplers import random_clip, uniform_clips
+
+
+class ClipSource:
+    """A deterministic map (epoch, index) -> sample dict of numpy arrays."""
+
+    num_classes: int
+
+    def __len__(self) -> int:  # pragma: no cover - interface
+        raise NotImplementedError
+
+    def get(self, index: int, epoch: int) -> Dict[str, np.ndarray]:  # pragma: no cover
+        raise NotImplementedError
+
+
+class VideoClipSource(ClipSource):
+    """Real videos: manifest entry -> clip span -> cv2 decode -> transform.
+
+    `training=True` samples a random span with an RNG derived from
+    (seed, epoch, index) — reproducible across restarts, distinct across
+    epochs (what the reference's shared-iterator design failed to provide).
+    """
+
+    def __init__(
+        self,
+        manifest: Manifest,
+        transform: Callable,
+        clip_duration: float,
+        training: bool,
+        seed: int = 42,
+    ):
+        self.manifest = manifest
+        self.transform = transform
+        self.clip_duration = clip_duration
+        self.training = training
+        self.seed = seed
+        self.num_classes = manifest.num_classes
+        self._meta_cache: Dict[str, decode_mod.VideoMeta] = {}
+        self._meta_lock = threading.Lock()
+
+    def __len__(self) -> int:
+        return len(self.manifest)
+
+    def _meta(self, path: str) -> decode_mod.VideoMeta:
+        with self._meta_lock:
+            meta = self._meta_cache.get(path)
+        if meta is None:
+            meta = decode_mod.probe(path)
+            with self._meta_lock:
+                self._meta_cache[path] = meta
+        return meta
+
+    def get(self, index: int, epoch: int) -> Dict[str, np.ndarray]:
+        entry = self.manifest.entries[index]
+        meta = self._meta(entry.path)
+        rng = np.random.default_rng((self.seed, epoch, index))
+        if self.training:
+            span = random_clip(meta.duration, self.clip_duration, rng)
+        else:
+            span = uniform_clips(meta.duration, self.clip_duration, 1)[0]
+        frames = decode_mod.decode_span(entry.path, span.start, span.end)
+        out = self.transform(frames, rng)
+        out["label"] = np.int32(entry.label)
+        return out
+
+
+class SyntheticClipSource(ClipSource):
+    """Label-coded synthetic clips — the `RegressionDataset` moral equivalent
+    from accelerate's harness (SURVEY §4.4), used by tests and bench; no
+    video files, but the full transform stack still runs."""
+
+    def __init__(
+        self,
+        transform: Callable,
+        num_videos: int = 64,
+        num_classes: int = 4,
+        raw_frames: int = 24,
+        raw_size: tuple = (72, 96),
+        seed: int = 42,
+    ):
+        self.transform = transform
+        self.num_videos = num_videos
+        self.num_classes = num_classes
+        self.raw_frames = raw_frames
+        self.raw_size = raw_size
+        self.seed = seed
+
+    def __len__(self) -> int:
+        return self.num_videos
+
+    def get(self, index: int, epoch: int) -> Dict[str, np.ndarray]:
+        label = index % self.num_classes
+        rng = np.random.default_rng((self.seed, epoch, index))
+        h, w = self.raw_size
+        frames = (rng.random((self.raw_frames, h, w, 3)) * 60).astype(np.uint8)
+        frames += np.uint8(label * (160 // max(self.num_classes - 1, 1)))
+        out = self.transform(frames, rng)
+        out["label"] = np.int32(label)
+        return out
+
+
+@dataclass
+class LoaderState:
+    """Checkpointable iterator position."""
+
+    epoch: int = 0
+    position: int = 0  # batches already yielded this epoch
+
+    def to_dict(self) -> dict:
+        return {"epoch": self.epoch, "position": self.position}
+
+    @classmethod
+    def from_dict(cls, d: Optional[dict]) -> "LoaderState":
+        d = d or {}
+        return cls(epoch=int(d.get("epoch", 0)), position=int(d.get("position", 0)))
+
+
+class ClipLoader:
+    """Batches a ClipSource for one host of a data-parallel mesh.
+
+    Yields numpy batch dicts shaped (B_local, ...) — or (accum, B_local, ...)
+    when `accum_steps > 1` — ready for `parallel.sharding.shard_batch`.
+    `global_batch_size` is the whole-mesh batch; B_local is this host's share.
+    """
+
+    def __init__(
+        self,
+        source: ClipSource,
+        global_batch_size: int,
+        accum_steps: int = 1,
+        shuffle: bool = False,
+        drop_last: bool = True,
+        seed: int = 42,
+        num_workers: int = 8,
+        process_index: int = 0,
+        process_count: int = 1,
+        prefetch_batches: int = 2,
+    ):
+        if global_batch_size % process_count:
+            raise ValueError(
+                f"global_batch_size {global_batch_size} not divisible by "
+                f"process_count {process_count}"
+            )
+        self.source = source
+        self.global_batch_size = global_batch_size
+        self.local_batch_size = global_batch_size // process_count
+        self.accum_steps = max(accum_steps, 1)
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self.seed = seed
+        self.num_workers = max(num_workers, 1)
+        self.process_index = process_index
+        self.process_count = process_count
+        self.prefetch_batches = prefetch_batches
+        self.state = LoaderState()
+        self._pool = ThreadPoolExecutor(max_workers=self.num_workers)
+
+    # --- epoch geometry ---------------------------------------------------
+
+    def _epoch_indices(self, epoch: int) -> np.ndarray:
+        idx = np.arange(len(self.source))
+        if self.shuffle:
+            rng = np.random.default_rng((self.seed, 0xDA7A, epoch))
+            rng.shuffle(idx)
+        return idx[self.process_index :: self.process_count]
+
+    @property
+    def samples_per_yield(self) -> int:
+        return self.local_batch_size * self.accum_steps
+
+    def batches_per_epoch(self) -> int:
+        n = len(self.source) // self.process_count
+        if self.drop_last:
+            return n // self.samples_per_yield
+        return -(-n // self.samples_per_yield)
+
+    def steps_per_epoch(self) -> int:
+        """Optimizer steps per epoch (one per yielded super-batch)."""
+        return self.batches_per_epoch()
+
+    # --- iteration --------------------------------------------------------
+
+    def _assemble(self, samples: List[Dict[str, np.ndarray]], pad_to: int) -> dict:
+        n = len(samples)
+        keys = samples[0].keys()
+        batch = {k: np.stack([s[k] for s in samples]) for k in keys}
+        if n < pad_to:  # padded tail (val only): mask marks real samples
+            mask = np.zeros(pad_to, np.float32)
+            mask[:n] = 1.0
+            for k in list(batch):
+                pad_shape = (pad_to - n, *batch[k].shape[1:])
+                batch[k] = np.concatenate(
+                    [batch[k], np.zeros(pad_shape, batch[k].dtype)]
+                )
+            batch["mask"] = mask
+        if self.accum_steps > 1:
+            batch = {
+                k: v.reshape(self.accum_steps, self.local_batch_size, *v.shape[1:])
+                for k, v in batch.items()
+            }
+        return batch
+
+    def epoch(self, epoch: Optional[int] = None) -> Iterator[dict]:
+        """Iterate one epoch, honoring and updating `self.state` (resume
+        mid-epoch by restoring state before calling)."""
+        if epoch is not None:
+            if epoch != self.state.epoch:
+                self.state = LoaderState(epoch=epoch, position=0)
+        epoch = self.state.epoch
+        indices = self._epoch_indices(epoch)
+        spy = self.samples_per_yield
+        n_batches = self.batches_per_epoch()
+
+        def fetch_batch(b: int) -> dict:
+            chunk = indices[b * spy : (b + 1) * spy]
+            samples = list(
+                self._pool.map(lambda i: self.source.get(int(i), epoch), chunk)
+            )
+            return self._assemble(samples, spy)
+
+        start = self.state.position
+        pending: "Queue[tuple]" = Queue()
+        depth = max(self.prefetch_batches, 1)
+        next_submit = start
+        submitted = 0
+        executor = ThreadPoolExecutor(max_workers=1)  # batch-assembly lane
+        try:
+            while next_submit < n_batches and submitted < depth:
+                pending.put((next_submit, executor.submit(fetch_batch, next_submit)))
+                next_submit += 1
+                submitted += 1
+            while not pending.empty():
+                b, fut = pending.get()
+                batch = fut.result()
+                if next_submit < n_batches:
+                    pending.put(
+                        (next_submit, executor.submit(fetch_batch, next_submit))
+                    )
+                    next_submit += 1
+                self.state = LoaderState(epoch=epoch, position=b + 1)
+                yield batch
+            self.state = LoaderState(epoch=epoch + 1, position=0)
+        finally:
+            executor.shutdown(wait=False)
+
+    def close(self) -> None:
+        self._pool.shutdown(wait=False)

@@ -16,6 +16,8 @@ from pytorchvideo_accelerate_tpu.config import ModelConfig
 from pytorchvideo_accelerate_tpu.models.heads import ResBasicHead  # noqa: F401
 from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
 from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
+from pytorchvideo_accelerate_tpu.models.x3d import X3D
+from pytorchvideo_accelerate_tpu.models.mvit import MViT
 
 _REGISTRY: Dict[str, Callable] = {}
 
@@ -52,6 +54,40 @@ def _slowfast_r101(cfg: ModelConfig, dtype):
         depths=(3, 4, 23, 3),
         alpha=cfg.slowfast_alpha,
         dropout_rate=cfg.dropout_rate,
+        dtype=dtype,
+    )
+
+
+@register_model("x3d_xs")
+def _x3d_xs(cfg: ModelConfig, dtype):
+    return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+               dtype=dtype)
+
+
+@register_model("x3d_s")
+def _x3d_s(cfg: ModelConfig, dtype):
+    # XS and S share the trunk; they differ in sampling (13f@160px for S)
+    return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+               dtype=dtype)
+
+
+@register_model("x3d_m")
+def _x3d_m(cfg: ModelConfig, dtype):
+    return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+               dtype=dtype)
+
+
+@register_model("mvit_b")
+def _mvit_b(cfg: ModelConfig, dtype):
+    if cfg.attention not in ("dense", "pallas", "ring"):
+        raise NotImplementedError(
+            f"attention backend {cfg.attention!r} not available for mvit_b"
+        )
+    return MViT(
+        num_classes=cfg.num_classes,
+        dropout_rate=cfg.dropout_rate,
+        attention_backend=cfg.attention,
+        context_axis="context" if cfg.attention == "ring" else None,
         dtype=dtype,
     )
 

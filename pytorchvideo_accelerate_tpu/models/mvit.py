@@ -1,0 +1,214 @@
+"""MViT: Multiscale Vision Transformers for video, TPU-native.
+
+BASELINE config 4 ("MViT-B multiscale video transformer, attention path ->
+XLA"). Architecture per Fan et al. 2021 (arXiv:2104.11227) with
+pytorchvideo's MViT-B/16x4 constants:
+
+- patch embed: 3x7x7 conv, stride (2,4,4), 96 dims
+- 16 transformer blocks; channel dim doubles entering blocks 1/3/14
+  (96->192->384->768) with head count 1->2->4->8
+- pooling attention (MHPA): Q pooled by stride (1,2,2) at each stage
+  transition (shrinking the token grid), K/V pooled by an adaptive stride
+  starting at (1,8,8) and halving spatially per stage; pooling = depthwise
+  conv per head channel + LN, with residual Q-pooling (x = x_pooled + attn)
+- MLP ratio 4, stochastic depth, LN everywhere
+
+TPU-first deviations from the torch implementation (documented, tested):
+- token tensors stay in their (B, T, H, W, C) grid between blocks; pooling
+  is a real strided depthwise conv on the grid (no flatten->unflatten
+  round-trips), which XLA maps onto conv units directly;
+- no CLS token — the head mean-pools the final grid (pytorchvideo exposes
+  the same via `cls_embed_on=False`): keeps every tensor dense/static for
+  the compiler and makes the sequence axis cleanly shardable for
+  context-parallel attention (SURVEY §5).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+
+def _drop_path(x, rate: float, deterministic: bool, rng):
+    if deterministic or rate == 0.0:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, (x.shape[0],) + (1,) * (x.ndim - 1))
+    return x * mask / keep
+
+
+class PoolHeads(nn.Module):
+    """Depthwise conv pooling of a per-head token grid + LN (MHPA pooling,
+    paper §3.1 'conv' mode). Operates on (B, T, H, W, heads*head_dim)."""
+
+    channels: int
+    stride: Tuple[int, int, int]
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        if self.stride == (1, 1, 1):
+            return x
+        x = nn.Conv(
+            self.channels,
+            kernel_size=tuple(s + 1 if s > 1 else 3 for s in self.stride),
+            strides=self.stride,
+            padding=[((k := (s + 1 if s > 1 else 3)) // 2, k // 2) for s in self.stride],
+            feature_group_count=self.channels,
+            use_bias=False,
+            dtype=self.dtype,
+            name="pool",
+        )(x)
+        return nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+
+
+class MultiScaleAttention(nn.Module):
+    """Pooling attention over a (B, T, H, W, C) token grid."""
+
+    dim_out: int
+    num_heads: int
+    q_stride: Tuple[int, int, int] = (1, 1, 1)
+    kv_stride: Tuple[int, int, int] = (1, 1, 1)
+    attention_backend: str = "dense"
+    context_axis: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, T, H, W, _ = x.shape
+        qkv = nn.Dense(3 * self.dim_out, dtype=self.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        q = PoolHeads(self.dim_out, self.q_stride, self.dtype, name="pool_q")(q)
+        k = PoolHeads(self.dim_out, self.kv_stride, self.dtype, name="pool_k")(k)
+        v = PoolHeads(self.dim_out, self.kv_stride, self.dtype, name="pool_v")(v)
+
+        tq, hq, wq = q.shape[1:4]
+        head_dim = self.dim_out // self.num_heads
+
+        def to_tokens(t):
+            return t.reshape(B, -1, self.num_heads, head_dim)
+
+        attn = dot_product_attention(
+            to_tokens(q), to_tokens(k), to_tokens(v),
+            backend=self.attention_backend, axis_name=self.context_axis,
+        )
+        attn = attn.reshape(B, tq, hq, wq, self.dim_out)
+        attn = attn + q  # residual Q-pooling (paper §3.1, improved MViTv2 form)
+        return nn.Dense(self.dim_out, dtype=self.dtype, name="proj")(attn)
+
+
+class MViTBlock(nn.Module):
+    dim_out: int
+    num_heads: int
+    q_stride: Tuple[int, int, int] = (1, 1, 1)
+    kv_stride: Tuple[int, int, int] = (1, 1, 1)
+    mlp_ratio: float = 4.0
+    drop_path: float = 0.0
+    attention_backend: str = "dense"
+    context_axis: Optional[str] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        shortcut = x
+        y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        y = MultiScaleAttention(
+            dim_out=self.dim_out, num_heads=self.num_heads,
+            q_stride=self.q_stride, kv_stride=self.kv_stride,
+            attention_backend=self.attention_backend,
+            context_axis=self.context_axis, dtype=self.dtype, name="attn",
+        )(y)
+        # skip path: max-pool + linear when the grid/dim changes
+        if self.q_stride != (1, 1, 1):
+            shortcut = nn.max_pool(
+                shortcut,
+                window_shape=self.q_stride,
+                strides=self.q_stride,
+                padding="SAME",
+            )
+        if shortcut.shape[-1] != self.dim_out:
+            shortcut = nn.Dense(self.dim_out, dtype=self.dtype, name="skip_proj")(shortcut)
+        rng = self.make_rng("dropout") if train and self.drop_path > 0 else None
+        x = shortcut + _drop_path(y, self.drop_path, not train, rng)
+
+        y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        y = nn.Dense(int(self.dim_out * self.mlp_ratio), dtype=self.dtype,
+                     name="mlp_fc1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim_out, dtype=self.dtype, name="mlp_fc2")(y)
+        rng = self.make_rng("dropout") if train and self.drop_path > 0 else None
+        return x + _drop_path(y, self.drop_path, not train, rng)
+
+
+class MViT(nn.Module):
+    """MViT-B/16x4 by default: 16 frames sampled every 4 (T=16 in, 8 after
+    the stride-2 patch embed), 224^2 crops."""
+
+    num_classes: int
+    depth: int = 16
+    embed_dim: int = 96
+    num_heads: int = 1
+    stage_starts: Tuple[int, ...] = (1, 3, 14)  # dim x2, heads x2 at each
+    patch_kernel: Tuple[int, int, int] = (3, 7, 7)
+    patch_stride: Tuple[int, int, int] = (2, 4, 4)
+    initial_kv_stride: Tuple[int, int, int] = (1, 8, 8)
+    mlp_ratio: float = 4.0
+    drop_path_rate: float = 0.2
+    dropout_rate: float = 0.5
+    attention_backend: str = "dense"
+    context_axis: Optional[str] = None
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            self.embed_dim, kernel_size=self.patch_kernel,
+            strides=self.patch_stride,
+            padding=[(k // 2, k // 2) for k in self.patch_kernel],
+            dtype=self.dtype, name="patch_embed",
+        )(x)
+        B, T, H, W, _ = x.shape
+        pos = self.param(
+            "pos_embed", nn.initializers.truncated_normal(0.02),
+            (1, T, H, W, self.embed_dim), jnp.float32,
+        )
+        x = x + pos.astype(self.dtype)
+
+        dim, heads = self.embed_dim, self.num_heads
+        kv_stride = list(self.initial_kv_stride)
+        dpr = [self.drop_path_rate * i / max(self.depth - 1, 1) for i in range(self.depth)]
+        for i in range(self.depth):
+            if i in self.stage_starts:
+                dim, heads = dim * 2, heads * 2
+                q_stride = (1, 2, 2)
+                kv_stride = [max(s // 2, 1) if j > 0 else s
+                             for j, s in enumerate(kv_stride)]
+            else:
+                q_stride = (1, 1, 1)
+            x = MViTBlock(
+                dim_out=dim, num_heads=heads, q_stride=q_stride,
+                kv_stride=tuple(kv_stride), mlp_ratio=self.mlp_ratio,
+                drop_path=dpr[i], attention_backend=self.attention_backend,
+                context_axis=self.context_axis, dtype=self.dtype,
+                name=f"block{i}",
+            )(x, train)
+
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        x = jnp.mean(x, axis=(1, 2, 3))
+        x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
+            x.astype(jnp.float32)
+        )
+
+    @staticmethod
+    def backbone_param_filter(path: Tuple[str, ...]) -> bool:
+        return path[0] != "head"

@@ -1,0 +1,5 @@
+"""Compute ops: attention backends (dense XLA, Pallas flash, ring/Ulysses
+context-parallel) and custom kernels for the hot paths the model zoo shares.
+"""
+
+from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention  # noqa: F401

@@ -1,0 +1,50 @@
+"""Attention backends.
+
+The transformer models (MViT, VideoMAE) call one entry point —
+`dot_product_attention(q, k, v, backend=...)` — so the attention
+implementation is a deployment choice, not a model choice:
+
+- "dense": `jax.nn.dot_product_attention` (XLA fuses QK^T -> softmax -> AV;
+  on TPU this hits the MXU with flash-style chunking from the compiler).
+- "pallas": hand-tiled flash attention kernel (ops/pallas_attention.py) for
+  sizes where XLA's default schedule underperforms.
+- "ring": context-parallel ring attention over the mesh "context" axis
+  (parallel/ring_attention.py) — sequence sharded, K/V blocks rotate over
+  ICI via ppermute (SURVEY §5 long-context plan).
+
+Shapes: q (B, Nq, H, D), k/v (B, Nkv, H, D) — BNHD, heads separate, the
+layout XLA:TPU prefers for attention (no pre-transpose of the token axis).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def dense_attention(q, k, v, scale: Optional[float] = None):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def dot_product_attention(q, k, v, backend: str = "dense", axis_name: Optional[str] = None):
+    """Route to an attention implementation. `axis_name` is required for the
+    ring backend (the mesh axis the sequence is sharded over)."""
+    if backend == "dense":
+        return dense_attention(q, k, v)
+    if backend == "pallas":
+        from pytorchvideo_accelerate_tpu.ops.pallas_attention import flash_attention
+
+        return flash_attention(q, k, v)
+    if backend == "ring":
+        from pytorchvideo_accelerate_tpu.parallel.ring_attention import ring_attention
+
+        if axis_name is None:
+            raise ValueError("ring attention needs the context-axis name")
+        return ring_attention(q, k, v, axis_name=axis_name)
+    raise ValueError(f"unknown attention backend {backend!r}")

@@ -1,0 +1,32 @@
+"""CLI entrypoint.
+
+Usage (reference-compatible flags, SURVEY §2.1-R1):
+
+    python -m pytorchvideo_accelerate_tpu.run --data_dir /data/kinetics \\
+        --is_slowfast --num_frames 32 --sampling_rate 2 --batch_size 8 \\
+        --gradient_accumulation_steps 4 --with_tracking \\
+        --checkpointing_steps epoch
+
+or with dotted flags (--optim.lr 0.1, --mesh.fsdp 2, ...). Replaces
+`accelerate launch run.py <flags>` (run_slowfast_r50.sh): no separate
+launcher is needed on TPU — single-host runs start directly; pod runs start
+one process per host (the pod scheduler's job) and self-configure via
+`jax.distributed` (parallel/distributed.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from pytorchvideo_accelerate_tpu.config import parse_cli
+from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+
+def main(argv: Optional[Sequence[str]] = None) -> dict:
+    cfg = parse_cli(argv)
+    trainer = Trainer(cfg)
+    return trainer.fit()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,142 @@
+"""Checkpoint/resume engine (orbax).
+
+TPU-native replacement for accelerate's checkpoint engine (SURVEY §2.2-A8,
+§3.6): `save_state`/`load_state` writing model.safetensors / optimizer.bin /
+scheduler.bin / scaler.pt / random_states_{rank}.pkl becomes ONE orbax
+composite per step: the whole TrainState pytree (params + BN stats + optax
+state, sharding-aware, async) plus a JSON `extra` record (epoch, kind,
+data-iterator state, config snapshot).
+
+What the reference saves that we deliberately do NOT:
+- GradScaler state — no scaler under bf16 (SURVEY §2.3-N7).
+- Scheduler object — the LR schedule is a pure function of the step already
+  inside `opt_state`.
+- Per-process RNG pickles (checkpointing.py:154-179) — all randomness is
+  derived from (seed, step/epoch) via fold_in (utils/rng.py), so resume
+  re-derives identical streams from the restored step; the data-iterator
+  position lives in `extra["data_state"]`.
+
+Naming/resume semantics kept from the reference (run.py:123-133, 203-224):
+`checkpointing_steps` = int | "epoch"; a checkpoint knows whether it was an
+epoch-end or mid-epoch step save (`extra["kind"]`), and `resume="auto"`
+scans for the latest — fixing the unreachable auto-find branch at
+run.py:208-212.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Optional, Tuple
+
+import jax
+import orbax.checkpoint as ocp
+
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+
+class Checkpointer:
+    """Step-indexed checkpoint manager over `output_dir`.
+
+    Retention (`max_to_keep`) covers accelerate's
+    `ProjectConfiguration.total_limit` semantics (accelerator.py:3622-3646);
+    async saving overlaps the write with the next train steps and is fenced
+    by `wait()`/`close()`.
+    """
+
+    def __init__(self, directory: str, max_to_keep: int = 0, use_async: bool = True):
+        self.directory = os.path.abspath(directory)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep if max_to_keep > 0 else None,
+            enable_async_checkpointing=use_async,
+            create=True,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    def save(self, step: int, state: Any, extra: Optional[dict] = None) -> None:
+        self._mgr.save(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardSave(state),
+                extra=ocp.args.JsonSave(extra or {}),
+            ),
+        )
+
+    def restore(
+        self, state_template: Any, step: Optional[int] = None, mesh=None
+    ) -> Tuple[Any, dict, int]:
+        """Restore `(state, extra, step)`; `state_template` (a matching
+        pytree, e.g. a freshly-initialized TrainState) drives dtypes/shapes.
+        Pass `mesh` to restore arrays directly into their mesh placement
+        (replicated / fsdp-sharded per parallel.sharding rules) — without it,
+        restored arrays are committed to the default device and will clash
+        with mesh-sharded batches inside jit."""
+        if mesh is not None and state_template is not None:
+            from pytorchvideo_accelerate_tpu.parallel.sharding import (
+                state_sharding_like,
+            )
+            import jax.numpy as jnp
+
+            shardings = state_sharding_like(mesh, state_template)
+            state_template = jax.tree.map(
+                lambda x, s: jax.ShapeDtypeStruct(
+                    jnp.shape(x), jnp.asarray(x).dtype, sharding=s
+                ),
+                state_template,
+                shardings,
+            )
+        if step is None:
+            step = self.latest_step()
+            if step is None:
+                raise FileNotFoundError(f"no checkpoint found in {self.directory}")
+        restored = self._mgr.restore(
+            int(step),
+            args=ocp.args.Composite(
+                state=ocp.args.StandardRestore(state_template),
+                extra=ocp.args.JsonRestore(),
+            ),
+        )
+        return restored["state"], dict(restored["extra"] or {}), int(step)
+
+    def latest_step(self) -> Optional[int]:
+        return self._mgr.latest_step()
+
+    def all_steps(self):
+        return sorted(self._mgr.all_steps())
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+
+def resolve_resume_path(resume: str, output_dir: str) -> Optional[str]:
+    """Map the reference's `--resume_from_checkpoint` forms onto a manager
+    directory: "" -> None; "auto" -> output_dir if it has checkpoints; an
+    explicit path -> that path (its parent manager dir if a step subdir was
+    given, matching the reference's habit of pointing at `step_{i}/`)."""
+    if not resume:
+        return None
+    if resume == "auto":
+        return output_dir
+    resume = resume.rstrip("/")
+    base = os.path.basename(resume)
+    if base.isdigit():  # orbax step dir
+        return os.path.dirname(resume)
+    for prefix in ("step_", "epoch_"):  # reference-style names (run.py:214-224)
+        if base.startswith(prefix) and base[len(prefix):].isdigit():
+            return os.path.dirname(resume)
+    return resume
+
+
+def resume_step_hint(resume: str) -> Optional[int]:
+    """If the user pointed at a specific step/epoch dir, extract the step."""
+    base = os.path.basename(resume.rstrip("/"))
+    if base.isdigit():
+        return int(base)
+    if base.startswith("step_") and base[5:].isdigit():
+        return int(base[5:])
+    return None

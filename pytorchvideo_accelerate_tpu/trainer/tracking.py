@@ -1,0 +1,164 @@
+"""Experiment tracking multiplexer.
+
+Replaces accelerate's tracker stack (SURVEY §2.2-A9: `GeneralTracker` ABC,
+TensorBoard/wandb concrete trackers, `log_with="all"` auto-discovery at
+tracking.py:1260-1290, main-process fan-out at accelerator.py:3356-3386).
+Same shape here: a small Tracker protocol, concrete writers, and "all"
+resolving to whatever is importable — wandb is absent in this image, so it
+gates cleanly; tensorboard writes via tf.summary; jsonl is always available
+and is what the bench/driver parse.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Dict, List, Optional
+
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+
+class Tracker:
+    name = "base"
+
+    def start(self, run_name: str, config: dict) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def log(self, values: Dict[str, float], step: int) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+    def finish(self) -> None:
+        pass
+
+
+class JsonlTracker(Tracker):
+    """One JSON line per log call — always available, trivially parseable."""
+
+    name = "jsonl"
+
+    def __init__(self, logging_dir: str):
+        self.logging_dir = logging_dir
+        self._fh = None
+
+    def start(self, run_name: str, config: dict) -> None:
+        os.makedirs(self.logging_dir, exist_ok=True)
+        path = os.path.join(self.logging_dir, f"{run_name}.jsonl")
+        self._fh = open(path, "a")
+        self._fh.write(json.dumps({"event": "start", "run": run_name,
+                                   "time": time.time(), "config": config},
+                                  default=str) + "\n")
+        self._fh.flush()
+
+    def log(self, values: Dict[str, float], step: int) -> None:
+        if self._fh:
+            self._fh.write(json.dumps({"step": int(step), **{k: float(v) for k, v in values.items()}}) + "\n")
+            self._fh.flush()
+
+    def finish(self) -> None:
+        if self._fh:
+            self._fh.write(json.dumps({"event": "end", "time": time.time()}) + "\n")
+            self._fh.close()
+            self._fh = None
+
+
+class TensorBoardTracker(Tracker):
+    name = "tensorboard"
+
+    def __init__(self, logging_dir: str):
+        self.logging_dir = logging_dir
+        self._writer = None
+
+    def start(self, run_name: str, config: dict) -> None:
+        import tensorflow as tf  # installed in the build env
+
+        self._writer = tf.summary.create_file_writer(
+            os.path.join(self.logging_dir, run_name)
+        )
+        with self._writer.as_default():
+            tf.summary.text("config", json.dumps(config, default=str), step=0)
+
+    def log(self, values: Dict[str, float], step: int) -> None:
+        import tensorflow as tf
+
+        if self._writer:
+            with self._writer.as_default():
+                for k, v in values.items():
+                    tf.summary.scalar(k, float(v), step=int(step))
+            self._writer.flush()
+
+    def finish(self) -> None:
+        if self._writer:
+            self._writer.close()
+            self._writer = None
+
+
+class WandbTracker(Tracker):
+    name = "wandb"
+
+    def __init__(self, logging_dir: str):
+        self.logging_dir = logging_dir
+        self._run = None
+
+    def start(self, run_name: str, config: dict) -> None:
+        import wandb
+
+        self._run = wandb.init(name=run_name, config=config, dir=self.logging_dir)
+
+    def log(self, values: Dict[str, float], step: int) -> None:
+        if self._run:
+            self._run.log(values, step=int(step))
+
+    def finish(self) -> None:
+        if self._run:
+            self._run.finish()
+            self._run = None
+
+
+def _available(name: str) -> bool:
+    if name == "jsonl":
+        return True
+    try:
+        __import__({"tensorboard": "tensorflow", "wandb": "wandb"}[name])
+        return True
+    except Exception:
+        return False
+
+
+def resolve_trackers(spec: str, logging_dir: str) -> List[Tracker]:
+    """`"all"` -> every importable tracker (accelerate tracking.py:1260-1290
+    semantics); else a comma-list of names."""
+    names = ["jsonl", "tensorboard", "wandb"] if spec == "all" else [
+        s.strip() for s in spec.split(",") if s.strip()
+    ]
+    out: List[Tracker] = []
+    for n in names:
+        if not _available(n):
+            logger.info("tracker %s unavailable; skipping", n)
+            continue
+        cls = {"jsonl": JsonlTracker, "tensorboard": TensorBoardTracker,
+               "wandb": WandbTracker}[n]
+        out.append(cls(logging_dir))
+    return out
+
+
+class TrackerHub:
+    """Fan-out facade: `init_trackers`/`log`/`end_training` equivalents
+    (reference run.py:231,274,323). Construct on the main process only."""
+
+    def __init__(self, spec: str, logging_dir: str):
+        self.trackers = resolve_trackers(spec, logging_dir)
+
+    def start(self, run_name: str, config: dict) -> None:
+        for t in self.trackers:
+            t.start(run_name, config)
+
+    def log(self, values: Dict[str, float], step: int) -> None:
+        for t in self.trackers:
+            t.log(values, step)
+
+    def finish(self) -> None:
+        for t in self.trackers:
+            t.finish()

@@ -52,6 +52,11 @@ class RngManager:
     def step_key(self, step) -> jax.Array:
         return jax.random.fold_in(self.root, step)
 
+    def init_key(self) -> jax.Array:
+        """Model parameter-init key, in its own fold_in subtree so it can
+        never collide with step_key(n) for any step n."""
+        return jax.random.fold_in(jax.random.fold_in(self.root, 0x1A171), 0)
+
     def data_key(self, epoch: int) -> jax.Array:
         return jax.random.fold_in(jax.random.fold_in(self.root, 0x9E3779B9), epoch)
 

@@ -1,0 +1,195 @@
+"""Data pipeline tests: manifest scan, decode, samplers, loader sharding,
+mid-epoch resume, padded tails — incl. a real-decode 4-video fixture
+(BASELINE config 1's "4-video Kinetics subset" equivalent, SURVEY §4.4)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.data.decode import decode_span, probe
+from pytorchvideo_accelerate_tpu.data.manifest import scan_directory
+from pytorchvideo_accelerate_tpu.data.pipeline import (
+    ClipLoader,
+    LoaderState,
+    SyntheticClipSource,
+    VideoClipSource,
+)
+from pytorchvideo_accelerate_tpu.data.samplers import random_clip, uniform_clips
+from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+
+
+@pytest.fixture(scope="module")
+def video_dir(tmp_path_factory):
+    """dir-per-class layout: 2 classes x 2 videos, 2s @ 10fps, 64x48."""
+    import cv2
+
+    root = tmp_path_factory.mktemp("kinetics_subset")
+    for split in ["train", "val"]:
+        for cls, base in [("archery", 40), ("bowling", 160)]:
+            cdir = root / split / cls
+            cdir.mkdir(parents=True)
+            for v in range(2):
+                path = str(cdir / f"{cls}_{v}.avi")
+                w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"MJPG"), 10.0, (64, 48))
+                assert w.isOpened()
+                rng = np.random.default_rng(hash((cls, v)) % 2**32)
+                for i in range(20):
+                    frame = (rng.random((48, 64, 3)) * 40 + base).astype(np.uint8)
+                    w.write(frame)
+                w.release()
+    return str(root)
+
+
+def test_manifest_scan(video_dir):
+    m = scan_directory(os.path.join(video_dir, "train"))
+    assert m.num_classes == 2
+    assert m.class_names == ["archery", "bowling"]  # sorted = label order
+    assert m.num_videos == 4
+    labels = sorted(e.label for e in m.entries)
+    assert labels == [0, 0, 1, 1]
+
+
+def test_manifest_missing_dir():
+    with pytest.raises(FileNotFoundError):
+        scan_directory("/nonexistent/dir")
+
+
+def test_probe_and_decode(video_dir):
+    m = scan_directory(os.path.join(video_dir, "train"))
+    meta = probe(m.entries[0].path)
+    assert meta.fps == 10.0
+    assert meta.frame_count == 20
+    assert abs(meta.duration - 2.0) < 1e-6
+    frames = decode_span(m.entries[0].path, 0.5, 1.5)
+    assert frames.shape == (10, 48, 64, 3)
+    assert frames.dtype == np.uint8
+
+
+def test_decode_short_video_clamps(video_dir):
+    m = scan_directory(os.path.join(video_dir, "train"))
+    frames = decode_span(m.entries[0].path, 1.5, 5.0)  # beyond end
+    assert 1 <= frames.shape[0] <= 6
+
+
+def test_samplers():
+    rng = np.random.default_rng(0)
+    spans = [random_clip(10.0, 2.0, rng) for _ in range(50)]
+    assert all(0.0 <= s.start <= 8.0 and abs((s.end - s.start) - 2.0) < 1e-9 for s in spans)
+    assert len({round(s.start, 3) for s in spans}) > 10  # actually random
+
+    u = uniform_clips(10.0, 2.0, 1)
+    assert u[0].start == 4.0  # centered single clip
+    u3 = uniform_clips(10.0, 2.0, 3)
+    assert [s.start for s in u3] == [0.0, 4.0, 8.0]
+    short = uniform_clips(1.0, 2.0, 1)
+    assert short[0].start == 0.0 and short[0].end == 1.0
+
+
+def test_video_source_end_to_end(video_dir):
+    m = scan_directory(os.path.join(video_dir, "train"))
+    tf = make_transform(num_frames=4, training=True, crop_size=32,
+                        min_short_side_scale=32, max_short_side_scale=40)
+    src = VideoClipSource(m, tf, clip_duration=1.0, training=True, seed=7)
+    s = src.get(0, epoch=0)
+    assert s["video"].shape == (4, 32, 32, 3)
+    assert s["label"] == 0
+    # deterministic per (epoch, index); distinct across epochs
+    s2 = src.get(0, epoch=0)
+    np.testing.assert_array_equal(s["video"], s2["video"])
+    s3 = src.get(0, epoch=1)
+    assert not np.array_equal(s["video"], s3["video"])
+
+
+def test_synthetic_source_label_coded():
+    tf = make_transform(num_frames=4, training=False, crop_size=32,
+                        min_short_side_scale=32)
+    src = SyntheticClipSource(tf, num_videos=8, num_classes=4)
+    s0, s5 = src.get(0, 0), src.get(5, 0)
+    assert s0["label"] == 0 and s5["label"] == 1
+    # brightness coding: higher label -> higher mean
+    assert s5["video"].mean() > s0["video"].mean()
+
+
+def _loader(n_videos=16, bs=8, **kw):
+    tf = make_transform(num_frames=4, training=False, crop_size=32,
+                        min_short_side_scale=32)
+    src = SyntheticClipSource(tf, num_videos=n_videos, num_classes=4)
+    return ClipLoader(src, global_batch_size=bs, num_workers=2, **kw)
+
+
+def test_loader_basic_epoch():
+    loader = _loader(n_videos=16, bs=8)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 2 == loader.batches_per_epoch()
+    assert batches[0]["video"].shape == (8, 4, 32, 32, 3)
+    assert batches[0]["label"].shape == (8,)
+    assert "mask" not in batches[0]
+    loader.close()
+
+
+def test_loader_accum_shaping():
+    loader = _loader(n_videos=16, bs=4, accum_steps=2)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 2
+    assert batches[0]["video"].shape == (2, 4, 4, 32, 32, 3)
+    loader.close()
+
+
+def test_loader_padded_tail_mask():
+    loader = _loader(n_videos=10, bs=8, drop_last=False)
+    batches = list(loader.epoch(0))
+    assert len(batches) == 2
+    assert "mask" not in batches[0]
+    assert batches[1]["mask"].tolist() == [1, 1, 0, 0, 0, 0, 0, 0]
+    loader.close()
+
+
+def test_loader_host_sharding_partitions():
+    """Two fake hosts see disjoint, covering index sets (DistributedSampler
+    semantics without padding duplicates)."""
+    tf = make_transform(num_frames=4, training=False, crop_size=32,
+                        min_short_side_scale=32)
+    src = SyntheticClipSource(tf, num_videos=16, num_classes=4)
+    l0 = ClipLoader(src, global_batch_size=8, process_index=0, process_count=2,
+                    num_workers=1, shuffle=True, seed=3)
+    l1 = ClipLoader(src, global_batch_size=8, process_index=1, process_count=2,
+                    num_workers=1, shuffle=True, seed=3)
+    i0 = l0._epoch_indices(0)
+    i1 = l1._epoch_indices(0)
+    assert len(i0) == len(i1) == 8
+    assert set(i0) | set(i1) == set(range(16))
+    assert set(i0).isdisjoint(i1)
+    # local batch = global/process_count
+    b0 = next(iter(l0.epoch(0)))
+    assert b0["video"].shape[0] == 4
+    l0.close(); l1.close()
+
+
+def test_loader_shuffle_changes_across_epochs():
+    loader = _loader(n_videos=16, bs=8, shuffle=True)
+    i0 = loader._epoch_indices(0)
+    i1 = loader._epoch_indices(1)
+    assert not np.array_equal(i0, i1)
+    assert sorted(i0) == sorted(i1) == list(range(16))
+    loader.close()
+
+
+def test_loader_mid_epoch_resume():
+    """Restore {epoch, position} -> identical remaining batches (O(1)
+    fast-forward replacing the reference's skip-loop, run.py:246-249)."""
+    loader = _loader(n_videos=32, bs=8, shuffle=True)
+    it = loader.epoch(0)
+    first = next(it)
+    saved = loader.state.to_dict()
+    rest_a = [b["label"] for b in it]
+
+    loader2 = _loader(n_videos=32, bs=8, shuffle=True)
+    loader2.state = LoaderState.from_dict(saved)
+    rest_b = [b["label"] for b in loader2.epoch(0)]
+    assert len(rest_a) == len(rest_b) == 3
+    for a, b in zip(rest_a, rest_b):
+        np.testing.assert_array_equal(a, b)
+    # epoch rolls over after exhaustion
+    assert loader2.state.epoch == 1 and loader2.state.position == 0
+    loader.close(); loader2.close()

@@ -1,0 +1,115 @@
+"""End-to-end training-app tests on the 8-device CPU mesh (SURVEY §4.5's
+"2-step train + eval + checkpoint + resume" contract, synthetic data)."""
+
+import os
+
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.config import TrainConfig, parse_cli
+from pytorchvideo_accelerate_tpu.trainer.loop import Trainer, _parse_checkpointing_steps
+
+
+def _cfg(tmp_path, **over):
+    cfg = parse_cli([
+        "--data.synthetic", "--data.synthetic_num_videos", "16",
+        "--data.num_frames", "4", "--data.crop_size", "32",
+        "--data.min_short_side_scale", "32", "--data.max_short_side_scale", "40",
+        "--data.batch_size", "1",  # per-shard; global = 8 on the 8-dev mesh
+        "--data.num_workers", "2",
+        "--model.name", "slow_r50", "--model.num_classes", "4",
+        "--optim.num_epochs", "2", "--optim.lr", "0.01",
+        "--optim.weight_decay", "0", "--model.dropout_rate", "0",
+        "--checkpoint.output_dir", str(tmp_path),
+        "--checkpoint.async_checkpoint", "false",
+        "--tracking.logging_dir", str(tmp_path / "logs"),
+    ])
+    # tiny model stand-in: patch depths via monkey config is overkill; the
+    # registry builds full slow_r50 (slow on CPU), so shrink via the test
+    # model name override below where needed.
+    for k, v in over.items():
+        parts = k.split(".")
+        obj = cfg
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        setattr(obj, parts[-1], v)
+    return cfg
+
+
+@pytest.fixture(autouse=True)
+def _tiny_slow_r50(monkeypatch):
+    """Swap the slow_r50 registry entry for a tiny-depth variant: e2e tests
+    exercise the full machinery, not CPU conv throughput."""
+    from pytorchvideo_accelerate_tpu import models
+    from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
+
+    def tiny(cfg, dtype):
+        return SlowR50(num_classes=cfg.num_classes, depths=(1, 1, 1, 1),
+                       stem_features=8, dropout_rate=cfg.dropout_rate,
+                       dtype=dtype)
+
+    monkeypatch.setitem(models._REGISTRY, "slow_r50", tiny)
+
+
+def test_parse_checkpointing_steps():
+    assert _parse_checkpointing_steps("") is None
+    assert _parse_checkpointing_steps("epoch") == "epoch"
+    assert _parse_checkpointing_steps("120") == 120
+    with pytest.raises(ValueError):
+        _parse_checkpointing_steps("sometimes")
+
+
+def test_fit_trains_and_reports(tmp_path):
+    cfg = _cfg(tmp_path)
+    result = Trainer(cfg).fit()
+    # 16 videos / global batch 8 = 2 steps/epoch x 2 epochs
+    assert result["steps"] == 4
+    assert 0.0 <= result["val_accuracy"] <= 1.0
+    assert np.isfinite(result["train_loss"])
+
+
+def test_fit_with_tracking_and_epoch_checkpoints(tmp_path):
+    cfg = _cfg(tmp_path, **{
+        "tracking.with_tracking": True, "tracking.trackers": "jsonl",
+        "tracking.log_every": 1,
+        "checkpoint.checkpointing_steps": "epoch",
+    })
+    Trainer(cfg).fit()
+    # jsonl tracker wrote scalars
+    logs = list((tmp_path / "logs").glob("*.jsonl"))
+    assert logs, "tracker wrote nothing"
+    text = logs[0].read_text()
+    assert "train_loss_step" in text and "accuracy" in text
+    # epoch + final checkpoints exist
+    ckpts = os.listdir(tmp_path / "checkpoints")
+    assert len(ckpts) >= 2
+
+
+def test_resume_continues_training(tmp_path):
+    cfg = _cfg(tmp_path, **{"checkpoint.checkpointing_steps": "epoch",
+                            "optim.num_epochs": 1})
+    r1 = Trainer(cfg).fit()
+    assert r1["steps"] == 2
+
+    cfg2 = _cfg(tmp_path, **{"checkpoint.checkpointing_steps": "epoch",
+                             "optim.num_epochs": 2,
+                             "checkpoint.resume_from_checkpoint": "auto"})
+    r2 = Trainer(cfg2).fit()
+    # resumed at step 2 (epoch 1), trained one more epoch
+    assert r2["steps"] == 4
+
+
+def test_limit_batches(tmp_path):
+    cfg = _cfg(tmp_path, **{"data.limit_train_batches": 1,
+                            "data.limit_val_batches": 1,
+                            "optim.num_epochs": 1})
+    r = Trainer(cfg).fit()
+    assert r["steps"] == 1
+
+
+def test_grad_accum_end_to_end(tmp_path):
+    cfg = _cfg(tmp_path, **{"optim.gradient_accumulation_steps": 2,
+                            "optim.num_epochs": 1})
+    r = Trainer(cfg).fit()
+    # 16 videos / (global 8 x accum 2) = 1 optimizer step
+    assert r["steps"] == 1
