@@ -63,7 +63,8 @@ class DataConfig:
 class ModelConfig:
     """Model selection + finetuning controls (reference `run.py:105-118`)."""
 
-    name: str = "slow_r50"  # slow_r50|slowfast_r50|slowfast_r101|x3d_s|mvit_b|videomae
+    name: str = "slow_r50"  # models.available_models(): slow_r50|slowfast_r50|
+    # slowfast_r101|x3d_xs|x3d_s|x3d_m|mvit_b|videomae_b|videomae_b_pretrain
     num_classes: int = 0  # 0 = infer from dataset labels (replaces run.py:185)
     pretrained: bool = False
     pretrained_path: str = ""  # converted torch-hub weights (models/convert.py)
@@ -71,7 +72,8 @@ class ModelConfig:
     slowfast_alpha: int = 4
     dropout_rate: float = 0.5
     # Transformer-family extras (MViT/VideoMAE); ignored by CNNs.
-    attention: str = "dense"  # dense|ring|ulysses (parallel/ring_attention.py)
+    attention: str = "dense"  # dense (XLA-fused) | pallas (ops/pallas_attention)
+    # | ring | ulysses (context-parallel, parallel/ring_attention.py + ulysses.py)
     mask_ratio: float = 0.9  # VideoMAE pretrain tube-mask ratio
 
 

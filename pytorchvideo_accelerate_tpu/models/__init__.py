@@ -8,6 +8,8 @@ weights come from the torch->Flax converter (models/convert.py) via
 
 from __future__ import annotations
 
+import inspect
+
 from typing import Callable, Dict
 
 import jax.numpy as jnp
@@ -18,6 +20,10 @@ from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
 from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
 from pytorchvideo_accelerate_tpu.models.x3d import X3D
 from pytorchvideo_accelerate_tpu.models.mvit import MViT
+from pytorchvideo_accelerate_tpu.models.videomae import (  # noqa: F401
+    VideoMAEClassifier,
+    VideoMAEForPretraining,
+)
 
 _REGISTRY: Dict[str, Callable] = {}
 
@@ -31,14 +37,14 @@ def register_model(name: str):
 
 
 @register_model("slow_r50")
-def _slow_r50(cfg: ModelConfig, dtype):
+def _slow_r50(cfg: ModelConfig, dtype, mesh=None):
     return SlowR50(
         num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate, dtype=dtype
     )
 
 
 @register_model("slowfast_r50")
-def _slowfast_r50(cfg: ModelConfig, dtype):
+def _slowfast_r50(cfg: ModelConfig, dtype, mesh=None):
     return SlowFast(
         num_classes=cfg.num_classes,
         alpha=cfg.slowfast_alpha,
@@ -48,7 +54,7 @@ def _slowfast_r50(cfg: ModelConfig, dtype):
 
 
 @register_model("slowfast_r101")
-def _slowfast_r101(cfg: ModelConfig, dtype):
+def _slowfast_r101(cfg: ModelConfig, dtype, mesh=None):
     return SlowFast(
         num_classes=cfg.num_classes,
         depths=(3, 4, 23, 3),
@@ -59,27 +65,27 @@ def _slowfast_r101(cfg: ModelConfig, dtype):
 
 
 @register_model("x3d_xs")
-def _x3d_xs(cfg: ModelConfig, dtype):
+def _x3d_xs(cfg: ModelConfig, dtype, mesh=None):
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
                dtype=dtype)
 
 
 @register_model("x3d_s")
-def _x3d_s(cfg: ModelConfig, dtype):
+def _x3d_s(cfg: ModelConfig, dtype, mesh=None):
     # XS and S share the trunk; they differ in sampling (13f@160px for S)
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
                dtype=dtype)
 
 
 @register_model("x3d_m")
-def _x3d_m(cfg: ModelConfig, dtype):
+def _x3d_m(cfg: ModelConfig, dtype, mesh=None):
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
                dtype=dtype)
 
 
 @register_model("mvit_b")
-def _mvit_b(cfg: ModelConfig, dtype):
-    if cfg.attention not in ("dense", "pallas", "ring"):
+def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
+    if cfg.attention not in ("dense", "pallas", "ring", "ulysses"):
         raise NotImplementedError(
             f"attention backend {cfg.attention!r} not available for mvit_b"
         )
@@ -87,7 +93,31 @@ def _mvit_b(cfg: ModelConfig, dtype):
         num_classes=cfg.num_classes,
         dropout_rate=cfg.dropout_rate,
         attention_backend=cfg.attention,
-        context_axis="context" if cfg.attention == "ring" else None,
+        context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        dtype=dtype,
+    )
+
+
+@register_model("videomae_b")
+def _videomae_b(cfg: ModelConfig, dtype, mesh=None):
+    """Fine-tune path of BASELINE config 5 (SSv2/K400 classification)."""
+    return VideoMAEClassifier(
+        num_classes=cfg.num_classes,
+        dropout_rate=cfg.dropout_rate,
+        attention_backend=cfg.attention,
+        context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        dtype=dtype,
+    )
+
+
+@register_model("videomae_b_pretrain")
+def _videomae_b_pretrain(cfg: ModelConfig, dtype, mesh=None):
+    """MAE pretraining path of BASELINE config 5 (self-supervised; the
+    reference stack has no SSL path — run.py is supervised-only)."""
+    return VideoMAEForPretraining(
+        mask_ratio=cfg.mask_ratio,
+        attention_backend=cfg.attention,
+        context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
         dtype=dtype,
     )
 
@@ -96,18 +126,37 @@ def available_models():
     return sorted(_REGISTRY)
 
 
-def create_model(cfg: ModelConfig, mixed_precision: str = "bf16"):
+def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None):
     """Build the Flax module for `cfg.name`.
 
     `mixed_precision="bf16"` sets compute dtype bf16 with fp32 params — the
     TPU-native replacement for the reference's fp16 AMP path. `"fp16"` is
     accepted and mapped to bf16 (reference launch-script compat: fp16 has no
     advantage on TPU and needs loss scaling).
+
+    `mesh`: required for the context-parallel attention backends
+    ("ring"/"ulysses") — the attention router opens a `shard_map` region over
+    the mesh's ``context`` axis, so the model stays usable from ordinary
+    auto-sharded (jit) training code.
     """
     if cfg.name not in _REGISTRY:
         raise ValueError(f"unknown model {cfg.name!r}; available: {available_models()}")
+    if cfg.attention in ("ring", "ulysses") and mesh is None:
+        raise ValueError(
+            f"attention={cfg.attention!r} needs the device mesh: "
+            "create_model(cfg, mixed_precision, mesh=mesh)"
+        )
     dtype = jnp.bfloat16 if mixed_precision in ("bf16", "fp16") else jnp.float32
-    return _REGISTRY[cfg.name](cfg, dtype)
+    builder = _REGISTRY[cfg.name]
+    # user-registered builders may use the original (cfg, dtype) signature;
+    # pass the mesh only to builders that declare a parameter named "mesh"
+    try:
+        takes_mesh = "mesh" in inspect.signature(builder).parameters
+    except (TypeError, ValueError):
+        takes_mesh = False
+    if takes_mesh:
+        return builder(cfg, dtype, mesh=mesh)
+    return builder(cfg, dtype)
 
 
 def model_input_spec(cfg: ModelConfig, data_cfg) -> dict:

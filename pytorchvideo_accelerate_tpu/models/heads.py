@@ -39,7 +39,10 @@ class ResBasicHead(nn.Module):
         if self.pool and x.ndim == 5:
             x = global_avg_pool(x)
         x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
-        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="proj")(
-            x.astype(jnp.float32)
-        )
+        # normal(0.01)/zero-bias projection init (pytorchvideo's head fc
+        # convention) keeps initial logits small -> initial CE ~ ln(classes)
+        x = nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="proj",
+            kernel_init=nn.initializers.normal(0.01),
+        )(x.astype(jnp.float32))
         return x

@@ -78,6 +78,7 @@ class MultiScaleAttention(nn.Module):
     kv_stride: Tuple[int, int, int] = (1, 1, 1)
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
+    context_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -99,6 +100,7 @@ class MultiScaleAttention(nn.Module):
         attn = dot_product_attention(
             to_tokens(q), to_tokens(k), to_tokens(v),
             backend=self.attention_backend, axis_name=self.context_axis,
+            mesh=self.context_mesh,
         )
         attn = attn.reshape(B, tq, hq, wq, self.dim_out)
         attn = attn + q  # residual Q-pooling (paper §3.1, improved MViTv2 form)
@@ -114,6 +116,7 @@ class MViTBlock(nn.Module):
     drop_path: float = 0.0
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
+    context_mesh: Optional[Any] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -124,7 +127,8 @@ class MViTBlock(nn.Module):
             dim_out=self.dim_out, num_heads=self.num_heads,
             q_stride=self.q_stride, kv_stride=self.kv_stride,
             attention_backend=self.attention_backend,
-            context_axis=self.context_axis, dtype=self.dtype, name="attn",
+            context_axis=self.context_axis, context_mesh=self.context_mesh,
+            dtype=self.dtype, name="attn",
         )(y)
         # skip path: max-pool + linear when the grid/dim changes
         if self.q_stride != (1, 1, 1):
@@ -165,6 +169,7 @@ class MViT(nn.Module):
     dropout_rate: float = 0.5
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
+    context_mesh: Optional[Any] = None
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -198,8 +203,8 @@ class MViT(nn.Module):
                 dim_out=dim, num_heads=heads, q_stride=q_stride,
                 kv_stride=tuple(kv_stride), mlp_ratio=self.mlp_ratio,
                 drop_path=dpr[i], attention_backend=self.attention_backend,
-                context_axis=self.context_axis, dtype=self.dtype,
-                name=f"block{i}",
+                context_axis=self.context_axis, context_mesh=self.context_mesh,
+                dtype=self.dtype, name=f"block{i}",
             )(x, train)
 
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)

@@ -1,0 +1,281 @@
+"""VideoMAE: masked-autoencoder pretraining + fine-tuning for video ViTs.
+
+BASELINE config 5 ("VideoMAE pretrain + SSv2 fine-tune"). Architecture per
+Tong et al. 2022 (arXiv:2203.12602), ViT-B constants:
+
+- cube embedding: 3D conv, kernel = stride = (2, 16, 16), 768 dims ->
+  (T/2)·(H/16)·(W/16) tokens (1568 for 16-frame 224² clips);
+- tube masking: ONE random spatial mask shared by every temporal index
+  (ratio 0.9) — defeats temporal-redundancy leakage, the paper's key trick;
+- encoder: ViT-B (12 blocks, 12 heads) over *visible* tokens only (~10%,
+  so pretraining compute scales with 1-ρ);
+- decoder: narrow ViT (384 dims, 4 blocks) over all tokens (encoder output
+  + learned mask token, each with positional embedding), predicting the
+  normalized pixel cube of every masked patch;
+- loss: MSE on per-patch-normalized pixels, masked patches only.
+
+TPU-first design notes:
+- everything is static-shaped for XLA: the visible count n_vis =
+  round(N·(1-ρ)) is a Python constant; the random tube mask is realized as
+  an `argsort(uniform)` permutation and token selection is `take_along_axis`
+  (gather) — no boolean dynamic shapes anywhere;
+- attention goes through `ops.attention.dot_product_attention`, so the
+  backend (XLA-fused / pallas flash / ring / ulysses context-parallel) is a
+  config choice; with ring attention the 90%-masked pretrain still shards
+  its 1568-token decode pass over the ``context`` axis for long clips;
+- sin-cos positional embeddings are computed once at trace time (no
+  params), matching the paper's fixed embeddings.
+
+Reference parity: the reference repo has no SSL path at all (run.py is
+supervised fine-tuning only); VideoMAE is part of the driver's BASELINE.json
+capability set, built here natively.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
+
+Dtype = Any
+
+
+def sincos_pos_embed(n_pos: int, dim: int) -> np.ndarray:
+    """Fixed 1-D sin-cos table (n_pos, dim), float32."""
+    pos = np.arange(n_pos, dtype=np.float64)[:, None]
+    omega = 1.0 / (10000 ** (np.arange(dim // 2, dtype=np.float64) / (dim / 2)))
+    ang = pos * omega[None, :]
+    emb = np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+    return emb.astype(np.float32)
+
+
+class ViTBlock(nn.Module):
+    """Standard pre-LN transformer block (attention backend routable)."""
+
+    dim: int
+    num_heads: int
+    mlp_ratio: float = 4.0
+    attention_backend: str = "dense"
+    context_mesh: Optional[Any] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        B, N, _ = x.shape
+        head_dim = self.dim // self.num_heads
+        y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
+        qkv = nn.Dense(3 * self.dim, dtype=self.dtype, name="qkv")(y)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (B, N, self.num_heads, head_dim)
+        attn = dot_product_attention(
+            q.reshape(shape), k.reshape(shape), v.reshape(shape),
+            backend=self.attention_backend, mesh=self.context_mesh,
+        ).reshape(B, N, self.dim)
+        x = x + nn.Dense(self.dim, dtype=self.dtype, name="proj")(attn)
+
+        y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
+        y = nn.Dense(int(self.dim * self.mlp_ratio), dtype=self.dtype,
+                     name="mlp_fc1")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_fc2")(y)
+        return x + y
+
+
+class CubeEmbed(nn.Module):
+    """(B, T, H, W, 3) -> (B, T/t · H/p · W/p, dim) token grid, plus dims."""
+
+    dim: int = 768
+    tubelet: Tuple[int, int, int] = (2, 16, 16)
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(
+            self.dim, kernel_size=self.tubelet, strides=self.tubelet,
+            padding="VALID", dtype=self.dtype, name="proj",
+        )(x)
+        B, t, h, w, _ = x.shape
+        return x.reshape(B, t * h * w, self.dim), (t, h, w)
+
+
+class VideoMAEEncoder(nn.Module):
+    """ViT encoder over (a subset of) cube tokens."""
+
+    dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    tubelet: Tuple[int, int, int] = (2, 16, 16)
+    attention_backend: str = "dense"
+    context_mesh: Optional[Any] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, keep_idx: Optional[jnp.ndarray] = None):
+        """x: (B, T, H, W, 3). `keep_idx`: (B, n_vis) token indices to
+        encode (pretraining); None encodes all tokens (fine-tuning)."""
+        tokens, (t, h, w) = CubeEmbed(self.dim, self.tubelet, self.dtype,
+                                      name="patch_embed")(x)
+        n = tokens.shape[1]
+        pos = jnp.asarray(sincos_pos_embed(n, self.dim))[None]
+        tokens = tokens + pos.astype(tokens.dtype)
+        if keep_idx is not None:
+            tokens = jnp.take_along_axis(tokens, keep_idx[..., None], axis=1)
+        for i in range(self.depth):
+            tokens = ViTBlock(
+                dim=self.dim, num_heads=self.num_heads,
+                attention_backend=self.attention_backend,
+                context_mesh=self.context_mesh, dtype=self.dtype,
+                name=f"block{i}",
+            )(tokens)
+        tokens = nn.LayerNorm(dtype=self.dtype, name="norm")(tokens)
+        return tokens, (t, h, w)
+
+
+def tube_mask_indices(key, batch: int, t: int, h: int, w: int,
+                      mask_ratio: float):
+    """Static-shape tube mask: one spatial mask shared across time.
+
+    Returns (keep_idx, masked_idx): (B, n_vis) and (B, n_masked) indices
+    into the flattened (t·h·w) token axis, n_vis = t · round(h·w·(1-ρ)).
+    """
+    spatial = h * w
+    n_vis_sp = max(1, int(round(spatial * (1.0 - mask_ratio))))
+    noise = jax.random.uniform(key, (batch, spatial))
+    order = jnp.argsort(noise, axis=1)                  # random spatial perm
+    keep_sp = order[:, :n_vis_sp]                       # (B, n_vis_sp)
+    mask_sp = order[:, n_vis_sp:]
+    toff = (jnp.arange(t) * spatial)[None, :, None]     # (1, t, 1)
+
+    def tube(sp):  # (B, s) spatial -> (B, t*s) spatio-temporal, time-major
+        return (sp[:, None, :] + toff).reshape(batch, -1)
+
+    return tube(keep_sp), tube(mask_sp)
+
+
+def patchify(x, tubelet: Tuple[int, int, int]):
+    """(B, T, H, W, C) -> (B, n_tokens, prod(tubelet)·C) pixel cubes, token
+    order matching CubeEmbed's (t-major, then h, then w)."""
+    B, T, H, W, C = x.shape
+    tt, p, _ = tubelet
+    t, h, w = T // tt, H // p, W // p
+    x = x.reshape(B, t, tt, h, p, w, p, C)
+    x = x.transpose(0, 1, 3, 5, 2, 4, 6, 7)             # B t h w tt p p C
+    return x.reshape(B, t * h * w, tt * p * p * C)
+
+
+class VideoMAEForPretraining(nn.Module):
+    """Masked-autoencoder pretraining model.
+
+    `__call__(x, train)` needs an rng stream named "mask"; returns a dict
+    with the scalar "loss" plus predictions/targets for inspection.
+    """
+
+    dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    decoder_dim: int = 384
+    decoder_depth: int = 4
+    decoder_heads: int = 6
+    tubelet: Tuple[int, int, int] = (2, 16, 16)
+    mask_ratio: float = 0.9
+    norm_pix: bool = True
+    attention_backend: str = "dense"
+    context_mesh: Optional[Any] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        B, T, H, W, _ = x.shape
+        tt, p, _ = self.tubelet
+        t, h, w = T // tt, H // p, W // p
+        n = t * h * w
+
+        keep_idx, masked_idx = tube_mask_indices(
+            self.make_rng("mask"), B, t, h, w, self.mask_ratio
+        )
+
+        enc, _ = VideoMAEEncoder(
+            dim=self.dim, depth=self.depth, num_heads=self.num_heads,
+            tubelet=self.tubelet, attention_backend=self.attention_backend,
+            context_mesh=self.context_mesh, dtype=self.dtype, name="encoder",
+        )(x, keep_idx)                                   # (B, n_vis, dim)
+
+        # decoder: project, scatter visible tokens + mask tokens, add pos
+        dec_in = nn.Dense(self.decoder_dim, dtype=self.dtype,
+                          name="enc_to_dec")(enc)
+        mask_token = self.param(
+            "mask_token", nn.initializers.normal(0.02), (1, 1, self.decoder_dim),
+            jnp.float32,
+        )
+        pos = jnp.asarray(sincos_pos_embed(n, self.decoder_dim))[None]
+        vis_pos = jnp.take_along_axis(
+            jnp.broadcast_to(pos, (B, n, self.decoder_dim)),
+            keep_idx[..., None], axis=1)
+        msk_pos = jnp.take_along_axis(
+            jnp.broadcast_to(pos, (B, n, self.decoder_dim)),
+            masked_idx[..., None], axis=1)
+        dec_tokens = jnp.concatenate(
+            [dec_in + vis_pos.astype(dec_in.dtype),
+             mask_token.astype(dec_in.dtype) + msk_pos.astype(dec_in.dtype)],
+            axis=1,
+        )                                               # (B, n, dec_dim)
+        for i in range(self.decoder_depth):
+            dec_tokens = ViTBlock(
+                dim=self.decoder_dim, num_heads=self.decoder_heads,
+                attention_backend=self.attention_backend,
+                context_mesh=self.context_mesh, dtype=self.dtype,
+                name=f"dec_block{i}",
+            )(dec_tokens)
+        dec_tokens = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(dec_tokens)
+        pred = nn.Dense(tt * p * p * 3, dtype=jnp.float32, name="dec_pred")(
+            dec_tokens[:, enc.shape[1]:].astype(jnp.float32)
+        )                                               # (B, n_masked, cube)
+
+        target = patchify(x.astype(jnp.float32), self.tubelet)
+        target = jnp.take_along_axis(target, masked_idx[..., None], axis=1)
+        if self.norm_pix:
+            mu = target.mean(-1, keepdims=True)
+            var = target.var(-1, keepdims=True)
+            target = (target - mu) / jnp.sqrt(var + 1e-6)
+
+        loss = jnp.mean((pred - target) ** 2)
+        return {"loss": loss, "pred": pred, "target": target,
+                "masked_idx": masked_idx}
+
+
+class VideoMAEClassifier(nn.Module):
+    """Fine-tuning model: full-token encoder + mean-pool + linear head
+    (the SSv2/K400 fine-tune path of BASELINE config 5)."""
+
+    num_classes: int
+    dim: int = 768
+    depth: int = 12
+    num_heads: int = 12
+    tubelet: Tuple[int, int, int] = (2, 16, 16)
+    dropout_rate: float = 0.0
+    attention_backend: str = "dense"
+    context_mesh: Optional[Any] = None
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        tokens, _ = VideoMAEEncoder(
+            dim=self.dim, depth=self.depth, num_heads=self.num_heads,
+            tubelet=self.tubelet, attention_backend=self.attention_backend,
+            context_mesh=self.context_mesh, dtype=self.dtype, name="encoder",
+        )(x)
+        feat = tokens.mean(axis=1)
+        feat = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(feat)
+        return nn.Dense(
+            self.num_classes, dtype=jnp.float32, name="head",
+            kernel_init=nn.initializers.normal(0.01),
+        )(feat.astype(jnp.float32))
+
+    @staticmethod
+    def backbone_param_filter(path: Tuple[str, ...]) -> bool:
+        return path[0] != "head"

@@ -9,4 +9,8 @@ is a pure `TrainState -> TrainState` compiled step driven by a thin host loop.
 
 from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState  # noqa: F401
 from pytorchvideo_accelerate_tpu.trainer.optim import build_optimizer, build_lr_schedule  # noqa: F401
-from pytorchvideo_accelerate_tpu.trainer.steps import make_train_step, make_eval_step  # noqa: F401
+from pytorchvideo_accelerate_tpu.trainer.steps import (  # noqa: F401
+    make_eval_step,
+    make_pretrain_step,
+    make_train_step,
+)

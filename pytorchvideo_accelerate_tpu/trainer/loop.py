@@ -39,7 +39,12 @@ from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
 )
 from pytorchvideo_accelerate_tpu.trainer.metrics import MeanLoss, SumMetrics
 from pytorchvideo_accelerate_tpu.trainer.optim import build_lr_schedule, build_optimizer
-from pytorchvideo_accelerate_tpu.trainer.steps import make_eval_step, make_train_step
+from pytorchvideo_accelerate_tpu.trainer.steps import (
+    make_eval_step,
+    make_pretrain_eval_step,
+    make_pretrain_step,
+    make_train_step,
+)
 from pytorchvideo_accelerate_tpu.trainer.tracking import TrackerHub
 from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
@@ -67,6 +72,9 @@ class Trainer:
 
     def __init__(self, cfg: TrainConfig):
         self.cfg = cfg
+        # self-supervised objective (VideoMAE): no labels, model computes its
+        # own loss; the supervised path is the reference's only mode
+        self.is_pretraining = cfg.model.name.endswith("_pretrain")
         self.checkpointing_steps = _parse_checkpointing_steps(
             cfg.checkpoint.checkpointing_steps
         )
@@ -177,7 +185,7 @@ class Trainer:
         cfg = self.cfg
         if not cfg.model.num_classes:
             cfg.model.num_classes = self.num_classes
-        self.model = create_model(cfg.model, cfg.mixed_precision)
+        self.model = create_model(cfg.model, cfg.mixed_precision, mesh=self.mesh)
 
         spec = model_input_spec(cfg.model, cfg.data)
         import jax.numpy as jnp
@@ -213,15 +221,23 @@ class Trainer:
                 )
             )
 
-        self.train_step = make_train_step(
-            self.model, self.tx, self.mesh,
-            accum_steps=cfg.optim.gradient_accumulation_steps,
-            label_smoothing=cfg.optim.label_smoothing,
-            lr_schedule=self.lr_schedule,
-        )
-        self.eval_step = make_eval_step(
-            self.model, self.mesh, label_smoothing=cfg.optim.label_smoothing
-        )
+        if self.is_pretraining:
+            self.train_step = make_pretrain_step(
+                self.model, self.tx, self.mesh,
+                accum_steps=cfg.optim.gradient_accumulation_steps,
+                lr_schedule=self.lr_schedule,
+            )
+            self.eval_step = make_pretrain_eval_step(self.model, self.mesh)
+        else:
+            self.train_step = make_train_step(
+                self.model, self.tx, self.mesh,
+                accum_steps=cfg.optim.gradient_accumulation_steps,
+                label_smoothing=cfg.optim.label_smoothing,
+                lr_schedule=self.lr_schedule,
+            )
+            self.eval_step = make_eval_step(
+                self.model, self.mesh, label_smoothing=cfg.optim.label_smoothing
+            )
 
     # --- resume -----------------------------------------------------------
 
@@ -329,8 +345,12 @@ class Trainer:
                     break
             last_val_acc = val.accuracy()
             last_train_loss = epoch_loss.mean()
+            val_str = (
+                f"val_recon_loss={val.mean_loss():.4f}" if self.is_pretraining
+                else f"val_acc={last_val_acc:.4f}"
+            )
             main_print(
-                f"epoch {epoch}: val_acc={last_val_acc:.4f} "
+                f"epoch {epoch}: {val_str} "
                 f"train_loss={last_train_loss:.4f} "
                 f"({time.time() - t_epoch:.1f}s)"
             )

@@ -142,6 +142,76 @@ def make_train_step(
     return jax.jit(step, donate_argnums=0)
 
 
+def make_pretrain_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    accum_steps: int = 1,
+    lr_schedule: Optional[Callable] = None,
+) -> Callable:
+    """Build the VideoMAE self-supervised step: `step(state, batch, key) ->
+    (state, metrics)`. No labels, no batch_stats (pure-LN ViT); the model
+    returns its own reconstruction loss. The rng key feeds both the tube
+    mask and dropout streams; like `make_train_step`, gradient accumulation
+    is an in-graph scan syncing once per effective step."""
+
+    def forward_loss(params, batch, key):
+        kmask, kdrop = jax.random.split(key)
+        out = model.apply(
+            {"params": params}, batch["video"], train=True,
+            rngs={"mask": kmask, "dropout": kdrop},
+        )
+        return out["loss"]
+
+    grad_fn = jax.value_and_grad(forward_loss)
+
+    def step(state: TrainState, batch: dict, key) -> tuple:
+        if accum_steps == 1:
+            batch = _constrain_batch(batch, mesh, leading_micro=False)
+            loss, grads = grad_fn(state.params, batch, key)
+        else:
+            batch = _constrain_batch(batch, mesh, leading_micro=True)
+
+            def micro(carry, mb):
+                grads_acc, i = carry
+                loss_i, g = grad_fn(state.params, mb, jax.random.fold_in(key, i))
+                return (jax.tree.map(jnp.add, grads_acc, g), i + 1), loss_i
+
+            zeros = jax.tree.map(jnp.zeros_like, state.params)
+            (grads, _), losses = lax.scan(micro, (zeros, 0), batch)
+            grads = jax.tree.map(lambda g: g / accum_steps, grads)
+            loss = jnp.mean(losses)
+
+        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
+        new_params = optax.apply_updates(state.params, updates)
+        new_state = state.replace(
+            step=state.step + 1, params=new_params, opt_state=new_opt_state
+        )
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if lr_schedule is not None:
+            metrics["lr"] = lr_schedule(state.step)
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=0)
+
+
+def make_pretrain_eval_step(model, mesh) -> Callable:
+    """Eval for MAE pretraining: reconstruction loss on held-out clips with
+    a deterministic mask (same SumMetrics contract; accuracy reads 0)."""
+
+    def eval_step(state: TrainState, batch: dict) -> dict:
+        batch = _constrain_batch(batch, mesh, leading_micro=False)
+        out = model.apply(
+            {"params": state.params}, batch["video"], train=False,
+            rngs={"mask": jax.random.key(0)},
+        )
+        count = jnp.asarray(batch["video"].shape[0], jnp.float32)
+        return {"loss_sum": out["loss"] * count,
+                "correct": jnp.zeros((), jnp.float32), "count": count}
+
+    return jax.jit(eval_step)
+
+
 def make_eval_step(model, mesh, label_smoothing: float = 0.0) -> Callable:
     """Build `eval_step(state, batch) -> {loss_sum, correct, count}` —
     in-graph masked sums; the host just adds them across batches

@@ -1,0 +1,139 @@
+"""VideoMAE: tube masking, patchify golden behavior, pretrain + fine-tune."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.models.videomae import (
+    VideoMAEClassifier,
+    VideoMAEForPretraining,
+    patchify,
+    sincos_pos_embed,
+    tube_mask_indices,
+)
+
+TINY = dict(dim=32, depth=2, num_heads=2, decoder_dim=16, decoder_depth=1,
+            decoder_heads=2, tubelet=(2, 4, 4))
+
+
+def test_tube_mask_is_a_tube():
+    """Same spatial positions masked at every temporal index (the paper's
+    tube-masking invariant), shapes static."""
+    t, h, w = 3, 4, 4
+    keep, masked = tube_mask_indices(jax.random.key(0), 2, t, h, w, 0.75)
+    spatial = h * w
+    n_vis_sp = int(round(spatial * 0.25))
+    assert keep.shape == (2, t * n_vis_sp)
+    assert masked.shape == (2, t * (spatial - n_vis_sp))
+    for b in range(2):
+        ks = np.asarray(keep[b]) % spatial
+        per_t = ks.reshape(t, n_vis_sp)
+        for i in range(1, t):
+            np.testing.assert_array_equal(np.sort(per_t[0]), np.sort(per_t[i]))
+    # keep + masked partition the token axis exactly
+    allidx = np.sort(np.concatenate([np.asarray(keep[0]), np.asarray(masked[0])]))
+    np.testing.assert_array_equal(allidx, np.arange(t * spatial))
+
+
+def test_patchify_round_trip_values():
+    """Patchify ordering matches CubeEmbed's t-major token order."""
+    B, T, H, W = 1, 4, 8, 8
+    tub = (2, 4, 4)
+    x = jnp.arange(B * T * H * W * 3, dtype=jnp.float32).reshape(B, T, H, W, 3)
+    cubes = patchify(x, tub)
+    t, h, w = T // 2, H // 4, W // 4
+    assert cubes.shape == (B, t * h * w, 2 * 4 * 4 * 3)
+    # token 0 = temporal block 0, spatial block (0,0)
+    expect0 = np.asarray(x[0, 0:2, 0:4, 0:4, :]).reshape(-1)
+    np.testing.assert_array_equal(np.asarray(cubes[0, 0]), expect0)
+    # last token = last temporal block, bottom-right spatial block
+    expectN = np.asarray(x[0, 2:4, 4:8, 4:8, :]).reshape(-1)
+    np.testing.assert_array_equal(np.asarray(cubes[0, -1]), expectN)
+
+
+def test_sincos_embed_shape_and_range():
+    e = sincos_pos_embed(10, 8)
+    assert e.shape == (10, 8)
+    assert np.all(np.abs(e) <= 1.0 + 1e-6)
+
+
+def test_pretrain_forward_and_loss():
+    model = VideoMAEForPretraining(mask_ratio=0.75, **TINY)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 4, 16, 16, 3)),
+                    jnp.float32)
+    variables = model.init({"params": jax.random.key(0), "mask": jax.random.key(1)}, x)
+    out = model.apply(variables, x, rngs={"mask": jax.random.key(2)})
+    assert np.isfinite(float(out["loss"]))
+    n_tokens = (4 // 2) * (16 // 4) * (16 // 4)
+    assert out["pred"].shape[1] == out["masked_idx"].shape[1]
+    assert out["pred"].shape[1] < n_tokens  # only masked tokens predicted
+    assert out["pred"].shape[2] == 2 * 4 * 4 * 3
+
+
+def test_pretrain_step_loss_decreases():
+    from pytorchvideo_accelerate_tpu.config import MeshConfig, OptimConfig
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.trainer import (
+        TrainState, build_optimizer, make_pretrain_step,
+    )
+
+    mesh = make_mesh(MeshConfig(data=8), devices=jax.devices()[:8])
+    model = VideoMAEForPretraining(mask_ratio=0.75, **TINY)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((8, 4, 16, 16, 3)),
+                    jnp.float32)
+    variables = model.init({"params": jax.random.key(0), "mask": jax.random.key(1)}, x)
+    tx = build_optimizer(OptimConfig(lr=1e-3, optimizer="adamw"), total_steps=10)
+    state = TrainState.create(variables["params"], {}, tx)
+    step = make_pretrain_step(model, tx, mesh)
+    batch = {"video": x}
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+    assert losses[-1] < losses[0], losses
+    assert int(state.step) == 6
+
+
+def test_pretrain_grad_accum_matches_shapes():
+    from pytorchvideo_accelerate_tpu.config import MeshConfig, OptimConfig
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.trainer import (
+        TrainState, build_optimizer, make_pretrain_step,
+    )
+
+    mesh = make_mesh(MeshConfig(data=8), devices=jax.devices()[:8])
+    model = VideoMAEForPretraining(mask_ratio=0.75, **TINY)
+    x = jnp.asarray(np.random.default_rng(0).standard_normal((2, 8, 4, 16, 16, 3)),
+                    jnp.float32)  # (accum, B, ...)
+    variables = model.init({"params": jax.random.key(0), "mask": jax.random.key(1)},
+                           x[0])
+    tx = build_optimizer(OptimConfig(lr=1e-3), total_steps=10)
+    state = TrainState.create(variables["params"], {}, tx)
+    step = make_pretrain_step(model, tx, mesh, accum_steps=2)
+    state, metrics = step(state, {"video": x}, jax.random.key(0))
+    assert np.isfinite(float(metrics["loss"]))
+    assert int(state.step) == 1
+
+
+def test_classifier_forward():
+    model = VideoMAEClassifier(num_classes=7, dim=32, depth=2, num_heads=2,
+                               tubelet=(2, 4, 4))
+    x = jnp.zeros((2, 4, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x)
+    out = model.apply(variables, x)
+    assert out.shape == (2, 7)
+    assert np.isfinite(np.asarray(out)).all()
+    # backbone filter exposes the head for freeze-backbone fine-tuning
+    assert VideoMAEClassifier.backbone_param_filter(("encoder", "block0"))
+    assert not VideoMAEClassifier.backbone_param_filter(("head", "kernel"))
+
+
+def test_registry_builds_videomae():
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+
+    m = create_model(ModelConfig(name="videomae_b", num_classes=3), "bf16")
+    assert isinstance(m, VideoMAEClassifier)
+    p = create_model(ModelConfig(name="videomae_b_pretrain", mask_ratio=0.8), "bf16")
+    assert isinstance(p, VideoMAEForPretraining)
+    assert p.mask_ratio == 0.8
