@@ -1,0 +1,377 @@
+"""torch -> Flax weight conversion (SURVEY §2.3-N12).
+
+The reference fetches pretrained backbones from torch.hub at run time
+(run.py:107: `torch.hub.load(..., "slowfast_r50", pretrained=True)`;
+run.py:115: `"slow_r50"`). The TPU-native replacement is a one-time offline
+conversion: download the hub checkpoint once (any machine with network),
+convert it here to a flat `.npz` of Flax paths, and point
+`ModelConfig.pretrained_path` at the result — no network dependency in the
+training job, and the artifact is plain numpy (no torch needed on the TPU VM
+unless converting on the fly from a `.pt`).
+
+Layout rules (SURVEY §7 hard-part 3: "BN stats, conv layout transposes"):
+- conv3d weight: torch (O, I, kD, kH, kW)  -> flax NDHWC kernel (kD, kH, kW, I, O)
+- linear weight: torch (O, I)              -> flax (I, O)
+- BatchNorm weight/bias -> params .../norm/{scale,bias};
+  running_mean/running_var -> batch_stats .../norm/{mean,var}
+
+Name mapping targets pytorchvideo's `create_resnet` / `create_slowfast`
+module trees (the structure behind the hub names the reference loads):
+`blocks.0` stem, `blocks.1-4` stages of `res_blocks` (branch1 projection +
+branch2 conv_a/b/c bottleneck), `blocks.5` head `proj`; SlowFast wraps each
+level in `multipathway_blocks.{0,1}` (slow, fast) with lateral
+`multipathway_fusion.conv_fast_to_slow` after stem/res2/res3/res4.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+Path = Tuple[str, ...]
+
+_BRANCH2 = {"conv_a": "conv_a", "conv_b": "conv_b", "conv_c": "conv_c"}
+_NORM2 = {"norm_a": "conv_a", "norm_b": "conv_b", "norm_c": "conv_c"}
+_BN_PARAM = {"weight": "scale", "bias": "bias"}
+_BN_STAT = {"running_mean": "mean", "running_var": "var"}
+
+
+def _map_block_member(rest: str) -> Optional[Tuple[str, Path]]:
+    """Map the part of a torch key inside one res block / stem / fusion.
+
+    Returns (collection, path-suffix) where collection is "params" or
+    "batch_stats", or None for ignorable keys (num_batches_tracked)."""
+    parts = rest.split(".")
+    # stem / fusion level: conv.weight, norm.weight, ...
+    if parts[0] == "conv" and parts[1] == "weight":
+        return "params", ("conv", "kernel")
+    if parts[0] == "norm":
+        if parts[1] in _BN_PARAM:
+            return "params", ("norm", _BN_PARAM[parts[1]])
+        if parts[1] in _BN_STAT:
+            return "batch_stats", ("norm", _BN_STAT[parts[1]])
+        return None
+    # res block level
+    if parts[0] == "branch1_conv" and parts[1] == "weight":
+        return "params", ("branch1", "conv", "kernel")
+    if parts[0] == "branch1_norm":
+        if parts[1] in _BN_PARAM:
+            return "params", ("branch1", "norm", _BN_PARAM[parts[1]])
+        if parts[1] in _BN_STAT:
+            return "batch_stats", ("branch1", "norm", _BN_STAT[parts[1]])
+        return None
+    if parts[0] == "branch2":
+        sub = parts[1]
+        if sub in _BRANCH2 and parts[2] == "weight":
+            return "params", (_BRANCH2[sub], "conv", "kernel")
+        if sub in _NORM2:
+            if parts[2] in _BN_PARAM:
+                return "params", (_NORM2[sub], "norm", _BN_PARAM[parts[2]])
+            if parts[2] in _BN_STAT:
+                return "batch_stats", (_NORM2[sub], "norm", _BN_STAT[parts[2]])
+    return None
+
+
+def map_torch_key(key: str, model: str) -> Optional[Tuple[str, Path]]:
+    """torch state_dict key -> ("params"|"batch_stats", flax path) or None."""
+    if key.endswith("num_batches_tracked"):
+        return None
+    slowfast = model.startswith("slowfast")
+
+    m = re.match(r"blocks\.(\d+)\.(.*)", key)
+    if not m:
+        return None
+    idx, rest = int(m.group(1)), m.group(2)
+
+    # head (blocks.5): proj linear
+    pm = re.match(r"proj\.(weight|bias)", rest)
+    if pm:
+        return "params", ("head", "proj",
+                          "kernel" if pm.group(1) == "weight" else "bias")
+
+    if slowfast:
+        m2 = re.match(r"multipathway_blocks\.([01])\.(.*)", rest)
+        if m2:
+            pathway = "slow" if m2.group(1) == "0" else "fast"
+            inner = m2.group(2)
+            if idx == 0:  # stem
+                mapped = _map_block_member(inner)
+                if mapped is None:
+                    return None
+                coll, suffix = mapped
+                return coll, (f"{pathway}_stem",) + suffix
+            m3 = re.match(r"res_blocks\.(\d+)\.(.*)", inner)
+            if m3:
+                mapped = _map_block_member(m3.group(2))
+                if mapped is None:
+                    return None
+                coll, suffix = mapped
+                return coll, (f"{pathway}_res{idx + 1}", f"block{m3.group(1)}") + suffix
+            return None
+        m2 = re.match(r"multipathway_fusion\.(.*)", rest)
+        if m2:
+            inner = m2.group(1)
+            prefix = "fuse_stem" if idx == 0 else f"fuse_res{idx + 1}"
+            fm = re.match(r"conv_fast_to_slow\.weight", inner)
+            if fm:
+                return "params", (prefix, "conv_f2s", "conv", "kernel")
+            nm = re.match(r"norm\.(\w+)", inner)
+            if nm:
+                if nm.group(1) in _BN_PARAM:
+                    return "params", (prefix, "conv_f2s", "norm", _BN_PARAM[nm.group(1)])
+                if nm.group(1) in _BN_STAT:
+                    return "batch_stats", (prefix, "conv_f2s", "norm", _BN_STAT[nm.group(1)])
+            return None
+        return None
+
+    # single-pathway resnet (slow_r50 / x3d-style trees share the skeleton)
+    if idx == 0:
+        mapped = _map_block_member(rest)
+        if mapped is None:
+            return None
+        coll, suffix = mapped
+        return coll, ("stem",) + suffix
+    m3 = re.match(r"res_blocks\.(\d+)\.(.*)", rest)
+    if m3:
+        mapped = _map_block_member(m3.group(2))
+        if mapped is None:
+            return None
+        coll, suffix = mapped
+        return coll, (f"res{idx + 1}", f"block{m3.group(1)}") + suffix
+    return None
+
+
+def torch_key_for(collection: str, path: Path, model: str) -> Optional[str]:
+    """Inverse of `map_torch_key` — flax path -> torch key (used by tests as
+    an independent spec and by weight export)."""
+    slowfast = model.startswith("slowfast")
+    head_block = 6 if slowfast else 5
+    if path[0] == "head":
+        return f"blocks.{head_block}.proj." + ("weight" if path[-1] == "kernel" else "bias")
+
+    def member(suffix: Path, in_res_block: bool) -> Optional[str]:
+        if suffix[0] == "conv":
+            return "conv.weight"
+        if suffix[0] == "norm":
+            inv = {v: k for k, v in (_BN_PARAM if collection == "params"
+                                     else _BN_STAT).items()}
+            return f"norm.{inv[suffix[1]]}"
+        if suffix[0] == "branch1":
+            if suffix[1] == "conv":
+                return "branch1_conv.weight"
+            inv = {v: k for k, v in (_BN_PARAM if collection == "params"
+                                     else _BN_STAT).items()}
+            return f"branch1_norm.{inv[suffix[2]]}"
+        if suffix[0] in ("conv_a", "conv_b", "conv_c"):
+            letter = suffix[0][-1]
+            if suffix[1] == "conv":
+                return f"branch2.conv_{letter}.weight"
+            inv = {v: k for k, v in (_BN_PARAM if collection == "params"
+                                     else _BN_STAT).items()}
+            return f"branch2.norm_{letter}.{inv[suffix[2]]}"
+        return None
+
+    if slowfast:
+        m = re.match(r"(slow|fast)_(stem|res(\d))", path[0])
+        if m:
+            pw = 0 if m.group(1) == "slow" else 1
+            if m.group(2) == "stem":
+                inner = member(path[1:], False)
+                return inner and f"blocks.0.multipathway_blocks.{pw}.{inner}"
+            stage = int(m.group(3)) - 1
+            blk = path[1].replace("block", "")
+            inner = member(path[2:], True)
+            return inner and (
+                f"blocks.{stage}.multipathway_blocks.{pw}.res_blocks.{blk}.{inner}"
+            )
+        m = re.match(r"fuse_(stem|res(\d))", path[0])
+        if m:
+            idx = 0 if m.group(1) == "stem" else int(m.group(2)) - 1
+            if path[2] == "conv":
+                return f"blocks.{idx}.multipathway_fusion.conv_fast_to_slow.weight"
+            inv = {v: k for k, v in (_BN_PARAM if collection == "params"
+                                     else _BN_STAT).items()}
+            return f"blocks.{idx}.multipathway_fusion.norm.{inv[path[3]]}"
+        return None
+
+    if path[0] == "stem":
+        inner = member(path[1:], False)
+        return inner and f"blocks.0.{inner}"
+    m = re.match(r"res(\d)", path[0])
+    if m:
+        stage = int(m.group(1)) - 1
+        blk = path[1].replace("block", "")
+        inner = member(path[2:], True)
+        return inner and f"blocks.{stage}.res_blocks.{blk}.{inner}"
+    return None
+
+
+def convert_tensor(path: Path, arr: np.ndarray) -> np.ndarray:
+    """Apply the torch->flax layout transpose for one tensor."""
+    if path[-1] == "kernel":
+        if arr.ndim == 5:      # conv3d OIDHW -> DHWIO
+            return np.transpose(arr, (2, 3, 4, 1, 0))
+        if arr.ndim == 2:      # linear (O, I) -> (I, O)
+            return np.transpose(arr, (1, 0))
+    return arr
+
+
+def export_tensor(path: Path, arr: np.ndarray) -> np.ndarray:
+    """Inverse of `convert_tensor` (flax -> torch layout)."""
+    if path[-1] == "kernel":
+        if arr.ndim == 5:      # DHWIO -> OIDHW
+            return np.transpose(arr, (4, 3, 0, 1, 2))
+        if arr.ndim == 2:
+            return np.transpose(arr, (1, 0))
+    return arr
+
+
+def _set_path(tree: dict, path: Path, value) -> None:
+    node = tree
+    for p in path[:-1]:
+        node = node.setdefault(p, {})
+    node[path[-1]] = value
+
+
+def convert_state_dict(sd: Dict[str, np.ndarray], model: str) -> dict:
+    """torch state_dict -> {"params": pytree, "batch_stats": pytree}.
+
+    Unrecognized keys are collected under "skipped" for caller inspection
+    (hub checkpoints carry no extras for these models, but users' exports
+    might)."""
+    out: dict = {"params": {}, "batch_stats": {}, "skipped": []}
+    for key, value in sd.items():
+        arr = np.asarray(value)
+        mapped = map_torch_key(key, model)
+        if mapped is None:
+            if not key.endswith("num_batches_tracked"):
+                out["skipped"].append(key)
+            continue
+        coll, path = mapped
+        _set_path(out[coll], path, convert_tensor(path, arr))
+    return out
+
+
+# --- npz artifact I/O -------------------------------------------------------
+
+def _flatten(tree: dict, prefix: Path = ()) -> Dict[str, np.ndarray]:
+    flat = {}
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            flat.update(_flatten(v, prefix + (k,)))
+        else:
+            flat["/".join(prefix + (k,))] = np.asarray(v)
+    return flat
+
+
+def save_converted(tree: dict, path: str) -> None:
+    """Write {"params":..., "batch_stats":...} as a flat npz artifact."""
+    flat = {}
+    for coll in ("params", "batch_stats"):
+        flat.update(_flatten(tree.get(coll, {}), (coll,)))
+    np.savez(path, **flat)
+
+
+def load_converted(path: str) -> dict:
+    tree: dict = {"params": {}, "batch_stats": {}}
+    with np.load(path) as data:
+        for key in data.files:
+            parts = tuple(key.split("/"))
+            _set_path(tree[parts[0]], parts[1:], data[key])
+    return tree
+
+
+# --- entry point used by the Trainer ---------------------------------------
+
+def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
+    """Merge a converted checkpoint into freshly-initialized variables.
+
+    `variables`: {"params": pytree, "batch_stats": pytree} (target shapes).
+    Leaves whose path exists in the artifact with a matching shape are
+    replaced (cast to the target dtype); mismatches — most commonly the
+    classification head when `num_classes` differs from the pretrain
+    dataset (reference head-swap semantics, run.py:109,117) — keep the
+    fresh initialization. Accepts a converted `.npz` or a raw torch
+    `.pt/.pth` (converted on the fly; needs `model` and the torch package).
+    Returns (merged_variables, report) where report lists loaded/kept paths.
+    """
+    import jax.numpy as jnp
+
+    if path.endswith((".pt", ".pth", ".bin")):
+        import torch  # CPU wheel, conversion only (SURVEY §7 env notes)
+
+        sd = torch.load(path, map_location="cpu", weights_only=True)
+        if isinstance(sd, dict) and "model_state" in sd:
+            sd = sd["model_state"]
+        if isinstance(sd, dict) and "state_dict" in sd:
+            sd = sd["state_dict"]
+        if not model:
+            model = ("slowfast" if any("multipathway" in k for k in sd)
+                     else "slow_r50")
+        source = convert_state_dict(
+            {k: v.numpy() for k, v in sd.items()}, model
+        )
+    else:
+        source = load_converted(path)
+
+    report = {"loaded": [], "kept": []}
+
+    def merge(target: dict, src: dict, prefix: Path) -> dict:
+        out = {}
+        for k, v in target.items():
+            p = prefix + (k,)
+            if isinstance(v, dict):
+                out[k] = merge(v, src.get(k, {}), p)
+            elif k in src and not isinstance(src[k], dict) \
+                    and tuple(np.shape(src[k])) == tuple(v.shape):
+                out[k] = jnp.asarray(src[k], dtype=v.dtype)
+                report["loaded"].append("/".join(p))
+            else:
+                out[k] = v
+                report["kept"].append("/".join(p))
+        return out
+
+    merged = {
+        "params": merge(variables["params"], source.get("params", {}), ("params",)),
+        "batch_stats": merge(
+            variables.get("batch_stats", {}), source.get("batch_stats", {}),
+            ("batch_stats",),
+        ),
+    }
+    if mesh is not None:
+        from pytorchvideo_accelerate_tpu.parallel.sharding import shard_params
+
+        merged["params"] = shard_params(mesh, merged["params"])
+        merged["batch_stats"] = shard_params(mesh, merged["batch_stats"])
+    return merged, report
+
+
+def main(argv=None):
+    """CLI: convert a torch hub checkpoint to the npz artifact.
+
+    python -m pytorchvideo_accelerate_tpu.models.convert SRC.pth OUT.npz \
+        --model slowfast_r50
+    """
+    import argparse
+
+    ap = argparse.ArgumentParser(description=main.__doc__)
+    ap.add_argument("src")
+    ap.add_argument("dst")
+    ap.add_argument("--model", default="slow_r50")
+    args = ap.parse_args(argv)
+
+    import torch
+
+    sd = torch.load(args.src, map_location="cpu", weights_only=True)
+    if isinstance(sd, dict) and "model_state" in sd:
+        sd = sd["model_state"]
+    tree = convert_state_dict({k: v.numpy() for k, v in sd.items()}, args.model)
+    save_converted(tree, args.dst)
+    n = len(_flatten(tree["params"])) + len(_flatten(tree["batch_stats"]))
+    print(f"wrote {n} tensors to {args.dst}; skipped: {tree['skipped']}")
+
+
+if __name__ == "__main__":
+    main()

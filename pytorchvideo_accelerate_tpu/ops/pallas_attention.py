@@ -141,14 +141,6 @@ def _pad_seq(x, block):
     return x
 
 
-def _pad_stat(x, block):
-    """Pad a (BH, N, LANES) stat array along N."""
-    pad = (-x.shape[1]) % block
-    if pad:
-        x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
-    return x
-
-
 def _fwd_call(q, k, v, scale, block_q, block_k, interpret):
     BH, nq, D = q.shape
     nk = k.shape[1]
@@ -213,8 +205,8 @@ def _flash_bhnd_bwd(scale, block_q, block_k, interpret, res, dout):
 
     qp = _pad_seq(q, block_q)
     dop = _pad_seq(dout, block_q)
-    lsep = _pad_stat(lse, block_q)
-    deltap = _pad_stat(delta, block_q)
+    lsep = _pad_seq(lse, block_q)
+    deltap = _pad_seq(delta, block_q)
     kp = _pad_seq(k, block_k)
     vp = _pad_seq(v, block_k)
     nq_p, nk_p = qp.shape[1], kp.shape[1]

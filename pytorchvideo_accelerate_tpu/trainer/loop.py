@@ -215,10 +215,18 @@ class Trainer:
         if cfg.model.pretrained and cfg.model.pretrained_path:
             from pytorchvideo_accelerate_tpu.models.convert import load_pretrained
 
+            merged, report = load_pretrained(
+                cfg.model.pretrained_path,
+                {"params": self.state.params,
+                 "batch_stats": self.state.batch_stats},
+                mesh=self.mesh, model=cfg.model.name,
+            )
             self.state = self.state.replace(
-                params=load_pretrained(
-                    cfg.model.pretrained_path, self.state.params, self.mesh
-                )
+                params=merged["params"], batch_stats=merged["batch_stats"]
+            )
+            main_print(
+                f"pretrained: loaded {len(report['loaded'])} tensors, "
+                f"kept {len(report['kept'])} fresh (head swap / mismatches)"
             )
 
         if self.is_pretraining:
@@ -291,6 +299,7 @@ class Trainer:
             progress = tqdm(total=cfg.optim.num_epochs * steps_per_epoch,
                             initial=int(self.state.step))
         last_val_acc, last_train_loss = 0.0, float("nan")
+        last_val_loss = float("nan")
 
         profiling = False
         for epoch in range(starting_epoch, cfg.optim.num_epochs):
@@ -344,9 +353,10 @@ class Trainer:
                 if 0 <= cfg.data.limit_val_batches <= step_in_epoch + 1:
                     break
             last_val_acc = val.accuracy()
+            last_val_loss = val.mean_loss()
             last_train_loss = epoch_loss.mean()
             val_str = (
-                f"val_recon_loss={val.mean_loss():.4f}" if self.is_pretraining
+                f"val_recon_loss={last_val_loss:.4f}" if self.is_pretraining
                 else f"val_acc={last_val_acc:.4f}"
             )
             main_print(
@@ -355,12 +365,13 @@ class Trainer:
                 f"({time.time() - t_epoch:.1f}s)"
             )
             if self.trackers:
-                self.trackers.log(
-                    {"accuracy": last_val_acc,
-                     "train_loss_epoch": last_train_loss,
-                     "epoch": epoch},
-                    step=epoch,
-                )
+                epoch_metrics = {"train_loss_epoch": last_train_loss,
+                                 "epoch": epoch}
+                if self.is_pretraining:
+                    epoch_metrics["val_recon_loss"] = last_val_loss
+                else:
+                    epoch_metrics["accuracy"] = last_val_acc
+                self.trackers.log(epoch_metrics, step=epoch)
             if self.checkpointing_steps == "epoch":
                 self._save("epoch", epoch)
 
@@ -374,5 +385,9 @@ class Trainer:
             progress.close()
         self.train_loader.close()
         self.val_loader.close()
-        return {"val_accuracy": last_val_acc, "train_loss": last_train_loss,
-                "steps": int(self.state.step)}
+        result = {"train_loss": last_train_loss, "steps": int(self.state.step)}
+        if self.is_pretraining:
+            result["val_recon_loss"] = last_val_loss
+        else:
+            result["val_accuracy"] = last_val_acc
+        return result

@@ -68,34 +68,22 @@ def _loss_and_metrics(logits, labels, mask, label_smoothing: float):
     return loss, correct, count
 
 
-def make_train_step(
-    model,
+def _make_update_step(
+    grad_fn: Callable,
     tx: optax.GradientTransformation,
     mesh,
-    accum_steps: int = 1,
-    label_smoothing: float = 0.0,
-    lr_schedule: Optional[Callable] = None,
+    accum_steps: int,
+    lr_schedule: Optional[Callable],
+    with_accuracy: bool,
 ) -> Callable:
-    """Build `step(state, batch, dropout_key) -> (state, metrics)`, jitted
-    with state donation (params update in place in HBM)."""
+    """Shared machinery of the supervised and self-supervised steps.
 
-    def forward_loss(params, batch_stats, batch, key):
-        mask = batch.get("mask")
-        if mask is None:
-            mask = jnp.ones(batch["label"].shape, jnp.float32)
-        logits, updates = model.apply(
-            {"params": params, "batch_stats": batch_stats},
-            model_inputs(batch),
-            train=True,
-            rngs={"dropout": key},
-            mutable=["batch_stats"],
-        )
-        loss, correct, count = _loss_and_metrics(
-            logits, batch["label"], mask, label_smoothing
-        )
-        return loss, (updates["batch_stats"], correct, count)
-
-    grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+    `grad_fn(params, batch_stats, batch, key) -> ((loss, (new_stats, correct,
+    count)), grads)` — a value_and_grad with has_aux; the self-supervised
+    wrapper passes batch_stats/correct/count through untouched. Gradient
+    accumulation is an in-graph `lax.scan` over the leading micro-batch axis
+    syncing ONCE per effective step; the returned step is jitted with state
+    donation (params update in place in HBM)."""
 
     def step(state: TrainState, batch: dict, key) -> tuple:
         if accum_steps == 1:
@@ -130,16 +118,46 @@ def make_train_step(
             batch_stats=new_stats,
             opt_state=new_opt_state,
         )
-        metrics = {
-            "loss": loss,
-            "accuracy": correct / jnp.maximum(count, 1.0),
-            "grad_norm": optax.global_norm(grads),
-        }
+        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if with_accuracy:
+            metrics["accuracy"] = correct / jnp.maximum(count, 1.0)
         if lr_schedule is not None:
             metrics["lr"] = lr_schedule(state.step)
         return new_state, metrics
 
     return jax.jit(step, donate_argnums=0)
+
+
+def make_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    mesh,
+    accum_steps: int = 1,
+    label_smoothing: float = 0.0,
+    lr_schedule: Optional[Callable] = None,
+) -> Callable:
+    """Build the supervised `step(state, batch, dropout_key) ->
+    (state, metrics)` (see `_make_update_step`)."""
+
+    def forward_loss(params, batch_stats, batch, key):
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones(batch["label"].shape, jnp.float32)
+        logits, updates = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            model_inputs(batch),
+            train=True,
+            rngs={"dropout": key},
+            mutable=["batch_stats"],
+        )
+        loss, correct, count = _loss_and_metrics(
+            logits, batch["label"], mask, label_smoothing
+        )
+        return loss, (updates["batch_stats"], correct, count)
+
+    grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+    return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
+                             with_accuracy=True)
 
 
 def make_pretrain_step(
@@ -150,49 +168,22 @@ def make_pretrain_step(
     lr_schedule: Optional[Callable] = None,
 ) -> Callable:
     """Build the VideoMAE self-supervised step: `step(state, batch, key) ->
-    (state, metrics)`. No labels, no batch_stats (pure-LN ViT); the model
-    returns its own reconstruction loss. The rng key feeds both the tube
-    mask and dropout streams; like `make_train_step`, gradient accumulation
-    is an in-graph scan syncing once per effective step."""
+    (state, metrics)`. No labels; batch_stats pass through unchanged (pure-LN
+    ViT keeps `{}`); the model returns its own reconstruction loss. The rng
+    key feeds both the tube mask and dropout streams."""
 
-    def forward_loss(params, batch, key):
+    def forward_loss(params, batch_stats, batch, key):
         kmask, kdrop = jax.random.split(key)
         out = model.apply(
             {"params": params}, batch["video"], train=True,
             rngs={"mask": kmask, "dropout": kdrop},
         )
-        return out["loss"]
+        zero = jnp.zeros((), jnp.float32)
+        return out["loss"], (batch_stats, zero, zero)
 
-    grad_fn = jax.value_and_grad(forward_loss)
-
-    def step(state: TrainState, batch: dict, key) -> tuple:
-        if accum_steps == 1:
-            batch = _constrain_batch(batch, mesh, leading_micro=False)
-            loss, grads = grad_fn(state.params, batch, key)
-        else:
-            batch = _constrain_batch(batch, mesh, leading_micro=True)
-
-            def micro(carry, mb):
-                grads_acc, i = carry
-                loss_i, g = grad_fn(state.params, mb, jax.random.fold_in(key, i))
-                return (jax.tree.map(jnp.add, grads_acc, g), i + 1), loss_i
-
-            zeros = jax.tree.map(jnp.zeros_like, state.params)
-            (grads, _), losses = lax.scan(micro, (zeros, 0), batch)
-            grads = jax.tree.map(lambda g: g / accum_steps, grads)
-            loss = jnp.mean(losses)
-
-        updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
-        new_params = optax.apply_updates(state.params, updates)
-        new_state = state.replace(
-            step=state.step + 1, params=new_params, opt_state=new_opt_state
-        )
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
-        if lr_schedule is not None:
-            metrics["lr"] = lr_schedule(state.step)
-        return new_state, metrics
-
-    return jax.jit(step, donate_argnums=0)
+    grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
+    return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
+                             with_accuracy=False)
 
 
 def make_pretrain_eval_step(model, mesh) -> Callable:

@@ -1,0 +1,182 @@
+"""torch->Flax weight converter (SURVEY N12): mapping coverage, layout
+transposes, npz round-trip, head-swap merge semantics.
+
+The synthetic torch state_dicts are generated from our model param trees via
+`torch_key_for` (the converter's inverse, acting as an independent spec of
+pytorchvideo's `create_resnet`/`create_slowfast` naming), so the tests prove
+key-mapping bijectivity and tensor-layout correctness over every parameter of
+the real architectures.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.models.convert import (
+    convert_state_dict,
+    export_tensor,
+    load_converted,
+    load_pretrained,
+    map_torch_key,
+    save_converted,
+    torch_key_for,
+)
+from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
+from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
+
+
+def _leaves(tree, prefix=()):
+    for k, v in tree.items():
+        if isinstance(v, dict):
+            yield from _leaves(v, prefix + (k,))
+        else:
+            yield prefix + (k,), v
+
+
+def _fake_torch_sd(variables, model, seed=0):
+    """Build a torch-style state_dict covering our full param tree."""
+    rng = np.random.default_rng(seed)
+    sd = {}
+    for coll in ("params", "batch_stats"):
+        for path, leaf in _leaves(variables[coll]):
+            key = torch_key_for(coll, path, model)
+            assert key is not None, f"no torch key for {coll}/{'/'.join(path)}"
+            arr = rng.standard_normal(np.shape(leaf)).astype(np.float32)
+            sd[key] = export_tensor(path, arr)
+    return sd
+
+
+@pytest.fixture(scope="module")
+def slow_vars():
+    model = SlowR50(num_classes=7, depths=(1, 1, 1, 1), stem_features=8)
+    return model.init(jax.random.key(0), jnp.zeros((1, 2, 32, 32, 3)))
+
+
+@pytest.fixture(scope="module")
+def slowfast_vars():
+    model = SlowFast(num_classes=7, depths=(1, 1, 1, 1), stem_features=8)
+    return model.init(
+        jax.random.key(0),
+        (jnp.zeros((1, 2, 32, 32, 3)), jnp.zeros((1, 8, 32, 32, 3))),
+    )
+
+
+@pytest.mark.parametrize("fixture,model", [
+    ("slow_vars", "slow_r50"), ("slowfast_vars", "slowfast_r50"),
+])
+def test_full_tree_round_trip(fixture, model, request):
+    """Every param/batch_stat of the architecture maps torch->flax with the
+    right path and layout (values match after the transposes)."""
+    variables = request.getfixturevalue(fixture)
+    sd = _fake_torch_sd(variables, model)
+    converted = convert_state_dict(sd, model)
+    assert converted["skipped"] == []
+
+    for coll in ("params", "batch_stats"):
+        want = dict(_leaves(variables[coll]))
+        got = dict(_leaves(converted[coll]))
+        assert set(got) == set(want), (
+            f"path mismatch: extra={set(got) - set(want)} "
+            f"missing={set(want) - set(got)}"
+        )
+        for path in want:
+            assert got[path].shape == tuple(want[path].shape), path
+            # value check: converting the exported tensor returns the original
+            key = torch_key_for(coll, path, model)
+            np.testing.assert_array_equal(
+                got[path],
+                np.asarray(sd[key]).transpose(
+                    (2, 3, 4, 1, 0) if np.asarray(sd[key]).ndim == 5
+                    else (1, 0) if path[-1] == "kernel" else
+                    tuple(range(np.asarray(sd[key]).ndim))
+                ),
+            )
+
+
+def test_conv_layout_transpose():
+    arr = np.arange(2 * 3 * 1 * 7 * 7).reshape(2, 3, 1, 7, 7).astype(np.float32)
+    mapped = map_torch_key("blocks.0.conv.weight", "slow_r50")
+    assert mapped == ("params", ("stem", "conv", "kernel"))
+    from pytorchvideo_accelerate_tpu.models.convert import convert_tensor
+
+    out = convert_tensor(mapped[1], arr)
+    assert out.shape == (1, 7, 7, 3, 2)  # DHWIO
+    np.testing.assert_array_equal(out[0, :, :, 1, 0], arr[0, 1, 0])
+
+
+def test_bn_split_params_vs_stats():
+    assert map_torch_key("blocks.1.res_blocks.0.branch2.norm_a.weight", "slow_r50") \
+        == ("params", ("res2", "block0", "conv_a", "norm", "scale"))
+    assert map_torch_key("blocks.1.res_blocks.0.branch2.norm_a.running_var", "slow_r50") \
+        == ("batch_stats", ("res2", "block0", "conv_a", "norm", "var"))
+    assert map_torch_key("blocks.0.norm.num_batches_tracked", "slow_r50") is None
+
+
+def test_slowfast_fusion_and_pathways():
+    assert map_torch_key(
+        "blocks.0.multipathway_blocks.1.conv.weight", "slowfast_r50"
+    ) == ("params", ("fast_stem", "conv", "kernel"))
+    assert map_torch_key(
+        "blocks.2.multipathway_blocks.0.res_blocks.3.branch2.conv_b.weight",
+        "slowfast_r50",
+    ) == ("params", ("slow_res3", "block3", "conv_b", "conv", "kernel"))
+    assert map_torch_key(
+        "blocks.1.multipathway_fusion.conv_fast_to_slow.weight", "slowfast_r50"
+    ) == ("params", ("fuse_res2", "conv_f2s", "conv", "kernel"))
+    assert map_torch_key(
+        "blocks.6.proj.weight", "slowfast_r50"
+    ) == ("params", ("head", "proj", "kernel"))
+
+
+def test_npz_round_trip_and_merge(tmp_path, slow_vars):
+    sd = _fake_torch_sd(slow_vars, "slow_r50")
+    tree = convert_state_dict(sd, "slow_r50")
+    path = str(tmp_path / "slow.npz")
+    save_converted(tree, path)
+    loaded = load_converted(path)
+    for coll in ("params", "batch_stats"):
+        for p, v in _leaves(tree[coll]):
+            np.testing.assert_array_equal(dict(_leaves(loaded[coll]))[p], v)
+
+    merged, report = load_pretrained(path, slow_vars)
+    assert not report["kept"], report["kept"]  # same shapes -> all loaded
+    got = dict(_leaves(merged["params"]))[("stem", "conv", "kernel")]
+    want = dict(_leaves(tree["params"]))[("stem", "conv", "kernel")]
+    np.testing.assert_allclose(np.asarray(got), want)
+
+
+def test_head_swap_keeps_fresh_head(tmp_path, slow_vars):
+    """Pretrain head (7 classes here) must NOT overwrite a different-size
+    fine-tune head — reference head-swap semantics (run.py:109,117)."""
+    sd = _fake_torch_sd(slow_vars, "slow_r50")
+    tree = convert_state_dict(sd, "slow_r50")
+    path = str(tmp_path / "slow.npz")
+    save_converted(tree, path)
+
+    target = SlowR50(num_classes=11, depths=(1, 1, 1, 1), stem_features=8).init(
+        jax.random.key(1), jnp.zeros((1, 2, 32, 32, 3))
+    )
+    merged, report = load_pretrained(path, target)
+    kept = set(report["kept"])
+    assert kept == {"params/head/proj/kernel", "params/head/proj/bias"}, kept
+    got_head = dict(_leaves(merged["params"]))[("head", "proj", "kernel")]
+    np.testing.assert_array_equal(
+        np.asarray(got_head),
+        np.asarray(dict(_leaves(target["params"]))[("head", "proj", "kernel")]),
+    )
+    # backbone still loaded
+    got_stem = dict(_leaves(merged["params"]))[("stem", "conv", "kernel")]
+    np.testing.assert_allclose(
+        np.asarray(got_stem), dict(_leaves(tree["params"]))[("stem", "conv", "kernel")]
+    )
+
+
+def test_torch_pt_on_the_fly(tmp_path, slow_vars):
+    torch = pytest.importorskip("torch")
+    sd = {k: torch.from_numpy(np.asarray(v))
+          for k, v in _fake_torch_sd(slow_vars, "slow_r50").items()}
+    p = str(tmp_path / "hub.pth")
+    torch.save(sd, p)
+    merged, report = load_pretrained(p, slow_vars, model="slow_r50")
+    assert not report["kept"]
