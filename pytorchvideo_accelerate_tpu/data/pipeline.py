@@ -173,12 +173,15 @@ class ClipLoader:
         process_index: int = 0,
         process_count: int = 1,
         prefetch_batches: int = 2,
+        transport: str = "thread",
     ):
         if global_batch_size % process_count:
             raise ValueError(
                 f"global_batch_size {global_batch_size} not divisible by "
                 f"process_count {process_count}"
             )
+        if transport not in ("thread", "process"):
+            raise ValueError(f"transport must be thread|process, got {transport!r}")
         self.source = source
         self.global_batch_size = global_batch_size
         self.local_batch_size = global_batch_size // process_count
@@ -192,6 +195,15 @@ class ClipLoader:
         self.prefetch_batches = prefetch_batches
         self.state = LoaderState()
         self._pool = ThreadPoolExecutor(max_workers=self.num_workers)
+        # "process": forked decode workers + native shm ring (SURVEY N8);
+        # falls back to threads when the native lib can't build
+        self.transport = transport
+        self._shm_pool = None
+        if transport == "process":
+            import pytorchvideo_accelerate_tpu.native as native
+
+            if native.load() is None:
+                self.transport = "thread"
 
     # --- epoch geometry ---------------------------------------------------
 
@@ -218,10 +230,23 @@ class ClipLoader:
 
     # --- iteration --------------------------------------------------------
 
+    @staticmethod
+    def _stack(arrs: List[np.ndarray]) -> np.ndarray:
+        """np.stack via the native multithreaded gather-copy when available
+        (GIL-free batch assembly); numpy fallback otherwise."""
+        first = np.asarray(arrs[0])
+        if first.ndim == 0:
+            return np.stack(arrs)
+        from pytorchvideo_accelerate_tpu.native.ringbuf import gather_copy
+
+        out = np.empty((len(arrs), *first.shape), first.dtype)
+        gather_copy(out, arrs)
+        return out
+
     def _assemble(self, samples: List[Dict[str, np.ndarray]], pad_to: int) -> dict:
         n = len(samples)
         keys = samples[0].keys()
-        batch = {k: np.stack([s[k] for s in samples]) for k in keys}
+        batch = {k: self._stack([s[k] for s in samples]) for k in keys}
         if n < pad_to:  # padded tail (val only): mask marks real samples
             mask = np.zeros(pad_to, np.float32)
             mask[:n] = 1.0
@@ -248,6 +273,9 @@ class ClipLoader:
         indices = self._epoch_indices(epoch)
         spy = self.samples_per_yield
         n_batches = self.batches_per_epoch()
+        if self.transport == "process":
+            yield from self._epoch_process(epoch, indices, n_batches)
+            return
 
         def fetch_batch(b: int) -> dict:
             chunk = indices[b * spy : (b + 1) * spy]
@@ -281,5 +309,49 @@ class ClipLoader:
         finally:
             executor.shutdown(wait=False)
 
+    def _epoch_process(self, epoch: int, indices: np.ndarray,
+                       n_batches: int) -> Iterator[dict]:
+        """Forked shm workers; batches byte-identical to the thread path.
+        Prefetch comes from ring capacity (workers run ahead of assembly)."""
+        from pytorchvideo_accelerate_tpu.native.shm_loader import ShmWorkerPool
+
+        spy = self.samples_per_yield
+        if self._shm_pool is None:
+            # assembly defers slot release until a full batch is collected,
+            # so the ring must hold spy in-flight slots plus worker headroom
+            self._shm_pool = ShmWorkerPool(
+                self.source, num_workers=self.num_workers,
+                n_slots=spy + 2 * self.num_workers,
+            )
+        usable = indices[: n_batches * spy] if self.drop_last else indices
+        start = self.state.position
+        samples, dones = [], []
+        b = start
+
+        def flush():
+            nonlocal samples, dones
+            batch = self._assemble(samples, spy)
+            for done in dones:
+                done()
+            samples, dones = [], []
+            return batch
+
+        for sample, done in self._shm_pool.map_epoch(
+            usable, epoch, start=start * spy
+        ):
+            samples.append(sample)
+            dones.append(done)
+            if len(samples) == spy:
+                self.state = LoaderState(epoch=epoch, position=b + 1)
+                yield flush()
+                b += 1
+        if samples:  # non-drop_last tail, padded + masked
+            self.state = LoaderState(epoch=epoch, position=b + 1)
+            yield flush()
+        self.state = LoaderState(epoch=epoch + 1, position=0)
+
     def close(self) -> None:
         self._pool.shutdown(wait=False)
+        if self._shm_pool is not None:
+            self._shm_pool.close()
+            self._shm_pool = None

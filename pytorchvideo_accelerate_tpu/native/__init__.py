@@ -1,0 +1,104 @@
+"""ctypes bindings for the native loader runtime (native/pva_native.cpp).
+
+The shared library is built on first use with the system g++ (no external
+deps, ~1s) and cached next to the source; environments without a toolchain
+get `load() -> None` and the pure-Python loader paths keep working.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional
+
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+_REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+_SRC = os.path.join(_REPO_ROOT, "native", "pva_native.cpp")
+_LIB_DIR = os.environ.get(
+    "PVA_NATIVE_CACHE",
+    os.path.join(os.path.expanduser("~"), ".cache", "pva_tpu"),
+)
+_LIB = os.path.join(_LIB_DIR, "libpva_native.so")
+
+_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    cmd = ["g++", "-O3", "-fPIC", "-shared", "-std=c++17", "-pthread",
+           _SRC, "-o", _LIB]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        return True
+    except (subprocess.SubprocessError, FileNotFoundError) as e:
+        logger.warning("native build failed (%s); using pure-Python loader", e)
+        return False
+
+
+def load() -> Optional[ctypes.CDLL]:
+    """Load (building if needed) the native library, or None if unavailable."""
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        if not os.path.exists(_LIB) or (
+            os.path.exists(_SRC)
+            and os.path.getmtime(_SRC) > os.path.getmtime(_LIB)
+        ):
+            if not os.path.exists(_SRC) and os.path.exists(_LIB):
+                pass  # installed without sources: use the cached build
+            elif not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(_LIB)
+        except OSError as e:
+            logger.warning("native load failed (%s); using pure-Python loader", e)
+            _load_failed = True
+            return None
+
+        u64, u32, i32 = ctypes.c_uint64, ctypes.c_uint32, ctypes.c_int
+        p = ctypes.c_void_p
+        lib.pva_rb_total_size.restype = u64
+        lib.pva_rb_total_size.argtypes = [u32, u64]
+        lib.pva_rb_init.restype = i32
+        lib.pva_rb_init.argtypes = [p, u32, u64]
+        lib.pva_rb_slot_ptr.restype = p
+        lib.pva_rb_slot_ptr.argtypes = [p, u32]
+        lib.pva_rb_slot_bytes.restype = u64
+        lib.pva_rb_slot_bytes.argtypes = [p]
+        lib.pva_rb_acquire.restype = i32
+        lib.pva_rb_acquire.argtypes = [p, i32]
+        lib.pva_rb_commit.restype = i32
+        lib.pva_rb_commit.argtypes = [p, u32, u64, u64]
+        lib.pva_rb_pop.restype = i32
+        lib.pva_rb_pop.argtypes = [p, i32, ctypes.POINTER(u64), ctypes.POINTER(u64)]
+        lib.pva_rb_release.restype = i32
+        lib.pva_rb_release.argtypes = [p, u32]
+        lib.pva_rb_shutdown.restype = None
+        lib.pva_rb_shutdown.argtypes = [p]
+        lib.pva_rb_ready_count.restype = u32
+        lib.pva_rb_ready_count.argtypes = [p]
+        lib.pva_gather_copy.restype = i32
+        lib.pva_gather_copy.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(u64), ctypes.POINTER(u64), u32, u32,
+        ]
+        _lib = lib
+        return _lib
+
+
+from pytorchvideo_accelerate_tpu.native.ringbuf import (  # noqa: E402,F401
+    ShmRing,
+    gather_copy,
+)
+from pytorchvideo_accelerate_tpu.native.shm_loader import (  # noqa: E402,F401
+    ShmWorkerPool,
+)

@@ -1,0 +1,175 @@
+"""Python view of the native shm ring buffer + sample (de)serialization.
+
+A slot carries one sample dict of fixed-shape numpy arrays with a tiny
+binary header (key table + dtype/shape), so workers in *other processes*
+write decoded clips straight into shared pages — no pickling, no pipes
+(the torch-DataLoader transport this replaces, SURVEY §2.3-N8).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import mmap
+import struct
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import pytorchvideo_accelerate_tpu.native as native
+
+_DTYPES = [np.dtype(np.float32), np.dtype(np.int32), np.dtype(np.uint8),
+           np.dtype(np.float16), np.dtype(np.int64), np.dtype(np.bool_)]
+_DTYPE_CODE = {d: i for i, d in enumerate(_DTYPES)}
+
+
+def pack_sample(sample: Dict[str, np.ndarray], buf: memoryview) -> int:
+    """Serialize a sample dict into `buf`; returns bytes written.
+
+    Layout: u32 n_arrays, then per array: u16 keylen, key bytes, u8 dtype
+    code, u8 ndim, u32 shape[ndim], u64 nbytes, payload (8-byte aligned).
+    """
+    off = 4
+    n = 0
+    for key, arr in sample.items():
+        # NB: np.asarray, not ascontiguousarray — the latter promotes 0-d
+        # scalars to (1,); tobytes() below handles layout regardless
+        arr = np.asarray(arr)
+        kb = key.encode()
+        struct.pack_into(f"<H{len(kb)}sBB", buf, off, len(kb), kb,
+                         _DTYPE_CODE[arr.dtype], arr.ndim)
+        off += 2 + len(kb) + 2
+        struct.pack_into(f"<{arr.ndim}I", buf, off, *arr.shape)
+        off += 4 * arr.ndim
+        nbytes = arr.nbytes
+        struct.pack_into("<Q", buf, off, nbytes)
+        off += 8
+        off = (off + 7) & ~7
+        buf[off:off + nbytes] = arr.tobytes()  # single copy into shm
+        off += nbytes
+        n += 1
+    struct.pack_into("<I", buf, 0, n)
+    return off
+
+
+def unpack_sample(buf: memoryview, copy: bool = False) -> Dict[str, np.ndarray]:
+    """Deserialize; by default returns zero-copy views into the slot (valid
+    until the slot is released — callers batch-copy before releasing)."""
+    (n,) = struct.unpack_from("<I", buf, 0)
+    off = 4
+    out = {}
+    for _ in range(n):
+        (klen,) = struct.unpack_from("<H", buf, off)
+        key = bytes(buf[off + 2:off + 2 + klen]).decode()
+        code, ndim = struct.unpack_from("<BB", buf, off + 2 + klen)
+        off += 2 + klen + 2
+        shape = struct.unpack_from(f"<{ndim}I", buf, off)
+        off += 4 * ndim
+        (nbytes,) = struct.unpack_from("<Q", buf, off)
+        off += 8
+        off = (off + 7) & ~7
+        arr = np.frombuffer(buf, dtype=_DTYPES[code], count=int(
+            nbytes // _DTYPES[code].itemsize), offset=off).reshape(shape)
+        out[key] = arr.copy() if copy else arr
+        off += nbytes
+    return out
+
+
+def sample_nbytes(sample: Dict[str, np.ndarray]) -> int:
+    total = 4
+    for key, arr in sample.items():
+        total += 2 + len(key.encode()) + 2 + 4 * np.ndim(arr) + 8 + 8
+        total += np.asarray(arr).nbytes
+    return total
+
+
+class ShmRing:
+    """A native ring buffer in an anonymous shared mmap (inherited by forked
+    worker processes). Parent creates it pre-fork; children reuse `ring.buf`."""
+
+    def __init__(self, n_slots: int, slot_bytes: int):
+        lib = native.load()
+        if lib is None:
+            raise RuntimeError("native library unavailable")
+        self.lib = lib
+        self.n_slots = n_slots
+        total = lib.pva_rb_total_size(n_slots, slot_bytes)
+        self.mm = mmap.mmap(-1, total)  # MAP_SHARED | MAP_ANONYMOUS
+        self._base = ctypes.addressof(ctypes.c_char.from_buffer(self.mm))
+        if lib.pva_rb_init(self._base, n_slots, slot_bytes) != 0:
+            raise RuntimeError("pva_rb_init failed")
+        self.slot_bytes = lib.pva_rb_slot_bytes(self._base)
+
+    # --- producer side ----------------------------------------------------
+    def acquire(self, timeout_ms: int = 10_000) -> int:
+        return self.lib.pva_rb_acquire(self._base, timeout_ms)
+
+    def commit(self, slot: int, nbytes: int, tag: int) -> None:
+        self.lib.pva_rb_commit(self._base, slot, nbytes, tag)
+
+    def put_sample(self, sample: Dict[str, np.ndarray], tag: int,
+                   timeout_ms: int = 10_000) -> bool:
+        slot = self.acquire(timeout_ms)
+        if slot < 0:
+            return False
+        n = pack_sample(sample, self.slot_view(slot))
+        self.commit(slot, n, tag)
+        return True
+
+    # --- consumer side ----------------------------------------------------
+    def pop(self, timeout_ms: int = 10_000) -> Tuple[int, int, int]:
+        nbytes = ctypes.c_uint64()
+        tag = ctypes.c_uint64()
+        slot = self.lib.pva_rb_pop(self._base, timeout_ms,
+                                   ctypes.byref(nbytes), ctypes.byref(tag))
+        return slot, nbytes.value, tag.value
+
+    def release(self, slot: int) -> None:
+        self.lib.pva_rb_release(self._base, slot)
+
+    def slot_view(self, slot: int) -> memoryview:
+        ptr = self.lib.pva_rb_slot_ptr(self._base, slot)
+        off = ptr - self._base
+        return memoryview(self.mm)[off:off + self.slot_bytes]
+
+    def ready_count(self) -> int:
+        return self.lib.pva_rb_ready_count(self._base)
+
+    def shutdown(self) -> None:
+        self.lib.pva_rb_shutdown(self._base)
+
+    def close(self) -> None:
+        self.shutdown()
+        # mm stays mapped until gc so outstanding views stay valid
+
+
+def gather_copy(dst: np.ndarray, parts: Sequence[np.ndarray],
+                offsets: Optional[Sequence[int]] = None,
+                n_threads: int = 4) -> None:
+    """dst.flat bytes[off_i:] = parts[i] — multithreaded memcpy without the
+    GIL (batch assembly; replaces np.stack's serial copies)."""
+    lib = native.load()
+    n = len(parts)
+    if offsets is None:
+        offsets, acc = [], 0
+        for part in parts:
+            offsets.append(acc)
+            acc += part.nbytes
+    if lib is None:  # pure-python fallback
+        view = dst.reshape(-1).view(np.uint8)
+        for off, part in zip(offsets, parts):
+            pb = np.ascontiguousarray(part).reshape(-1).view(np.uint8)
+            view[off:off + part.nbytes] = pb
+        return
+    srcs = (ctypes.c_char_p * n)()
+    offs = (ctypes.c_uint64 * n)(*offsets)
+    sizes = (ctypes.c_uint64 * n)()
+    keepalive: List[np.ndarray] = []
+    for i, part in enumerate(parts):
+        part = np.ascontiguousarray(part)
+        keepalive.append(part)
+        srcs[i] = ctypes.cast(part.ctypes.data, ctypes.c_char_p)
+        sizes[i] = part.nbytes
+    lib.pva_gather_copy(
+        ctypes.cast(dst.ctypes.data, ctypes.c_char_p), srcs, offs, sizes,
+        n, n_threads,
+    )

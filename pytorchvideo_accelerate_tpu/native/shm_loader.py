@@ -1,0 +1,146 @@
+"""Process-based clip workers over native shm rings (SURVEY §2.3-N8).
+
+The thread-pool loader (data/pipeline.py) is enough while cv2 decode
+releases the GIL, but the numpy transform stack serializes on it; the
+reference's answer is worker *processes* (torch DataLoader), paying pickle +
+pipe per sample. This pool forks workers that write decoded/transformed
+samples straight into shared memory:
+
+- ONE RING PER WORKER, created fresh per epoch: worker w produces epoch
+  positions w, w+W, ... in order into its own ring, and the consumer pops
+  position p from ring p%W — samples arrive in order by construction, so
+  there is no reordering stash, memory is bounded by the ring sizes, and a
+  slow worker back-pressures only itself;
+- samples cross as zero-copy views and are copied exactly once into the
+  batch buffer (native `gather_copy`, no GIL);
+- a worker exception is delivered as an in-band "__error__" sample, so the
+  consumer raises the real message immediately (parity with the thread
+  path's fut.result()) instead of timing out;
+- fork() per epoch, copy-on-write. KNOWN LIMITATION (shared with torch's
+  fork-mode DataLoader): forking a heavily threaded parent can deadlock a
+  child on an inherited lock; children therefore run only numpy/cv2/ring
+  code (no logging, no JAX) between fork and os._exit. `transport="thread"`
+  remains the default; select "process" when decode is the bottleneck.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import traceback
+from typing import Dict, Iterator, List, Sequence, Tuple
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.native.ringbuf import (
+    ShmRing,
+    pack_sample,
+    sample_nbytes,
+    unpack_sample,
+)
+
+ERROR_KEY = "__error__"
+
+
+class ShmWorkerPool:
+    """Decode workers in forked processes, samples through per-worker rings."""
+
+    def __init__(self, source, num_workers: int = 4, slots_per_worker: int = 0,
+                 slot_bytes: int = 0, probe_epoch: int = 0,
+                 timeout_ms: int = 60_000):
+        self.source = source
+        self.num_workers = max(1, num_workers)
+        self.timeout_ms = timeout_ms
+        if not slot_bytes:
+            probe = source.get(0, probe_epoch)
+            # headroom for per-sample shape jitter + header
+            slot_bytes = int(sample_nbytes(probe) * 1.25) + 1024
+        self.slot_bytes = slot_bytes
+        self.slots_per_worker = slots_per_worker or 4
+        self._rings: List[ShmRing] = []
+        self._pids: List[int] = []
+
+    # --- worker body ------------------------------------------------------
+
+    def _worker(self, wid: int, indices: np.ndarray, epoch: int) -> None:
+        ring = self._rings[wid]
+        try:
+            for pos in range(wid, len(indices), self.num_workers):
+                sample = self.source.get(int(indices[pos]), epoch)
+                slot = ring.acquire(self.timeout_ms)
+                if slot < 0:  # shutdown or stuck consumer
+                    return
+                n = pack_sample(sample, ring.slot_view(slot))
+                ring.commit(slot, n, tag=pos)
+        except BaseException:
+            # in-band error delivery; consumer raises with this traceback
+            msg = traceback.format_exc().encode()[-4096:]
+            slot = ring.acquire(2000)
+            if slot >= 0:
+                err = {ERROR_KEY: np.frombuffer(msg, np.uint8)}
+                n = pack_sample(err, ring.slot_view(slot))
+                ring.commit(slot, n, tag=0)
+        finally:
+            os._exit(0)
+
+    def _spawn(self, indices: np.ndarray, epoch: int) -> None:
+        self._rings = [ShmRing(self.slots_per_worker, self.slot_bytes)
+                       for _ in range(self.num_workers)]
+        self._pids = []
+        for w in range(self.num_workers):
+            pid = os.fork()
+            if pid == 0:
+                self._worker(w, indices, epoch)  # never returns
+            self._pids.append(pid)
+
+    def _teardown(self) -> None:
+        for ring in self._rings:
+            ring.shutdown()  # wakes any worker blocked in acquire()
+        for pid in self._pids:
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        self._pids = []
+        self._rings = []
+
+    # --- consumer ---------------------------------------------------------
+
+    def map_epoch(self, indices: Sequence[int], epoch: int,
+                  start: int = 0) -> Iterator[Tuple[Dict[str, np.ndarray], "callable"]]:
+        """Yield (sample, done) for positions start..len(indices)-1 IN ORDER.
+
+        `sample` holds zero-copy views into a ring slot; call `done()` after
+        copying it out (releases the slot). Rings + workers live for this
+        call only; early generator exit tears them down promptly.
+        """
+        indices = np.asarray(indices[start:])
+        self._spawn(indices, epoch)
+        try:
+            for pos in range(len(indices)):
+                ring = self._rings[pos % self.num_workers]
+                slot, nbytes, tag = ring.pop(self.timeout_ms)
+                if slot < 0:
+                    raise TimeoutError(
+                        f"shm pool: no sample for position {pos} from worker "
+                        f"{pos % self.num_workers} (status {slot})"
+                    )
+                sample = unpack_sample(ring.slot_view(slot)[:nbytes])
+                if ERROR_KEY in sample:
+                    raise RuntimeError(
+                        "shm worker failed:\n"
+                        + bytes(sample[ERROR_KEY]).decode(errors="replace")
+                    )
+                if tag != pos:  # protocol violation — should be impossible
+                    raise RuntimeError(f"shm pool: expected pos {pos}, got {tag}")
+                yield sample, (lambda r=ring, s=slot: r.release(s))
+        finally:
+            self._teardown()
+
+    def close(self) -> None:
+        for pid in self._pids:
+            try:
+                os.kill(pid, signal.SIGTERM)
+            except ProcessLookupError:
+                pass
+        self._teardown()

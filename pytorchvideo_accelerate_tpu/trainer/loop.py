@@ -170,6 +170,7 @@ class Trainer:
             num_workers=d.num_workers,
             process_index=jax.process_index(),
             process_count=jax.process_count(),
+            transport=d.transport,
         )
         self.train_loader = ClipLoader(
             self.train_source, global_batch,
