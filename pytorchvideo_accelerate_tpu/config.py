@@ -46,8 +46,8 @@ class DataConfig:
     num_frames: int = 8  # run.py:374 default; 32 in run_slowfast_r50.sh
     sampling_rate: int = 8
     frames_per_second: int = 30
-    batch_size: int = 8
-    transport: str = "thread"  # thread | process (native shm decode workers)  # per data-parallel shard, matching per-rank semantics
+    batch_size: int = 8  # per data-parallel shard, matching per-rank semantics
+    transport: str = "thread"  # thread | process (native shm decode workers)
     num_workers: int = 8
     crop_size: int = 256
     min_short_side_scale: int = 256

@@ -317,11 +317,14 @@ class ClipLoader:
 
         spy = self.samples_per_yield
         if self._shm_pool is None:
-            # assembly defers slot release until a full batch is collected,
-            # so the ring must hold spy in-flight slots plus worker headroom
+            # assembly defers slot release until a full batch is collected;
+            # worker w contributes ceil(spy/W) samples per batch, so each
+            # per-worker ring must hold that many in-flight slots plus
+            # prefetch headroom
+            per_worker = -(-spy // self.num_workers) + 2
             self._shm_pool = ShmWorkerPool(
                 self.source, num_workers=self.num_workers,
-                n_slots=spy + 2 * self.num_workers,
+                slots_per_worker=per_worker,
             )
         usable = indices[: n_batches * spy] if self.drop_last else indices
         start = self.state.position

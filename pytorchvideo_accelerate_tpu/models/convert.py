@@ -78,6 +78,8 @@ def map_torch_key(key: str, model: str) -> Optional[Tuple[str, Path]]:
     """torch state_dict key -> ("params"|"batch_stats", flax path) or None."""
     if key.endswith("num_batches_tracked"):
         return None
+    if model.startswith("x3d"):
+        return map_x3d_key(key)
     slowfast = model.startswith("slowfast")
 
     m = re.match(r"blocks\.(\d+)\.(.*)", key)
@@ -146,6 +148,8 @@ def map_torch_key(key: str, model: str) -> Optional[Tuple[str, Path]]:
 def torch_key_for(collection: str, path: Path, model: str) -> Optional[str]:
     """Inverse of `map_torch_key` — flax path -> torch key (used by tests as
     an independent spec and by weight export)."""
+    if model.startswith("x3d"):
+        return x3d_torch_key_for(collection, path)
     slowfast = model.startswith("slowfast")
     head_block = 6 if slowfast else 5
     if path[0] == "head":
@@ -208,6 +212,263 @@ def torch_key_for(collection: str, path: Path, model: str) -> Optional[str]:
     return None
 
 
+# --- X3D (pytorchvideo create_x3d tree) ------------------------------------
+#
+# Torch tree (run.py:107's hub family; pytorchvideo models/x3d.py):
+# blocks.0 stem = Conv2plus1d where — a pytorchvideo quirk — the `conv_t`
+# slot holds the 1xkxk *spatial* conv and `conv_xy` the kx1x1 depthwise
+# temporal conv; blocks.1-4 stages of ResBlock(branch1_conv/branch1_norm,
+# branch2=BottleneckBlock(conv_a/norm_a/conv_b/norm_b/conv_c/norm_c)) where
+# norm_b is `Sequential(BN, SqueezeExcitation(fc1, fc2))` on SE blocks
+# (keys norm_b.0.* / norm_b.1.fc{1,2}.*) and a plain BN otherwise; blocks.5
+# head = ProjectedPool(pre_conv/pre_norm/post_conv) + proj linear.
+
+_X3D_STEM = {"conv.conv_t": ("stem_xy", "kernel"),
+             "conv.conv_xy": ("stem_t", "kernel")}
+
+
+def _x3d_norm(prefix: Path, leaf: str) -> Optional[Tuple[str, Path]]:
+    if leaf in _BN_PARAM:
+        return "params", prefix + (_BN_PARAM[leaf],)
+    if leaf in _BN_STAT:
+        return "batch_stats", prefix + (_BN_STAT[leaf],)
+    return None
+
+
+def map_x3d_key(key: str) -> Optional[Tuple[str, Path]]:
+    if key.endswith("num_batches_tracked"):
+        return None
+    m = re.match(r"blocks\.(\d+)\.(.*)", key)
+    if not m:
+        return None
+    idx, rest = int(m.group(1)), m.group(2)
+
+    if idx == 0:  # stem
+        for torch_name, flax in _X3D_STEM.items():
+            if rest == f"{torch_name}.weight":
+                return "params", flax
+        nm = re.match(r"norm\.(\w+)", rest)
+        return _x3d_norm(("stem_norm",), nm.group(1)) if nm else None
+
+    if idx == 5:  # head
+        if rest == "pool.pre_conv.weight":
+            return "params", ("conv5", "conv", "kernel")
+        nm = re.match(r"pool\.pre_norm\.(\w+)", rest)
+        if nm:
+            return _x3d_norm(("conv5", "norm"), nm.group(1))
+        if rest == "pool.post_conv.weight":
+            return "params", ("head_conv", "kernel")
+        pm = re.match(r"proj\.(weight|bias)", rest)
+        if pm:
+            return "params", ("proj",
+                              "kernel" if pm.group(1) == "weight" else "bias")
+        return None
+
+    m3 = re.match(r"res_blocks\.(\d+)\.(.*)", rest)
+    if not m3:
+        return None
+    block = (f"res{idx + 1}_block{m3.group(1)}",)
+    inner = m3.group(2)
+    if inner == "branch1_conv.weight":
+        return "params", block + ("branch1", "conv", "kernel")
+    nm = re.match(r"branch1_norm\.(\w+)", inner)
+    if nm:
+        return _x3d_norm(block + ("branch1", "norm"), nm.group(1))
+    m4 = re.match(r"branch2\.(.*)", inner)
+    if not m4:
+        return None
+    b2 = m4.group(1)
+    for letter, tgt in (("a", ("conv_a", "conv")), ("c", ("conv_c", "conv"))):
+        if b2 == f"conv_{letter}.weight":
+            return "params", block + tgt + ("kernel",)
+        nm = re.match(rf"norm_{letter}\.(\w+)", b2)
+        if nm:
+            return _x3d_norm(block + (tgt[0], "norm"), nm.group(1))
+    if b2 == "conv_b.weight":
+        return "params", block + ("conv_b", "kernel")
+    # norm_b: plain BN, or Sequential(BN, SE) on SE blocks
+    nm = re.match(r"norm_b\.(?:0\.)?(\w+)$", b2)
+    if nm and (nm.group(1) in _BN_PARAM or nm.group(1) in _BN_STAT):
+        return _x3d_norm(block + ("norm_b",), nm.group(1))
+    sm = re.match(r"norm_b\.1\.(fc[12])\.(weight|bias)", b2)
+    if sm:
+        return "params", block + ("se", sm.group(1),
+                                  "kernel" if sm.group(2) == "weight" else "bias")
+    return None
+
+
+def x3d_torch_key_for(collection: str, path: Path) -> Optional[str]:
+    """Inverse of `map_x3d_key` (independent spec for tests + export)."""
+    inv_bn = {v: k for k, v in (_BN_PARAM if collection == "params"
+                                else _BN_STAT).items()}
+    if path[0] == "stem_xy":
+        return "blocks.0.conv.conv_t.weight"
+    if path[0] == "stem_t":
+        return "blocks.0.conv.conv_xy.weight"
+    if path[0] == "stem_norm":
+        return f"blocks.0.norm.{inv_bn[path[1]]}"
+    if path[0] == "conv5":
+        if path[1] == "conv":
+            return "blocks.5.pool.pre_conv.weight"
+        return f"blocks.5.pool.pre_norm.{inv_bn[path[2]]}"
+    if path[0] == "head_conv":
+        return "blocks.5.pool.post_conv.weight"
+    if path[0] == "proj":
+        return "blocks.5.proj." + ("weight" if path[1] == "kernel" else "bias")
+    m = re.match(r"res(\d)_block(\d+)", path[0])
+    if not m:
+        return None
+    prefix = f"blocks.{int(m.group(1)) - 1}.res_blocks.{m.group(2)}"
+    rest = path[1:]
+    if rest[0] == "branch1":
+        if rest[1] == "conv":
+            return f"{prefix}.branch1_conv.weight"
+        return f"{prefix}.branch1_norm.{inv_bn[rest[2]]}"
+    if rest[0] in ("conv_a", "conv_c"):
+        letter = rest[0][-1]
+        if rest[1] == "conv":
+            return f"{prefix}.branch2.conv_{letter}.weight"
+        return f"{prefix}.branch2.norm_{letter}.{inv_bn[rest[2]]}"
+    if rest[0] == "conv_b":
+        return f"{prefix}.branch2.conv_b.weight"
+    if rest[0] == "norm_b":
+        # SE blocks nest the BN at norm_b.0; either key converts back
+        return f"{prefix}.branch2.norm_b.0.{inv_bn[rest[1]]}"
+    if rest[0] == "se":
+        return (f"{prefix}.branch2.norm_b.1.{rest[1]}."
+                + ("weight" if rest[2] == "kernel" else "bias"))
+    return None
+
+
+# --- MViT (pytorchvideo create_multiscale_vision_transformers tree) ---------
+#
+# Torch tree (pytorchvideo models/vision_transformers.py + layers/attention.py):
+# patch_embed.patch_model conv; cls_positional_encoding with *separable*
+# pos embeds (pos_embed_spatial (1,HW,C) + pos_embed_temporal (1,T,C) +
+# pos_embed_class); blocks.i = MultiScaleBlock(norm1, attn(qkv, pool_q/
+# norm_q, pool_k/norm_k, pool_v/norm_v, proj), norm2, mlp.fc1/fc2, proj on
+# dim-change blocks); final norm; head.proj.
+#
+# Documented deviations of the flax MViT (mvit.py module docstring) and how
+# conversion handles them:
+# - joint pos embed (1,T,H,W,C), no CLS token: the separable tables ARE an
+#   outer sum, so the joint table is synthesized exactly as
+#   temporal[:,:,None,:] + spatial[:,None,hw,:]; pos_embed_class is dropped
+#   (no CLS in this architecture — the head mean-pools).
+# - per-head pooling as ONE depthwise conv over heads*head_dim channels:
+#   torch applies the SAME (head_dim,1,3,3,3) depthwise kernel to every
+#   head, so tiling it `heads` times across channels is exact. The pooling
+#   LayerNorm tiles the same way but normalizes over all channels rather
+#   than per head — an approximation, flagged in the report.
+# - dim change at the attention (qkv emits dim_out) vs torch's change in
+#   the MLP: stage-transition blocks (3 of 16 in MViT-B) keep their fresh
+#   init via load_pretrained's shape check.
+
+_MVIT_DIRECT = {
+    "norm1": ("norm1", {"weight": "scale", "bias": "bias"}),
+    "norm2": ("norm2", {"weight": "scale", "bias": "bias"}),
+    "attn.qkv": ("attn/qkv", {"weight": "kernel", "bias": "bias"}),
+    "attn.proj": ("attn/proj", {"weight": "kernel", "bias": "bias"}),
+    "mlp.fc1": ("mlp_fc1", {"weight": "kernel", "bias": "bias"}),
+    "mlp.fc2": ("mlp_fc2", {"weight": "kernel", "bias": "bias"}),
+    "proj": ("skip_proj", {"weight": "kernel", "bias": "bias"}),
+}
+_MVIT_POOL = {"pool_q": "pool_q", "pool_k": "pool_k", "pool_v": "pool_v",
+              "norm_q": "pool_q", "norm_k": "pool_k", "norm_v": "pool_v"}
+
+
+def convert_mvit_state_dict(sd: Dict[str, np.ndarray]) -> dict:
+    """MViT torch state_dict -> flax tree (cross-key: pos-embed synthesis and
+    per-head tiling need more than one tensor, hence no per-key map fn)."""
+    out: dict = {"params": {}, "batch_stats": {}, "skipped": []}
+
+    # per-block head counts, from qkv dim / pool head_dim
+    heads: Dict[int, int] = {}
+    for key, value in sd.items():
+        m = re.match(r"blocks\.(\d+)\.attn\.pool_[qkv]\.weight", key)
+        if m:
+            i = int(m.group(1))
+            qkv = sd.get(f"blocks.{i}.attn.qkv.weight")
+            if qkv is not None:
+                heads[i] = max(np.shape(qkv)[0] // 3 // np.shape(value)[0], 1)
+
+    spatial = sd.get("cls_positional_encoding.pos_embed_spatial")
+    temporal = sd.get("cls_positional_encoding.pos_embed_temporal")
+    if spatial is not None and temporal is not None:
+        s, t = np.asarray(spatial), np.asarray(temporal)
+        hw, c = s.shape[1], s.shape[2]
+        h = int(round(float(np.sqrt(hw))))
+        if h * h == hw:
+            joint = (t[:, :, None, :] + s[:, None, :, :].reshape(1, 1, hw, c))
+            joint = joint.reshape(1, t.shape[1], h, h, c)
+            _set_path(out["params"], ("pos_embed",), joint.astype(np.float32))
+        else:
+            out["skipped"].append("cls_positional_encoding.pos_embed_spatial "
+                                  "(non-square grid)")
+
+    for key, value in sd.items():
+        arr = np.asarray(value)
+        if key.startswith("cls_positional_encoding."):
+            if (key.endswith("pos_embed_class") or key.endswith("cls_token")
+                    or spatial is not None):
+                continue  # consumed above / no CLS token in this arch
+            out["skipped"].append(key)
+            continue
+        if key == "patch_embed.patch_model.weight":
+            _set_path(out["params"], ("patch_embed", "kernel"),
+                      np.transpose(arr, (2, 3, 4, 1, 0)))
+            continue
+        if key == "patch_embed.patch_model.bias":
+            _set_path(out["params"], ("patch_embed", "bias"), arr)
+            continue
+        if key in ("norm.weight", "norm.bias"):
+            _set_path(out["params"],
+                      ("norm", "scale" if key.endswith("weight") else "bias"), arr)
+            continue
+        m = re.match(r"head\.proj\.(weight|bias)", key)
+        if m:
+            _set_path(out["params"],
+                      ("head", "kernel" if m.group(1) == "weight" else "bias"),
+                      convert_tensor(("head", "kernel"), arr)
+                      if m.group(1) == "weight" else arr)
+            continue
+        m = re.match(r"blocks\.(\d+)\.(.*)", key)
+        if not m:
+            out["skipped"].append(key)
+            continue
+        i, rest = int(m.group(1)), m.group(2)
+        block = f"block{i}"
+        pm = re.match(r"attn\.(pool_[qkv]|norm_[qkv])\.(\w+)", rest)
+        if pm:
+            name, leaf = pm.group(1), pm.group(2)
+            n_heads = heads.get(i, 1)
+            flax_pool = _MVIT_POOL[name]
+            if name.startswith("pool") and leaf == "weight":
+                # (head_dim,1,3,3,3) depthwise -> (3,3,3,1,heads*head_dim)
+                k = np.transpose(arr, (2, 3, 4, 1, 0))
+                _set_path(out["params"],
+                          (block, "attn", flax_pool, "pool", "kernel"),
+                          np.tile(k, (1, 1, 1, 1, n_heads)))
+            elif name.startswith("norm") and leaf in ("weight", "bias"):
+                _set_path(out["params"],
+                          (block, "attn", flax_pool, "norm",
+                           "scale" if leaf == "weight" else "bias"),
+                          np.tile(arr, n_heads))
+            else:
+                out["skipped"].append(key)
+            continue
+        for torch_name, (flax_name, leaf_map) in _MVIT_DIRECT.items():
+            m2 = re.match(rf"{re.escape(torch_name)}\.(weight|bias)$", rest)
+            if m2:
+                leaf = leaf_map[m2.group(1)]
+                path = (block,) + tuple(flax_name.split("/")) + (leaf,)
+                _set_path(out["params"], path, convert_tensor(path, arr))
+                break
+        else:
+            out["skipped"].append(key)
+    return out
+
+
 def convert_tensor(path: Path, arr: np.ndarray) -> np.ndarray:
     """Apply the torch->flax layout transpose for one tensor."""
     if path[-1] == "kernel":
@@ -241,6 +502,8 @@ def convert_state_dict(sd: Dict[str, np.ndarray], model: str) -> dict:
     Unrecognized keys are collected under "skipped" for caller inspection
     (hub checkpoints carry no extras for these models, but users' exports
     might)."""
+    if model.startswith("mvit"):
+        return convert_mvit_state_dict(sd)
     out: dict = {"params": {}, "batch_stats": {}, "skipped": []}
     for key, value in sd.items():
         arr = np.asarray(value)
@@ -308,8 +571,14 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
         if isinstance(sd, dict) and "state_dict" in sd:
             sd = sd["state_dict"]
         if not model:
-            model = ("slowfast" if any("multipathway" in k for k in sd)
-                     else "slow_r50")
+            if any("multipathway" in k for k in sd):
+                model = "slowfast"
+            elif any(k.startswith("cls_positional_encoding") for k in sd):
+                model = "mvit_b"
+            elif "blocks.0.conv.conv_t.weight" in sd:
+                model = "x3d_s"
+            else:
+                model = "slow_r50"
         source = convert_state_dict(
             {k: v.numpy() for k, v in sd.items()}, model
         )

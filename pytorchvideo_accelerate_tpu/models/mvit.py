@@ -56,11 +56,14 @@ class PoolHeads(nn.Module):
     def __call__(self, x):
         if self.stride == (1, 1, 1):
             return x
+        # fixed 3x3x3 pooling kernel at any stride — pytorchvideo's
+        # `pool_kvq_kernel` constant; also keeps the depthwise conv cheap and
+        # makes pretrained pool weights layout-convertible (models/convert.py)
         x = nn.Conv(
             self.channels,
-            kernel_size=tuple(s + 1 if s > 1 else 3 for s in self.stride),
+            kernel_size=(3, 3, 3),
             strides=self.stride,
-            padding=[((k := (s + 1 if s > 1 else 3)) // 2, k // 2) for s in self.stride],
+            padding=[(1, 1)] * 3,
             feature_group_count=self.channels,
             use_bias=False,
             dtype=self.dtype,

@@ -9,13 +9,18 @@ sharded over `fsdp`), and everything else follows from XLA's propagation.
 
 from __future__ import annotations
 
+import re
 from typing import Any, Optional
 
 import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from pytorchvideo_accelerate_tpu.parallel.mesh import AXIS_FSDP, BATCH_AXES
+from pytorchvideo_accelerate_tpu.parallel.mesh import (
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    BATCH_AXES,
+)
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
@@ -72,15 +77,80 @@ def fsdp_spec(shape, fsdp_size: int, min_size: int = 2**16) -> P:
     return P()
 
 
+# --- tensor parallelism (Megatron pattern over the `tensor` axis) ---------
+#
+# Column-parallel layers (qkv, mlp_fc1) shard their output-features dim and
+# bias; row-parallel layers (attention out-proj, mlp_fc2) shard the
+# input-features dim with a replicated bias — the reference backbone's
+# Megatron TP path (accelerate/accelerator.py:1580-1657, accelerator.py:2506)
+# expressed as GSPMD layout rules: XLA derives the all-gather/reduce-scatter
+# pairs from these annotations instead of hand-written comm hooks.
+# The rules key on the module names shared by every transformer family in
+# models/ (mvit.py / videomae.py ViTBlock): qkv, proj, mlp_fc1, mlp_fc2.
+_TP_COLUMN = frozenset({"qkv", "mlp_fc1"})
+_TP_ROW = frozenset({"proj", "mlp_fc2"})
+
+
+def _path_names(path) -> tuple:
+    """Flax pytree key path -> tuple of name strings."""
+    names = []
+    for k in path:
+        for attr in ("key", "idx", "name"):
+            if hasattr(k, attr):
+                names.append(str(getattr(k, attr)))
+                break
+        else:
+            names.append(str(k))
+    return tuple(names)
+
+
+def tp_dim(names: tuple, shape: tuple, tensor_size: int) -> Optional[int]:
+    """Which dim (if any) of this param shards over `tensor`."""
+    if tensor_size <= 1 or len(names) < 2 or len(shape) < 1:
+        return None
+    module, leaf = names[-2], names[-1]
+    # "proj" is also the name of classifier heads (x3d.py:138, resnet heads)
+    # and CubeEmbed's patchifying conv (videomae.py:100) — only the attention
+    # out-projection inside a transformer block is row-parallel
+    if module == "proj" and not (
+        len(names) >= 3
+        and (names[-3] == "attn" or re.match(r"block\d+$", names[-3]))
+    ):
+        return None
+    if module in _TP_COLUMN:
+        if leaf == "kernel" and shape[-1] % tensor_size == 0:
+            return len(shape) - 1
+        if leaf == "bias" and shape[0] % tensor_size == 0:
+            return 0
+    if (module in _TP_ROW and leaf == "kernel" and len(shape) >= 2
+            and shape[0] % tensor_size == 0):
+        return 0
+    return None
+
+
 def param_sharding(mesh: Mesh, params: Any, min_size: int = 2**16) -> Any:
     """Sharding tree for a param/opt-state pytree: replicated under pure DP,
-    fsdp-sharded (ZeRO-3 equivalent) when the fsdp axis is >1."""
+    fsdp-sharded (ZeRO-3 equivalent) when the fsdp axis is >1, and
+    Megatron-style tensor-sharded over `tensor` for transformer qkv/proj/MLP
+    params (composing with fsdp on a different dim where divisible)."""
     fsdp_size = mesh.shape[AXIS_FSDP]
+    tensor_size = mesh.shape.get(AXIS_TENSOR, 1)
 
-    def rule(x):
-        return NamedSharding(mesh, fsdp_spec(np.shape(x), fsdp_size, min_size))
+    def rule(path, x):
+        shape = tuple(np.shape(x))
+        d = tp_dim(_path_names(path), shape, tensor_size)
+        if d is None:
+            return NamedSharding(mesh, fsdp_spec(shape, fsdp_size, min_size))
+        spec = [None] * len(shape)
+        spec[d] = AXIS_TENSOR
+        if fsdp_size > 1 and np.prod(shape, dtype=np.int64) >= min_size:
+            for other in sorted(range(len(shape)), key=lambda i: -shape[i]):
+                if other != d and shape[other] % fsdp_size == 0:
+                    spec[other] = AXIS_FSDP
+                    break
+        return NamedSharding(mesh, P(*spec))
 
-    return jax.tree.map(rule, params)
+    return jax.tree_util.tree_map_with_path(rule, params)
 
 
 def shard_params(mesh: Mesh, params: Any, min_size: int = 2**16) -> Any:

@@ -40,17 +40,34 @@ class SumMetrics:
 
 @dataclass
 class MeanLoss:
-    """Running epoch-mean train loss (reference `total_loss` run.py:239,269)."""
+    """Running epoch-mean train loss (reference `total_loss` run.py:239,269).
+
+    `update_async` keeps device scalars un-fetched so the train loop never
+    blocks on a step's result before dispatching the next; the transfers
+    happen in one batched `device_get` at `mean()` (epoch end).
+    """
 
     total: float = 0.0
     n: int = 0
+    pending: list = field(default_factory=list)
 
     def update(self, loss) -> None:
         self.total += float(loss)
         self.n += 1
 
+    def update_async(self, loss) -> None:
+        self.pending.append(loss)
+
+    def _drain(self) -> None:
+        if self.pending:
+            for v in jax.device_get(self.pending):
+                self.total += float(v)
+                self.n += 1
+            self.pending = []
+
     def mean(self) -> float:
+        self._drain()
         return self.total / max(self.n, 1)
 
     def reset(self) -> None:
-        self.total, self.n = 0.0, 0
+        self.total, self.n, self.pending = 0.0, 0, []

@@ -196,8 +196,18 @@ def make_pretrain_eval_step(model, mesh) -> Callable:
             {"params": state.params}, batch["video"], train=False,
             rngs={"mask": jax.random.key(0)},
         )
-        count = jnp.asarray(batch["video"].shape[0], jnp.float32)
-        return {"loss_sum": out["loss"] * count,
+        mask = batch.get("mask")
+        if mask is None:
+            mask = jnp.ones((batch["video"].shape[0],), jnp.float32)
+        # per-sample recon loss from pred/target so zero-padded val-tail
+        # clips don't bias the mean (parity with the supervised eval fix)
+        per_sample = jnp.mean(
+            (out["pred"].astype(jnp.float32)
+             - out["target"].astype(jnp.float32)) ** 2,
+            axis=tuple(range(1, out["pred"].ndim)),
+        )
+        count = mask.sum()
+        return {"loss_sum": (per_sample * mask).sum(),
                 "correct": jnp.zeros((), jnp.float32), "count": count}
 
     return jax.jit(eval_step)

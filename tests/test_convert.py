@@ -172,6 +172,166 @@ def test_head_swap_keeps_fresh_head(tmp_path, slow_vars):
     )
 
 
+@pytest.fixture(scope="module")
+def x3d_vars():
+    from pytorchvideo_accelerate_tpu.models.x3d import X3D
+
+    model = X3D(num_classes=7, depths=(1, 1, 1, 1))
+    return model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+
+
+def test_x3d_full_tree_round_trip(x3d_vars):
+    """Every X3D param/batch_stat maps pytorchvideo-create_x3d-key -> flax
+    path with the right layout (VERDICT r2 missing #3)."""
+    sd = _fake_torch_sd(x3d_vars, "x3d_s")
+    converted = convert_state_dict(sd, "x3d_s")
+    assert converted["skipped"] == []
+    for coll in ("params", "batch_stats"):
+        want = dict(_leaves(x3d_vars[coll]))
+        got = dict(_leaves(converted[coll]))
+        assert set(got) == set(want), (
+            f"extra={set(got) - set(want)} missing={set(want) - set(got)}"
+        )
+        for path in want:
+            assert got[path].shape == tuple(want[path].shape), path
+
+
+def test_x3d_key_spot_checks():
+    assert map_torch_key("blocks.0.conv.conv_t.weight", "x3d_s") \
+        == ("params", ("stem_xy", "kernel"))  # conv_t slot holds the SPATIAL conv
+    assert map_torch_key("blocks.0.conv.conv_xy.weight", "x3d_s") \
+        == ("params", ("stem_t", "kernel"))
+    assert map_torch_key(
+        "blocks.1.res_blocks.0.branch2.norm_b.1.fc1.weight", "x3d_s"
+    ) == ("params", ("res2_block0", "se", "fc1", "kernel"))
+    assert map_torch_key(
+        "blocks.1.res_blocks.0.branch2.norm_b.0.running_mean", "x3d_s"
+    ) == ("batch_stats", ("res2_block0", "norm_b", "mean"))
+    # non-SE blocks carry a plain BN at norm_b
+    assert map_torch_key(
+        "blocks.1.res_blocks.1.branch2.norm_b.weight", "x3d_s"
+    ) == ("params", ("res2_block1", "norm_b", "scale"))
+    assert map_torch_key("blocks.5.pool.post_conv.weight", "x3d_s") \
+        == ("params", ("head_conv", "kernel"))
+    assert map_torch_key("blocks.5.proj.bias", "x3d_s") \
+        == ("params", ("proj", "bias"))
+
+
+def test_x3d_merge_head_swap(tmp_path, x3d_vars):
+    from pytorchvideo_accelerate_tpu.models.x3d import X3D
+
+    sd = _fake_torch_sd(x3d_vars, "x3d_s")
+    tree = convert_state_dict(sd, "x3d_s")
+    path = str(tmp_path / "x3d.npz")
+    save_converted(tree, path)
+    target = X3D(num_classes=11, depths=(1, 1, 1, 1)).init(
+        jax.random.key(1), jnp.zeros((1, 4, 32, 32, 3))
+    )
+    merged, report = load_pretrained(path, target)
+    kept = set(report["kept"])
+    assert kept == {"params/proj/kernel", "params/proj/bias"}, kept
+
+
+class TestMViTConvert:
+    """MViT conversion: pos-embed synthesis from separable tables, per-head
+    pool tiling, qkv/proj/mlp mapping (VERDICT r2 missing #3; deviations
+    documented at convert.py's MViT section)."""
+
+    T, S = 4, 32  # input -> token grid (2, 8, 8) after stride (2,4,4)
+
+    def _model(self, num_classes=7):
+        from pytorchvideo_accelerate_tpu.models.mvit import MViT
+
+        return MViT(num_classes=num_classes, depth=2, embed_dim=16,
+                    num_heads=2, stage_starts=(), initial_kv_stride=(1, 2, 2),
+                    drop_path_rate=0.0, dropout_rate=0.0)
+
+    def _fake_sd(self, seed=0):
+        """pytorchvideo-style state_dict for the tiny config above."""
+        rng = np.random.default_rng(seed)
+        dim, heads, head_dim = 16, 2, 8
+        t, h, w = 2, 8, 8
+
+        def randn(*shape):
+            return rng.standard_normal(shape).astype(np.float32)
+
+        sd = {
+            "patch_embed.patch_model.weight": randn(dim, 3, 3, 7, 7),
+            "patch_embed.patch_model.bias": randn(dim),
+            "cls_positional_encoding.pos_embed_spatial": randn(1, h * w, dim),
+            "cls_positional_encoding.pos_embed_temporal": randn(1, t, dim),
+            "cls_positional_encoding.pos_embed_class": randn(1, 1, dim),
+            "norm.weight": randn(dim),
+            "norm.bias": randn(dim),
+            "head.proj.weight": randn(7, dim),
+            "head.proj.bias": randn(7),
+        }
+        for i in range(2):
+            p = f"blocks.{i}"
+            sd.update({
+                f"{p}.norm1.weight": randn(dim),
+                f"{p}.norm1.bias": randn(dim),
+                f"{p}.attn.qkv.weight": randn(3 * dim, dim),
+                f"{p}.attn.qkv.bias": randn(3 * dim),
+                f"{p}.attn.pool_k.weight": randn(head_dim, 1, 3, 3, 3),
+                f"{p}.attn.norm_k.weight": randn(head_dim),
+                f"{p}.attn.norm_k.bias": randn(head_dim),
+                f"{p}.attn.pool_v.weight": randn(head_dim, 1, 3, 3, 3),
+                f"{p}.attn.norm_v.weight": randn(head_dim),
+                f"{p}.attn.norm_v.bias": randn(head_dim),
+                f"{p}.attn.proj.weight": randn(dim, dim),
+                f"{p}.attn.proj.bias": randn(dim),
+                f"{p}.norm2.weight": randn(dim),
+                f"{p}.norm2.bias": randn(dim),
+                f"{p}.mlp.fc1.weight": randn(4 * dim, dim),
+                f"{p}.mlp.fc1.bias": randn(4 * dim),
+                f"{p}.mlp.fc2.weight": randn(dim, 4 * dim),
+                f"{p}.mlp.fc2.bias": randn(dim),
+            })
+        return sd
+
+    def test_pos_embed_outer_sum(self):
+        sd = self._fake_sd()
+        tree = convert_state_dict(sd, "mvit_b")
+        pos = dict(_leaves(tree["params"]))[("pos_embed",)]
+        assert pos.shape == (1, 2, 8, 8, 16)
+        s = sd["cls_positional_encoding.pos_embed_spatial"]
+        t = sd["cls_positional_encoding.pos_embed_temporal"]
+        np.testing.assert_allclose(
+            pos[0, 1, 3, 5], t[0, 1] + s[0, 3 * 8 + 5], rtol=1e-6)
+
+    def test_pool_tiling_is_exact(self):
+        sd = self._fake_sd()
+        tree = convert_state_dict(sd, "mvit_b")
+        leaves = dict(_leaves(tree["params"]))
+        k = leaves[("block0", "attn", "pool_k", "pool", "kernel")]
+        assert k.shape == (3, 3, 3, 1, 16)  # tiled heads*head_dim
+        src = sd["blocks.0.attn.pool_k.weight"]
+        # channel h*head_dim+c carries the same kernel as channel c
+        np.testing.assert_array_equal(k[..., 0, 8 + 3], src[3, 0])
+        ln = leaves[("block0", "attn", "pool_k", "norm", "scale")]
+        np.testing.assert_array_equal(ln[8:], ln[:8])
+
+    def test_merge_into_model(self, tmp_path):
+        sd = self._fake_sd()
+        tree = convert_state_dict(sd, "mvit_b")
+        assert tree["skipped"] == [], tree["skipped"]
+        path = str(tmp_path / "mvit.npz")
+        save_converted(tree, path)
+        model = self._model()
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, self.T, self.S, self.S, 3)))
+        merged, report = load_pretrained(path, variables)
+        loaded = set(report["loaded"])
+        for want in ("params/block0/attn/qkv/kernel",
+                     "params/block0/attn/pool_k/pool/kernel",
+                     "params/block1/mlp_fc2/kernel",
+                     "params/pos_embed",
+                     "params/patch_embed/kernel",
+                     "params/head/kernel"):
+            assert want in loaded, (want, sorted(report["kept"]))
+
+
 def test_torch_pt_on_the_fly(tmp_path, slow_vars):
     torch = pytest.importorskip("torch")
     sd = {k: torch.from_numpy(np.asarray(v))

@@ -1,0 +1,135 @@
+"""Tensor parallelism over the `tensor` mesh axis (VERDICT r2 missing #1).
+
+Megatron-style qkv/proj/MLP sharding expressed as GSPMD param layouts
+(parallel/sharding.py tp_dim): tensor=2 must match tensor=1 numerics on the
+transformer family, with XLA inserting the collectives.
+Reference anchor: accelerate/accelerator.py:1580-1657 (native TP path).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.config import MeshConfig, OptimConfig
+from pytorchvideo_accelerate_tpu.models.mvit import MViT
+from pytorchvideo_accelerate_tpu.models.videomae import VideoMAEClassifier
+from pytorchvideo_accelerate_tpu.parallel.mesh import (
+    AXIS_TENSOR,
+    make_mesh,
+)
+from pytorchvideo_accelerate_tpu.parallel.sharding import (
+    param_sharding,
+    shard_batch,
+    shard_params,
+    tp_dim,
+)
+from pytorchvideo_accelerate_tpu.trainer import (
+    TrainState,
+    build_optimizer,
+    make_train_step,
+)
+
+
+def tiny_mvit(num_classes=5):
+    return MViT(
+        num_classes=num_classes, depth=2, embed_dim=16, num_heads=2,
+        stage_starts=(1,), drop_path_rate=0.0, dropout_rate=0.0,
+    )
+
+
+def _forward(mesh, model, variables, video):
+    params = shard_params(mesh, variables["params"], min_size=0)
+    gb = shard_batch(mesh, {"video": video})
+
+    @jax.jit
+    def fwd(p, v):
+        return model.apply({"params": p}, v)
+
+    return np.asarray(fwd(params, gb["video"]))
+
+
+class TestTpRules:
+    def test_column_and_row_rules(self):
+        assert tp_dim(("block0", "attn", "qkv", "kernel"), (16, 48), 2) == 1
+        assert tp_dim(("block0", "attn", "qkv", "bias"), (48,), 2) == 0
+        assert tp_dim(("block0", "mlp_fc1", "kernel"), (16, 64), 2) == 1
+        assert tp_dim(("block0", "mlp_fc1", "bias"), (64,), 2) == 0
+        assert tp_dim(("block0", "attn", "proj", "kernel"), (16, 16), 2) == 0
+        assert tp_dim(("block0", "mlp_fc2", "kernel"), (64, 16), 2) == 0
+
+    def test_excluded_params(self):
+        # row-parallel bias stays replicated (added after the psum)
+        assert tp_dim(("block0", "attn", "proj", "bias"), (16,), 2) is None
+        assert tp_dim(("block0", "mlp_fc2", "bias"), (16,), 2) is None
+        # the patchifying conv is also named "proj" — not a projection
+        assert tp_dim(("patch_embed", "proj", "kernel"), (2, 16, 16, 3, 96), 2) is None
+        # indivisible dims stay replicated rather than erroring
+        assert tp_dim(("b", "qkv", "kernel"), (16, 45), 2) is None
+        assert tp_dim(("b", "norm1", "scale"), (16,), 2) is None
+
+    def test_param_sharding_uses_tensor_axis(self, devices8):
+        mesh = make_mesh(MeshConfig(data=4, tensor=2), devices=devices8)
+        model = tiny_mvit()
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+        shardings = param_sharding(mesh, variables["params"], min_size=0)
+        flat = {
+            "/".join(str(getattr(k, "key", k)) for k in path): s
+            for path, s in jax.tree_util.tree_flatten_with_path(shardings)[0]
+        }
+        assert flat["block0/attn/qkv/kernel"].spec[-1] == AXIS_TENSOR
+        assert flat["block0/attn/proj/kernel"].spec[0] == AXIS_TENSOR
+        assert flat["block0/mlp_fc1/kernel"].spec[-1] == AXIS_TENSOR
+        assert flat["block0/mlp_fc2/kernel"].spec[0] == AXIS_TENSOR
+        # non-TP params fall through to the fsdp/replicated rule
+        assert AXIS_TENSOR not in jax.tree_util.tree_leaves(
+            [flat["patch_embed/kernel"].spec]
+        )
+
+
+class TestTpNumerics:
+    @pytest.mark.parametrize("model_fn", [
+        tiny_mvit,
+        lambda: VideoMAEClassifier(num_classes=5, dim=32, depth=2, num_heads=2,
+                                   dropout_rate=0.0),
+    ], ids=["mvit", "videomae_cls"])
+    def test_forward_tensor2_matches_tensor1(self, devices8, model_fn):
+        model = model_fn()
+        t, s = (4, 32) if isinstance(model, MViT) else (4, 32)
+        video = np.random.default_rng(0).standard_normal(
+            (8, t, s, s, 3)).astype(np.float32)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, t, s, s, 3)))
+        mesh1 = make_mesh(MeshConfig(data=8), devices=devices8)
+        mesh2 = make_mesh(MeshConfig(data=4, tensor=2), devices=devices8)
+        out1 = _forward(mesh1, model, variables, video)
+        out2 = _forward(mesh2, model, variables, video)
+        np.testing.assert_allclose(out1, out2, rtol=2e-5, atol=2e-5)
+
+    def test_train_step_tensor2_matches_tensor1(self, devices8):
+        model = tiny_mvit()
+        rng = np.random.default_rng(1)
+        batch = {
+            "video": rng.standard_normal((8, 4, 32, 32, 3)).astype(np.float32),
+            "label": rng.integers(0, 5, 8).astype(np.int32),
+        }
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+        # host copy: the donated train step deletes its input buffers, which
+        # can alias the init arrays when device_put is a no-op placement
+        params_host = jax.tree.map(np.asarray, variables["params"])
+        tx = build_optimizer(OptimConfig(), total_steps=4)
+
+        losses = {}
+        for name, cfg in [("dp", MeshConfig(data=8)),
+                          ("tp", MeshConfig(data=4, tensor=2))]:
+            mesh = make_mesh(cfg, devices=jax.devices()[:8])
+            params = shard_params(mesh, params_host, min_size=0)
+            state = TrainState.create(params, {}, tx)
+            step = make_train_step(model, tx, mesh)
+            gb = shard_batch(mesh, batch)
+            seq = []
+            for i in range(2):
+                state, metrics = step(state, gb, jax.random.key(5))
+                seq.append(float(metrics["loss"]))
+            losses[name] = seq
+        np.testing.assert_allclose(losses["dp"], losses["tp"],
+                                   rtol=5e-5, atol=5e-5)
