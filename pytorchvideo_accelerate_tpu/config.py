@@ -56,6 +56,9 @@ class DataConfig:
     std: tuple = (0.225, 0.225, 0.225)
     horizontal_flip_p: float = 0.5
     decode_audio: bool = False
+    # multi-view val: views/video with view-averaged logits (the reference's
+    # uniform clip-tiling eval, run.py:163); 1 = single center clip
+    eval_num_clips: int = 1
     limit_train_batches: int = -1  # run.py:385
     limit_val_batches: int = -1
 

@@ -66,12 +66,17 @@ class VideoClipSource(ClipSource):
         clip_duration: float,
         training: bool,
         seed: int = 42,
+        num_clips: int = 1,
     ):
         self.manifest = manifest
         self.transform = transform
         self.clip_duration = clip_duration
         self.training = training
         self.seed = seed
+        # eval-only multi-view: `num_clips` evenly-spaced views per video,
+        # stacked on a leading axis; the eval step view-averages the logits
+        # in-graph (reference uniform-sampler tiling, run.py:163)
+        self.num_clips = max(num_clips, 1) if not training else 1
         self.num_classes = manifest.num_classes
         self._meta_cache: Dict[str, decode_mod.VideoMeta] = {}
         self._meta_lock = threading.Lock()
@@ -93,11 +98,18 @@ class VideoClipSource(ClipSource):
         meta = self._meta(entry.path)
         rng = np.random.default_rng((self.seed, epoch, index))
         if self.training:
-            span = random_clip(meta.duration, self.clip_duration, rng)
+            spans = [random_clip(meta.duration, self.clip_duration, rng)]
         else:
-            span = uniform_clips(meta.duration, self.clip_duration, 1)[0]
-        frames = decode_mod.decode_span(entry.path, span.start, span.end)
-        out = self.transform(frames, rng)
+            spans = uniform_clips(meta.duration, self.clip_duration,
+                                  self.num_clips)
+        views = []
+        for span in spans:
+            frames = decode_mod.decode_span(entry.path, span.start, span.end)
+            views.append(self.transform(frames, rng))
+        if len(views) == 1 and self.num_clips == 1:
+            out = views[0]
+        else:  # (V, ...) per key
+            out = {k: np.stack([v[k] for v in views]) for k in views[0]}
         out["label"] = np.int32(entry.label)
         return out
 
@@ -115,6 +127,7 @@ class SyntheticClipSource(ClipSource):
         raw_frames: int = 24,
         raw_size: tuple = (72, 96),
         seed: int = 42,
+        num_clips: int = 1,
     ):
         self.transform = transform
         self.num_videos = num_videos
@@ -122,6 +135,7 @@ class SyntheticClipSource(ClipSource):
         self.raw_frames = raw_frames
         self.raw_size = raw_size
         self.seed = seed
+        self.num_clips = max(num_clips, 1)
 
     def __len__(self) -> int:
         return self.num_videos
@@ -130,9 +144,15 @@ class SyntheticClipSource(ClipSource):
         label = index % self.num_classes
         rng = np.random.default_rng((self.seed, epoch, index))
         h, w = self.raw_size
-        frames = (rng.random((self.raw_frames, h, w, 3)) * 60).astype(np.uint8)
-        frames += np.uint8(label * (160 // max(self.num_classes - 1, 1)))
-        out = self.transform(frames, rng)
+        views = []
+        for _ in range(self.num_clips):
+            frames = (rng.random((self.raw_frames, h, w, 3)) * 60).astype(np.uint8)
+            frames += np.uint8(label * (160 // max(self.num_classes - 1, 1)))
+            views.append(self.transform(frames, rng))
+        if self.num_clips == 1:
+            out = views[0]
+        else:
+            out = {k: np.stack([v[k] for v in views]) for k in views[0]}
         out["label"] = np.int32(label)
         return out
 

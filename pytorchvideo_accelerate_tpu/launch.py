@@ -1,0 +1,156 @@
+"""Local process launcher — the `accelerate launch` equivalent.
+
+The reference wraps every run in `accelerate launch run.py ...`
+(`/root/reference/run_slowfast_r50.sh:1`), which spawns N processes and
+wires RANK/WORLD_SIZE/MASTER_ADDR before `init_process_group`
+(accelerate/commands/launch.py:986-1030). The TPU-native equivalent is much
+smaller because device collectives need no process-group bootstrap — XLA
+compiles them from shardings — but multi-HOST runs still need one process
+per host wired to a coordinator (`jax.distributed`). This launcher:
+
+- spawns `--num_processes` local Python processes, each with the `PVA_*`
+  env contract consumed by `parallel.distributed.initialize_distributed`
+  (PVA_COORDINATOR_ADDRESS / PVA_NUM_PROCESSES / PVA_PROCESS_ID);
+- picks a free coordinator port when none is given;
+- streams rank-0 output through, prefixes other ranks' lines;
+- tears the group down on the first failure and propagates the exit code.
+
+On a real TPU pod the per-host process is normally started by the pod
+scheduler and `initialize_distributed` self-configures; this launcher's
+production role is single-host multi-process runs and — exactly like the
+backbone's own test strategy (SURVEY §4.1: accelerate launches 2-process
+CPU/gloo jobs in its test suite) — real multi-process integration tests on
+CPU (tests/test_launch.py).
+
+Usage:
+    python -m pytorchvideo_accelerate_tpu.launch --num_processes 2 -- \
+        --cpu --synthetic --optim.num_epochs 1 ...         # default module
+    python -m pytorchvideo_accelerate_tpu.launch --num_processes 2 -- \
+        my_script.py --my-flag                             # arbitrary script
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import subprocess
+import time
+import sys
+import threading
+from typing import List, Optional, Sequence
+
+
+def find_free_port() -> int:
+    with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _forward(stream, rank: int) -> None:
+    for line in iter(stream.readline, b""):
+        sys.stderr.buffer.write(f"[rank {rank}] ".encode() + line)
+        sys.stderr.buffer.flush()
+    stream.close()
+
+
+def build_commands(num_processes: int, prog: List[str]) -> List[List[str]]:
+    if prog and prog[0].endswith(".py"):
+        base = [sys.executable, *prog]
+    else:
+        base = [sys.executable, "-m", "pytorchvideo_accelerate_tpu.run", *prog]
+    return [list(base) for _ in range(num_processes)]
+
+
+def launch(num_processes: int, prog: List[str],
+           coordinator_address: str = "", env_extra: Optional[dict] = None,
+           timeout: Optional[float] = None) -> int:
+    """Spawn the process group; returns the first non-zero exit code or 0."""
+    if num_processes < 1:
+        raise ValueError(f"--num_processes must be >= 1, got {num_processes}")
+    coordinator_address = (
+        coordinator_address or f"127.0.0.1:{find_free_port()}"
+    )
+    cmds = build_commands(num_processes, prog)
+    procs: List[subprocess.Popen] = []
+    threads: List[threading.Thread] = []
+    for rank, cmd in enumerate(cmds):
+        env = dict(os.environ)
+        env.update(env_extra or {})
+        env.update({
+            "PVA_COORDINATOR_ADDRESS": coordinator_address,
+            "PVA_NUM_PROCESSES": str(num_processes),
+            "PVA_PROCESS_ID": str(rank),
+        })
+        if rank == 0:
+            p = subprocess.Popen(cmd, env=env)
+        else:
+            p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
+                                 stderr=subprocess.STDOUT)
+            t = threading.Thread(target=_forward, args=(p.stdout, rank),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+        procs.append(p)
+
+    rc = 0
+    deadline = (time.monotonic() + timeout) if timeout else None
+    try:
+        # any-child semantics: tear the group down as soon as ANY rank fails
+        # (a dead peer leaves the others blocked in a collective forever),
+        # with ONE group-level deadline rather than a per-process clock
+        while True:
+            codes = [p.poll() for p in procs]
+            bad = next((c for c in codes if c), None)
+            if bad is not None:
+                rc = bad
+                break
+            if all(c == 0 for c in codes):
+                break
+            if deadline is not None and time.monotonic() > deadline:
+                rc = 124
+                break
+            time.sleep(0.2)
+    except KeyboardInterrupt:
+        rc = rc or 130
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        for p in procs:
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        for t in threads:
+            t.join(timeout=5)
+    return rc
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pytorchvideo_accelerate_tpu.launch", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--num_processes", type=int, default=1,
+                    help="local processes to spawn (accelerate --num_processes)")
+    ap.add_argument("--coordinator_address", default="",
+                    help="host:port of the jax.distributed coordinator "
+                         "(default: 127.0.0.1 with a free port)")
+    ap.add_argument("--timeout", type=float, default=None,
+                    help="kill the group after this many seconds")
+    ap.add_argument("prog", nargs=argparse.REMAINDER,
+                    help="script.py + args, or args for the default "
+                         "training module")
+    args = ap.parse_args(argv)
+    prog = args.prog
+    if prog and prog[0] == "--":
+        prog = prog[1:]
+    return launch(args.num_processes, prog,
+                  coordinator_address=args.coordinator_address,
+                  timeout=args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

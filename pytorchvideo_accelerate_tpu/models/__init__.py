@@ -43,6 +43,16 @@ def _slow_r50(cfg: ModelConfig, dtype, mesh=None):
     )
 
 
+@register_model("tiny3d")
+def _tiny3d(cfg: ModelConfig, dtype, mesh=None):
+    """Deliberately tiny Slow-style net for integration tests / CLI smokes
+    (compiles in seconds on a CPU host; not a reference architecture)."""
+    return SlowR50(
+        num_classes=cfg.num_classes, depths=(1, 1, 1, 1), stem_features=8,
+        dropout_rate=cfg.dropout_rate, dtype=dtype,
+    )
+
+
 @register_model("slowfast_r50")
 def _slowfast_r50(cfg: ModelConfig, dtype, mesh=None):
     return SlowFast(

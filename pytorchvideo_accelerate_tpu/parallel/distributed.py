@@ -56,6 +56,14 @@ def initialize_distributed(
                 f"is configured; got {process_id}. Set PVA_PROCESS_ID or "
                 f"--process_id on every host."
             )
+        # cross-process collectives on the CPU backend need gloo (the same
+        # transport accelerate's 2-process CPU tests use, SURVEY §4.1);
+        # harmless no-op on TPU where ICI/DCN collectives come from XLA
+        if str(getattr(jax.config, "jax_platforms", "") or "").startswith("cpu"):
+            try:
+                jax.config.update("jax_cpu_collectives_implementation", "gloo")
+            except Exception:  # older jax: flag absent, mpi-only builds
+                logger.warning("could not enable gloo cpu collectives")
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,

@@ -110,6 +110,10 @@ class Trainer:
                 use_async=cfg.checkpoint.async_checkpoint,
             )
 
+        # user-registered checkpoint participants (reference
+        # `accelerator.register_for_checkpointing`, run.py:199)
+        self._registered: dict = {}
+
         self.trackers: Optional[TrackerHub] = None
         if cfg.tracking.with_tracking and is_main_process():
             run_name = (
@@ -139,6 +143,12 @@ class Trainer:
         train_tf = make_transform(training=True, **common)
         val_tf = make_transform(training=False, **common)
 
+        # multi-view eval is supervised-only: the pretrain eval step scores
+        # reconstructions clip-by-clip, so a view axis would just crash it
+        eval_clips = 1 if self.is_pretraining else d.eval_num_clips
+        if self.is_pretraining and d.eval_num_clips > 1:
+            main_print("eval_num_clips ignored for self-supervised pretraining")
+
         if d.synthetic:
             num_classes = cfg.model.num_classes or 4
             self.train_source = SyntheticClipSource(
@@ -148,6 +158,7 @@ class Trainer:
             self.val_source = SyntheticClipSource(
                 val_tf, num_videos=max(d.synthetic_num_videos // 4, 4),
                 num_classes=num_classes, seed=cfg.seed + 1,
+                num_clips=eval_clips,
             )
         else:
             train_manifest = scan_directory(os.path.join(d.data_dir, "train"))
@@ -159,7 +170,7 @@ class Trainer:
             )
             self.val_source = VideoClipSource(
                 val_manifest, val_tf, cfg.clip_duration, training=False,
-                seed=cfg.seed,
+                seed=cfg.seed, num_clips=eval_clips,
             )
         self.num_classes = num_classes
 
@@ -248,6 +259,21 @@ class Trainer:
                 self.model, self.mesh, label_smoothing=cfg.optim.label_smoothing
             )
 
+    def register_for_checkpointing(self, name: str, obj) -> None:
+        """Add a custom object to every checkpoint (reference
+        `accelerator.register_for_checkpointing(lr_scheduler)`, run.py:199).
+
+        `obj` must expose `state_dict() -> dict` (JSON-serializable) and
+        `load_state_dict(dict)`; its state is saved with each checkpoint and
+        restored on resume, keyed by `name`."""
+        if not (callable(getattr(obj, "state_dict", None))
+                and callable(getattr(obj, "load_state_dict", None))):
+            raise TypeError(
+                f"{type(obj).__name__} needs state_dict()/load_state_dict() "
+                "methods to be registered for checkpointing"
+            )
+        self._registered[name] = obj
+
     # --- resume -----------------------------------------------------------
 
     def _maybe_resume(self) -> int:
@@ -265,6 +291,9 @@ class Trainer:
             self.state, mesh=self.mesh
         )
         main_print(f"resumed from checkpoint step {step}")
+        for name, obj in self._registered.items():
+            if name in extra.get("registered", {}):
+                obj.load_state_dict(extra["registered"][name])
         data_state = LoaderState.from_dict(extra.get("data_state"))
         # epoch-end checkpoints restart at the next epoch (reference
         # `epoch_{i} -> starting_epoch=i+1`, run.py:218-219); mid-epoch ones
@@ -286,6 +315,8 @@ class Trainer:
                 "data_state": self.train_loader.state.to_dict(),
                 "num_classes": self.num_classes,
                 "model": self.cfg.model.name,
+                "registered": {n: o.state_dict()
+                               for n, o in self._registered.items()},
             },
         )
 

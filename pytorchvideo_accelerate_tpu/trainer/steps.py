@@ -216,18 +216,36 @@ def make_pretrain_eval_step(model, mesh) -> Callable:
 def make_eval_step(model, mesh, label_smoothing: float = 0.0) -> Callable:
     """Build `eval_step(state, batch) -> {loss_sum, correct, count}` —
     in-graph masked sums; the host just adds them across batches
-    (trainer/metrics.py), nothing to gather."""
+    (trainer/metrics.py), nothing to gather.
+
+    Multi-view eval (reference uniform-sampler tiling, run.py:163): when the
+    clip leaves carry a view axis — (B, V, T, H, W, C) from a
+    `num_clips > 1` source — the views are folded into the batch for the
+    forward pass (one big MXU-friendly batch) and the logits are
+    view-averaged in-graph before the argmax."""
 
     def eval_step(state: TrainState, batch: dict) -> dict:
         batch = _constrain_batch(batch, mesh, leading_micro=False)
         mask = batch.get("mask")
         if mask is None:
             mask = jnp.ones(batch["label"].shape, jnp.float32)
+        inputs = model_inputs(batch)
+        first = inputs[0] if isinstance(inputs, tuple) else inputs
+        num_views = first.shape[1] if first.ndim == 6 else 1
+        if num_views > 1:
+            inputs = jax.tree.map(
+                lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+                inputs,
+            )
         logits = model.apply(
             {"params": state.params, "batch_stats": state.batch_stats},
-            model_inputs(batch),
+            inputs,
             train=False,
         )
+        if num_views > 1:
+            logits = logits.astype(jnp.float32).reshape(
+                -1, num_views, logits.shape[-1]
+            ).mean(axis=1)
         loss, correct, count = _loss_and_metrics(
             logits, batch["label"], mask, label_smoothing
         )

@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Canonical SlowFast-R50 fine-tune recipe — the TPU-native equivalent of the
+# reference's `run_slowfast_r50.sh` (accelerate launch run.py ...), flag for
+# flag. Reference aliases (--is_slowfast, --pin_memory, fp16) are accepted
+# by the CLI and mapped to their TPU meanings (config.py REFERENCE_ALIASES):
+# fp16 AMP -> bf16 compute, pin_memory is a no-op on TPU hosts.
+#
+# Single host (the TPU runtime is one process per host; no launcher needed):
+set -euo pipefail
+
+python -m pytorchvideo_accelerate_tpu.run \
+  --data_dir "${DATA_DIR:-/data/kinetics}" \
+  --output_dir outputs \
+  --batch_size 8 \
+  --num_workers 8 \
+  --gradient_accumulation_steps 4 \
+  --checkpointing_steps epoch \
+  --mixed_precision fp16 \
+  --with_tracking \
+  --num_frames 32 \
+  --sampling_rate 2 \
+  --is_slowfast \
+  --pin_memory \
+  "$@"
+
+# Multi-host pods: start this script once per host (your pod scheduler's
+# job); `jax.distributed` self-configures from TPU metadata. For manual
+# wiring or local multi-process runs, use the launcher instead:
+#   python -m pytorchvideo_accelerate_tpu.launch --num_processes 2 -- \
+#     --cpu --synthetic --optim.num_epochs 1

@@ -113,3 +113,40 @@ def test_grad_accum_end_to_end(tmp_path):
     r = Trainer(cfg).fit()
     # 16 videos / (global 8 x accum 2) = 1 optimizer step
     assert r["steps"] == 1
+
+
+def test_register_for_checkpointing_round_trip(tmp_path):
+    """Custom objects ride every checkpoint and restore on resume
+    (reference `accelerator.register_for_checkpointing`, run.py:199)."""
+
+    class EmaTracker:
+        def __init__(self):
+            self.value = 0.0
+            self.updates = 0
+
+        def state_dict(self):
+            return {"value": self.value, "updates": self.updates}
+
+        def load_state_dict(self, d):
+            self.value, self.updates = d["value"], d["updates"]
+
+    cfg = _cfg(tmp_path, **{"checkpoint.checkpointing_steps": "epoch",
+                            "optim.num_epochs": 1})
+    tr = Trainer(cfg)
+    ema = EmaTracker()
+    ema.value, ema.updates = 3.25, 7
+    tr.register_for_checkpointing("ema", ema)
+    tr.fit()
+
+    cfg2 = _cfg(tmp_path, **{"checkpoint.checkpointing_steps": "epoch",
+                             "optim.num_epochs": 2,
+                             "checkpoint.resume_from_checkpoint": "auto"})
+    tr2 = Trainer(cfg2)
+    ema2 = EmaTracker()
+    tr2.register_for_checkpointing("ema", ema2)
+    tr2._maybe_resume()
+    assert ema2.value == 3.25 and ema2.updates == 7
+
+    import pytest as _pytest
+    with _pytest.raises(TypeError):
+        tr2.register_for_checkpointing("bad", object())

@@ -1,0 +1,80 @@
+"""Launcher (SURVEY A10/R3; VERDICT r2 missing #2): command building, and a
+REAL 2-process CPU integration run — the backbone's own core test trick
+(accelerate launches 2-process gloo jobs in its suite, SURVEY §4.1).
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from pytorchvideo_accelerate_tpu.launch import build_commands, find_free_port
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_build_commands_default_module():
+    cmds = build_commands(2, ["--cpu", "--synthetic"])
+    assert len(cmds) == 2
+    assert cmds[0][:3] == [sys.executable, "-m",
+                           "pytorchvideo_accelerate_tpu.run"]
+    assert cmds[0][3:] == ["--cpu", "--synthetic"]
+
+
+def test_build_commands_script():
+    cmds = build_commands(1, ["train.py", "--flag"])
+    assert cmds[0] == [sys.executable, "train.py", "--flag"]
+
+
+def test_find_free_port_is_bindable():
+    import socket
+
+    port = find_free_port()
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", port))
+
+
+def test_two_process_cpu_training(tmp_path):
+    """Spawn 2 real processes through the launcher; they rendezvous via
+    jax.distributed, build a 2-device global mesh (1 CPU device per
+    process), interleave per-process data shards, and train 2 steps with
+    gloo-backed collectives."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # one CPU device per process (the conftest's 8-device flag would give 16)
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    cmd = [
+        sys.executable, "-m", "pytorchvideo_accelerate_tpu.launch",
+        "--num_processes", "2", "--timeout", "420", "--",
+        "--cpu", "--synthetic", "--data.synthetic_num_videos", "8",
+        "--model.name", "tiny3d", "--model.num_classes", "4",
+        "--data.num_frames", "4", "--data.crop_size", "32",
+        "--data.batch_size", "2", "--data.num_workers", "1",
+        "--optim.num_epochs", "1", "--limit_train_batches", "2",
+        "--limit_val_batches", "1",
+        "--output_dir", str(tmp_path / "out"),
+    ]
+    proc = subprocess.run(cmd, env=env, cwd=str(tmp_path),
+                          capture_output=True, text=True, timeout=600)
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert "2 process(es)" in out, out[-4000:]
+    assert "epoch 0" in out, out[-4000:]
+
+
+def test_failure_propagates_and_tears_down(tmp_path):
+    """A crashing rank must fail the whole group with its exit code."""
+    bad = tmp_path / "bad.py"
+    bad.write_text(
+        "import os, sys\n"
+        "sys.exit(3 if os.environ['PVA_PROCESS_ID'] == '1' else 0)\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorchvideo_accelerate_tpu.launch",
+         "--num_processes", "2", "--timeout", "60", "--", str(bad)],
+        env=env, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 3

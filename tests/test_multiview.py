@@ -1,0 +1,108 @@
+"""Multi-view uniform eval (VERDICT r2 missing #4; reference run.py:163
+uniform clip tiling): sources stack `num_clips` views per video; the eval
+step folds views into the batch and view-averages logits in-graph.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.config import MeshConfig, OptimConfig
+from pytorchvideo_accelerate_tpu.data.pipeline import SyntheticClipSource
+from pytorchvideo_accelerate_tpu.data.samplers import uniform_clips
+from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
+from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+from pytorchvideo_accelerate_tpu.trainer import TrainState, build_optimizer
+from pytorchvideo_accelerate_tpu.trainer.steps import make_eval_step
+
+
+def _tf(**kw):
+    return make_transform(training=False, num_frames=4, crop_size=32,
+                          min_short_side_scale=36, max_short_side_scale=36,
+                          **kw)
+
+
+def test_uniform_clips_spacing():
+    spans = uniform_clips(10.0, 2.0, 3)
+    starts = [s.start for s in spans]
+    np.testing.assert_allclose(starts, [0.0, 4.0, 8.0])
+    assert all(abs((s.end - s.start) - 2.0) < 1e-9 for s in spans)
+
+
+def test_synthetic_source_stacks_views():
+    src = SyntheticClipSource(_tf(), num_videos=4, num_classes=2, num_clips=3)
+    s = src.get(0, 0)
+    assert s["video"].shape == (3, 4, 32, 32, 3)
+    assert s["label"].shape == ()
+    single = SyntheticClipSource(_tf(), num_videos=4, num_classes=2)
+    assert single.get(0, 0)["video"].shape == (4, 32, 32, 3)
+
+
+class TestViewAveragedEval:
+    def _setup(self, devices8):
+        mesh = make_mesh(MeshConfig(data=8), devices=devices8)
+        model = SlowR50(num_classes=4, depths=(1, 1, 1, 1), stem_features=8,
+                        dropout_rate=0.0)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+        tx = build_optimizer(OptimConfig(), total_steps=2)
+        state = TrainState.create(variables["params"],
+                                  variables["batch_stats"], tx)
+        return mesh, model, state
+
+    def test_identical_views_match_single_view(self, devices8):
+        mesh, model, state = self._setup(devices8)
+        step = make_eval_step(model, mesh)
+        rng = np.random.default_rng(0)
+        video = rng.standard_normal((8, 4, 32, 32, 3)).astype(np.float32)
+        label = rng.integers(0, 4, 8).astype(np.int32)
+        out1 = step(state, shard_batch(mesh, {"video": video, "label": label}))
+        tiled = np.repeat(video[:, None], 3, axis=1)  # 3 identical views
+        out3 = step(state, shard_batch(mesh, {"video": tiled, "label": label}))
+        np.testing.assert_allclose(float(out1["loss_sum"]),
+                                   float(out3["loss_sum"]), rtol=1e-4)
+        assert float(out1["correct"]) == float(out3["correct"])
+        assert float(out3["count"]) == 8.0
+
+    def test_views_are_averaged_not_concatenated(self, devices8):
+        mesh, model, state = self._setup(devices8)
+        step = make_eval_step(model, mesh)
+        rng = np.random.default_rng(1)
+        views = rng.standard_normal((8, 3, 4, 32, 32, 3)).astype(np.float32)
+        label = rng.integers(0, 4, 8).astype(np.int32)
+        out = step(state, shard_batch(mesh, {"video": views, "label": label}))
+        # count must be per *video*, not per view
+        assert float(out["count"]) == 8.0
+
+        # independent reference: mean of per-view logits
+        @jax.jit
+        def fwd(v):
+            return model.apply(
+                {"params": state.params, "batch_stats": state.batch_stats},
+                v, train=False)
+
+        logits = np.stack([np.asarray(fwd(views[:, i]), np.float32)
+                           for i in range(3)], axis=1).mean(axis=1)
+        correct = (logits.argmax(-1) == label).sum()
+        assert float(out["correct"]) == float(correct)
+
+    def test_trainer_end_to_end_with_eval_num_clips(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.config import (
+            CheckpointConfig, DataConfig, ModelConfig, TrainConfig,
+        )
+        from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+        cfg = TrainConfig(
+            model=ModelConfig(name="tiny3d", num_classes=4),
+            data=DataConfig(synthetic=True, synthetic_num_videos=8,
+                            num_frames=4, crop_size=32, batch_size=2,
+                            num_workers=1, eval_num_clips=2,
+                            limit_train_batches=1, limit_val_batches=2),
+            optim=OptimConfig(num_epochs=1),
+            checkpoint=CheckpointConfig(output_dir=str(tmp_path)),
+        )
+        tr = Trainer(cfg)
+        res = tr.fit()
+        assert np.isfinite(res["train_loss"])
+        assert 0.0 <= res["val_accuracy"] <= 1.0
