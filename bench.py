@@ -37,25 +37,8 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-# bf16 peak TFLOP/s per chip, keyed by substrings of jax device_kind.
-_PEAK_TFLOPS = [
-    ("v6", 918.0),      # Trillium / v6e
-    ("v5p", 459.0),
-    ("v5", 197.0),      # v5e / "TPU v5 lite"
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
-
-def peak_tflops(device) -> float | None:
-    kind = device.device_kind.lower()
-    if device.platform != "tpu":
-        return None
-    for key, tf in _PEAK_TFLOPS:
-        if key in kind:
-            return tf
-    return None
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops  # noqa: E402
 
 
 # Benchmark workloads: BASELINE.md configs. (model, frames, crop, per-chip

@@ -41,6 +41,11 @@ class DataConfig:
     """Data pipeline knobs (reference `run.py:140-183` + transform stack R6)."""
 
     data_dir: str = ""
+    # pre-decoded frame cache (data/cache.py, built offline with
+    # `python -m pytorchvideo_accelerate_tpu.data.cache build`): when set,
+    # clips come from memmap slices instead of per-clip video decode; expects
+    # train/ and val/ sub-caches mirroring data_dir
+    cache_dir: str = ""
     synthetic: bool = False  # synthetic clips (test/bench fixture; SURVEY §4.4)
     synthetic_num_videos: int = 64
     num_frames: int = 8  # run.py:374 default; 32 in run_slowfast_r50.sh
@@ -140,6 +145,10 @@ class TrainConfig:
     profile: bool = False  # jax.profiler trace of a step window (SURVEY §5)
     profile_dir: str = "/tmp/pva_tpu_profile"
     debug_nans: bool = False  # jax.config debug_nans (SURVEY §5 sanitizers)
+    # trace-time batch-contract chex asserts in the compiled steps
+    debug_asserts: bool = False
+    # per-epoch cross-host fingerprint comparison (multi-process runs)
+    debug_desync: bool = False
     # Multi-host control plane (jax.distributed.initialize); empty = single
     # process or auto-detected TPU pod env.
     coordinator_address: str = ""

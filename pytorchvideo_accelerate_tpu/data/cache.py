@@ -1,0 +1,282 @@
+"""Pre-decoded frame cache: the array_record-style fallback of SURVEY §7
+hard-part 1 ("host decode is the likely real bottleneck").
+
+The reference pays a full PyAV decode per sampled clip every epoch
+(run.py:155,164 via pytorchvideo `EncodedVideo` [external]). This module
+trades disk for decode CPU: an offline pass decodes every manifest video
+ONCE into a flat uint8 frame store + JSON index; training then serves any
+clip span as a memmap slice — O(1), no codec in the hot path, and the
+random-access pattern clip sampling produces is exactly what a memmap is
+good at.
+
+Format (directory):
+    index.json   {"fps": F, "short_side": S, "videos": [{"path", "label",
+                  "offset", "frames", "height", "width"}, ...]}
+    data.bin     concatenated (T_i, H_i, W_i, 3) uint8 frame blocks
+
+Videos keep their aspect ratio (short side scaled to `short_side`), so
+records vary in H/W; offsets are byte positions into data.bin. One file +
+one index keeps the filesystem metadata load trivial (vs a file per clip)
+and the read path a single pread per clip.
+
+CLI:
+    python -m pytorchvideo_accelerate_tpu.data.cache build \
+        --data_dir /data/kinetics/train --out /ssd/kinetics_train_cache \
+        [--fps 30] [--short_side 320] [--num_workers 8]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from concurrent.futures import ThreadPoolExecutor
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.data import decode as decode_mod
+from pytorchvideo_accelerate_tpu.data.manifest import Manifest, scan_directory
+from pytorchvideo_accelerate_tpu.data.samplers import random_clip
+
+INDEX_NAME = "index.json"
+DATA_NAME = "data.bin"
+
+
+def _scaled_size(h: int, w: int, short_side: int) -> tuple:
+    if min(h, w) <= short_side:
+        return h, w
+    if h < w:
+        return short_side, max(int(round(w * short_side / h)), 1)
+    return max(int(round(h * short_side / w)), 1), short_side
+
+
+def _decode_video(path: str, fps: float, short_side: int) -> np.ndarray:
+    """Decode a whole video resampled to `fps`, short side <= `short_side`."""
+    import cv2
+
+    meta = decode_mod.probe(path)
+    frames = decode_mod.decode_span(path, 0.0, meta.duration)
+    # temporal resample to the cache fps (nearest frame)
+    if abs(meta.fps - fps) > 1e-3 and meta.fps > 0:
+        n_out = max(int(round(len(frames) * fps / meta.fps)), 1)
+        idx = np.clip(
+            np.round(np.arange(n_out) * meta.fps / fps).astype(np.int64),
+            0, len(frames) - 1,
+        )
+        frames = frames[idx]
+    h, w = frames.shape[1:3]
+    sh, sw = _scaled_size(h, w, short_side)
+    if (sh, sw) != (h, w):
+        frames = np.stack(
+            [cv2.resize(f, (sw, sh), interpolation=cv2.INTER_LINEAR)
+             for f in frames]
+        )
+    return np.ascontiguousarray(frames)
+
+
+def build_cache(data_dir: str, out_dir: str, fps: float = 30.0,
+                short_side: int = 320, num_workers: int = 8,
+                manifest: Optional[Manifest] = None) -> dict:
+    """Offline transcode: manifest videos -> frame store. Returns the index.
+
+    Decode runs in a thread pool (cv2 releases the GIL); writes are
+    sequential appends in manifest order, so the output is deterministic.
+    """
+    manifest = manifest or scan_directory(data_dir)
+    os.makedirs(out_dir, exist_ok=True)
+    videos: List[dict] = []
+    pool = ThreadPoolExecutor(max_workers=max(num_workers, 1))
+    try:
+        # bounded decode-ahead window: the writer consumes in manifest order,
+        # so unbounded submission would buffer whole decoded videos
+        # (~100s of MB each) while it catches up
+        from collections import deque
+
+        window = max(num_workers, 1) * 2
+        pending = deque()
+        for e in manifest.entries[:window]:
+            pending.append((e, pool.submit(_decode_video, e.path, fps,
+                                           short_side)))
+        consumed = len(pending)
+        offset = 0
+        with open(os.path.join(out_dir, DATA_NAME), "wb") as f:
+            while pending:
+                entry, fut = pending.popleft()
+                frames = fut.result()
+                if consumed < len(manifest.entries):
+                    nxt = manifest.entries[consumed]
+                    pending.append((nxt, pool.submit(_decode_video, nxt.path,
+                                                     fps, short_side)))
+                    consumed += 1
+                f.write(frames.tobytes())
+                videos.append({
+                    "path": entry.path,
+                    "label": int(entry.label),
+                    "offset": offset,
+                    "frames": int(frames.shape[0]),
+                    "height": int(frames.shape[1]),
+                    "width": int(frames.shape[2]),
+                })
+                offset += frames.nbytes
+    finally:
+        pool.shutdown(wait=True, cancel_futures=True)
+    index = {
+        "fps": float(fps),
+        "short_side": int(short_side),
+        "num_classes": manifest.num_classes,
+        "videos": videos,
+    }
+    with open(os.path.join(out_dir, INDEX_NAME), "w") as f:
+        json.dump(index, f)
+    return index
+
+
+class FrameCache:
+    """Memmap view over a built cache; `read(i, start_sec, end_sec)` returns
+    (T, H, W, 3) uint8 — the `decode_span` contract, without the decode."""
+
+    def __init__(self, cache_dir: str):
+        with open(os.path.join(cache_dir, INDEX_NAME)) as f:
+            self.index = json.load(f)
+        self.fps = float(self.index["fps"])
+        self.num_classes = int(self.index.get("num_classes", 0))
+        self.videos = self.index["videos"]
+        self._data = np.memmap(os.path.join(cache_dir, DATA_NAME),
+                               dtype=np.uint8, mode="r")
+
+    def __len__(self) -> int:
+        return len(self.videos)
+
+    def duration(self, i: int) -> float:
+        return self.videos[i]["frames"] / self.fps
+
+    def label(self, i: int) -> int:
+        return self.videos[i]["label"]
+
+    def read(self, i: int, start_sec: float, end_sec: float) -> np.ndarray:
+        v = self.videos[i]
+        t, h, w = v["frames"], v["height"], v["width"]
+        start = min(max(int(round(start_sec * self.fps)), 0), t - 1)
+        end = min(max(int(round(end_sec * self.fps)), start + 1), t)
+        stride = h * w * 3
+        lo = v["offset"] + start * stride
+        hi = v["offset"] + end * stride
+        return np.asarray(self._data[lo:hi]).reshape(end - start, h, w, 3)
+
+
+class CachedClipSource:
+    """Drop-in `ClipSource` over a FrameCache (same sampling semantics as
+    VideoClipSource, including eval multi-view)."""
+
+    def __init__(self, cache_dir: str, transform: Callable,
+                 clip_duration: float, training: bool, seed: int = 42,
+                 num_clips: int = 1):
+        self.cache = FrameCache(cache_dir)
+        self.transform = transform
+        self.clip_duration = clip_duration
+        self.training = training
+        self.seed = seed
+        self.num_clips = max(num_clips, 1) if not training else 1
+        self.num_classes = self.cache.num_classes
+
+    def __len__(self) -> int:
+        return len(self.cache)
+
+    def get(self, index: int, epoch: int) -> Dict[str, np.ndarray]:
+        from pytorchvideo_accelerate_tpu.data.pipeline import sample_views
+
+        rng = np.random.default_rng((self.seed, epoch, index))
+        out = sample_views(
+            lambda a, b: self.cache.read(index, a, b), self.transform,
+            self.cache.duration(index), self.clip_duration, self.training,
+            rng, self.num_clips,
+        )
+        out["label"] = np.int32(self.cache.label(index))
+        return out
+
+
+def measure_clip_throughput(fetch: Callable[[int], np.ndarray], n_items: int,
+                            n_clips: int, num_workers: int = 1) -> float:
+    """Clips/sec of `fetch(i)` over a thread pool (the loader's access
+    pattern); used by the `bench` subcommand and tests."""
+    import time
+
+    pool = ThreadPoolExecutor(max_workers=max(num_workers, 1))
+    try:
+        list(pool.map(fetch, range(min(2, n_clips))))  # warm caches
+        t0 = time.perf_counter()
+        for arr in pool.map(fetch, (i % n_items for i in range(n_clips))):
+            np.add.reduce(arr[0, 0, 0])  # touch the data (defeat lazy maps)
+        return n_clips / (time.perf_counter() - t0)
+    finally:
+        pool.shutdown(wait=False)
+
+
+def bench_decode_vs_cache(data_dir: str, cache_dir: str,
+                          clip_duration: float = 2.0, n_clips: int = 64,
+                          num_workers: int = 4, seed: int = 0) -> dict:
+    """Measure raw-decode vs cache clips/sec on the same sampled spans
+    (SURVEY §7 hard-part 1: quantify the decode bottleneck)."""
+    manifest = scan_directory(data_dir)
+    cache = FrameCache(cache_dir)
+    rng = np.random.default_rng(seed)
+    spans = []
+    for i in range(len(manifest)):
+        d = decode_mod.probe(manifest.entries[i].path).duration
+        spans.append(random_clip(d, clip_duration, rng))
+
+    def fetch_decode(i):
+        s = spans[i]
+        return decode_mod.decode_span(manifest.entries[i].path, s.start, s.end)
+
+    def fetch_cache(i):
+        s = spans[i]
+        return cache.read(i, s.start, s.end)
+
+    decode_cps = measure_clip_throughput(fetch_decode, len(manifest),
+                                         n_clips, num_workers)
+    cache_cps = measure_clip_throughput(fetch_cache, len(manifest),
+                                        n_clips, num_workers)
+    return {
+        "decode_clips_per_sec": round(decode_cps, 2),
+        "cache_clips_per_sec": round(cache_cps, 2),
+        "speedup": round(cache_cps / decode_cps, 2),
+        "num_workers": num_workers,
+    }
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    b = sub.add_parser("build", help="decode a manifest directory into a cache")
+    b.add_argument("--data_dir", required=True)
+    b.add_argument("--out", required=True)
+    b.add_argument("--fps", type=float, default=30.0)
+    b.add_argument("--short_side", type=int, default=320)
+    b.add_argument("--num_workers", type=int, default=8)
+    m = sub.add_parser("bench", help="decode vs cache clips/sec microbench")
+    m.add_argument("--data_dir", required=True)
+    m.add_argument("--cache_dir", required=True)
+    m.add_argument("--clip_duration", type=float, default=2.0)
+    m.add_argument("--clips", type=int, default=64)
+    m.add_argument("--num_workers", type=int, default=4)
+    args = ap.parse_args(argv)
+
+    if args.cmd == "build":
+        index = build_cache(args.data_dir, args.out, fps=args.fps,
+                            short_side=args.short_side,
+                            num_workers=args.num_workers)
+        total = sum(v["frames"] for v in index["videos"])
+        size = os.path.getsize(os.path.join(args.out, DATA_NAME))
+        print(f"cached {len(index['videos'])} videos, {total} frames, "
+              f"{size / 1e9:.2f} GB -> {args.out}")
+    else:
+        print(json.dumps(bench_decode_vs_cache(
+            args.data_dir, args.cache_dir, clip_duration=args.clip_duration,
+            n_clips=args.clips, num_workers=args.num_workers)))
+
+
+if __name__ == "__main__":
+    main()

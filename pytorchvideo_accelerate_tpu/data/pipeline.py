@@ -51,6 +51,28 @@ class ClipSource:
         raise NotImplementedError
 
 
+def sample_views(read_span: Callable, transform: Callable, duration: float,
+                 clip_duration: float, training: bool,
+                 rng: np.random.Generator, num_clips: int) -> Dict[str, np.ndarray]:
+    """Shared span-selection + multi-view stacking for every clip source.
+
+    Train: ONE random span. Eval: `num_clips` evenly-spaced spans, each
+    transformed and stacked on a leading view axis (the eval step
+    view-averages the logits; reference uniform tiling, run.py:163).
+    `read_span(start_sec, end_sec) -> (T, H, W, 3) uint8`.
+    """
+    if training:
+        spans = [random_clip(duration, clip_duration, rng)]
+        single = True
+    else:
+        spans = uniform_clips(duration, clip_duration, num_clips)
+        single = num_clips == 1
+    views = [transform(read_span(s.start, s.end), rng) for s in spans]
+    if single:
+        return views[0]
+    return {k: np.stack([v[k] for v in views]) for k in views[0]}
+
+
 class VideoClipSource(ClipSource):
     """Real videos: manifest entry -> clip span -> cv2 decode -> transform.
 
@@ -97,19 +119,11 @@ class VideoClipSource(ClipSource):
         entry = self.manifest.entries[index]
         meta = self._meta(entry.path)
         rng = np.random.default_rng((self.seed, epoch, index))
-        if self.training:
-            spans = [random_clip(meta.duration, self.clip_duration, rng)]
-        else:
-            spans = uniform_clips(meta.duration, self.clip_duration,
-                                  self.num_clips)
-        views = []
-        for span in spans:
-            frames = decode_mod.decode_span(entry.path, span.start, span.end)
-            views.append(self.transform(frames, rng))
-        if len(views) == 1 and self.num_clips == 1:
-            out = views[0]
-        else:  # (V, ...) per key
-            out = {k: np.stack([v[k] for v in views]) for k in views[0]}
+        out = sample_views(
+            lambda a, b: decode_mod.decode_span(entry.path, a, b),
+            self.transform, meta.duration, self.clip_duration,
+            self.training, rng, self.num_clips,
+        )
         out["label"] = np.int32(entry.label)
         return out
 
@@ -144,15 +158,15 @@ class SyntheticClipSource(ClipSource):
         label = index % self.num_classes
         rng = np.random.default_rng((self.seed, epoch, index))
         h, w = self.raw_size
-        views = []
-        for _ in range(self.num_clips):
+
+        def synth_span(a, b):  # label-coded random frames, span-independent
             frames = (rng.random((self.raw_frames, h, w, 3)) * 60).astype(np.uint8)
             frames += np.uint8(label * (160 // max(self.num_classes - 1, 1)))
-            views.append(self.transform(frames, rng))
-        if self.num_clips == 1:
-            out = views[0]
-        else:
-            out = {k: np.stack([v[k] for v in views]) for k in views[0]}
+            return frames
+
+        out = sample_views(synth_span, self.transform, 1.0, 1.0,
+                           training=self.num_clips == 1, rng=rng,
+                           num_clips=self.num_clips)
         out["label"] = np.int32(label)
         return out
 

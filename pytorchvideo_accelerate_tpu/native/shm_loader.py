@@ -116,14 +116,37 @@ class ShmWorkerPool:
         """
         indices = np.asarray(indices[start:])
         self._spawn(indices, epoch)
+        dead: Dict[int, int] = {}  # wid -> waitpid status
         try:
             for pos in range(len(indices)):
-                ring = self._rings[pos % self.num_workers]
-                slot, nbytes, tag = ring.pop(self.timeout_ms)
+                wid = pos % self.num_workers
+                ring = self._rings[wid]
+                # short-interval pops with a liveness check between them:
+                # a SIGKILLed worker is reported within ~1s (with its wait
+                # status) instead of burning the full consumer timeout
+                deadline_ms = self.timeout_ms
+                slot = -1
+                while deadline_ms > 0:
+                    step_ms = min(deadline_ms, 1000)
+                    slot, nbytes, tag = ring.pop(step_ms)
+                    if slot >= 0:
+                        break
+                    deadline_ms -= step_ms
+                    if wid not in dead:
+                        pid_done, status = os.waitpid(self._pids[wid],
+                                                      os.WNOHANG)
+                        if pid_done:
+                            dead[wid] = status
+                    if wid in dead:
+                        raise RuntimeError(
+                            f"shm pool: worker {wid} (pid {self._pids[wid]}) "
+                            f"died (wait status {dead[wid]}) before producing "
+                            f"position {pos}"
+                        )
                 if slot < 0:
                     raise TimeoutError(
                         f"shm pool: no sample for position {pos} from worker "
-                        f"{pos % self.num_workers} (status {slot})"
+                        f"{wid} (status {slot})"
                     )
                 sample = unpack_sample(ring.slot_view(slot)[:nbytes])
                 if ERROR_KEY in sample:

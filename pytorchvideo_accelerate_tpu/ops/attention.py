@@ -36,6 +36,15 @@ def dense_attention(q, k, v, scale: Optional[float] = None, kmask=None):
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
+def fused_attention(q, k, v, scale: Optional[float] = None, kmask=None):
+    """XLA's fused attention (flash-style chunking on TPU — no materialized
+    N^2 score matrix) with the same key-mask contract as `dense_attention`.
+    The CP wrappers use this for their local attention so peak memory stays
+    O(N) at the long sequences that motivate context parallelism."""
+    mask = None if kmask is None else kmask[None, None, None, :]
+    return jax.nn.dot_product_attention(q, k, v, mask=mask, scale=scale)
+
+
 def dot_product_attention(q, k, v, backend: str = "dense",
                           axis_name: Optional[str] = None, mesh=None):
     """Route to an attention implementation.

@@ -102,6 +102,33 @@ def main_print(*args, **kwargs) -> None:
         print(*args, **kwargs)
 
 
+def check_desync(fingerprint: float, name: str = "train_state") -> None:
+    """Debug guard (SURVEY §5 race/failure detection): compare a scalar
+    fingerprint (e.g. the params global-norm) across processes and raise if
+    any host disagrees — catching silent replica divergence (bad hardware,
+    non-deterministic data order) the way torch's DDP detects mismatched
+    buckets. No-op single-process; out-of-band (DCN), so only call it at a
+    debug cadence (TrainConfig.debug_desync wires it per epoch)."""
+    if jax.process_count() == 1:
+        return
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    vals = np.asarray(
+        multihost_utils.process_allgather(np.float32(fingerprint))
+    ).reshape(-1)
+    # equal_nan: all-NaN means the run diverged IDENTICALLY everywhere —
+    # that's a NaN problem (debug_nans territory), not a desync
+    agree = np.all((vals == vals[0]) | (np.isnan(vals) & np.isnan(vals[0])))
+    if not agree:
+        raise RuntimeError(
+            f"cross-host desync on {name!r}: per-process fingerprints {vals.tolist()}"
+        )
+    if np.isnan(vals[0]):
+        logger.warning("desync check on %r: fingerprint is NaN on all hosts "
+                       "(consistent, but the run has diverged)", name)
+
+
 def sync_global_devices(name: str = "barrier") -> None:
     """Host-level barrier (out-of-band, DCN) — for checkpoint/teardown fences."""
     if jax.process_count() > 1:

@@ -30,7 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh
 
-from pytorchvideo_accelerate_tpu.ops.attention import dense_attention
+from pytorchvideo_accelerate_tpu.ops.attention import fused_attention
 from pytorchvideo_accelerate_tpu.parallel.mesh import AXIS_CONTEXT
 
 
@@ -58,7 +58,9 @@ def ulysses_attention(q, k, v, axis_name: str = AXIS_CONTEXT,
     kmask = None
     if nk_valid is not None and nk_valid < kg.shape[1]:
         kmask = jnp.arange(kg.shape[1]) < nk_valid
-    out = dense_attention(to_heads(q), kg, to_heads(v), scale=scale, kmask=kmask)
+    # fused (flash-chunked) local attention: peak memory O(N), not O(N^2) —
+    # the whole point at the sequence lengths that motivate Ulysses
+    out = fused_attention(to_heads(q), kg, to_heads(v), scale=scale, kmask=kmask)
     return to_tokens(out)
 
 

@@ -113,6 +113,7 @@ class Trainer:
         # user-registered checkpoint participants (reference
         # `accelerator.register_for_checkpointing`, run.py:199)
         self._registered: dict = {}
+        self._flops_per_step: Optional[float] = None  # XLA cost model, lazy
 
         self.trackers: Optional[TrackerHub] = None
         if cfg.tracking.with_tracking and is_main_process():
@@ -160,6 +161,19 @@ class Trainer:
                 num_classes=num_classes, seed=cfg.seed + 1,
                 num_clips=eval_clips,
             )
+        elif d.cache_dir:
+            from pytorchvideo_accelerate_tpu.data.cache import CachedClipSource
+
+            self.train_source = CachedClipSource(
+                os.path.join(d.cache_dir, "train"), train_tf,
+                cfg.clip_duration, training=True, seed=cfg.seed,
+            )
+            self.val_source = CachedClipSource(
+                os.path.join(d.cache_dir, "val"), val_tf,
+                cfg.clip_duration, training=False, seed=cfg.seed,
+                num_clips=eval_clips,
+            )
+            num_classes = self.train_source.num_classes
         else:
             train_manifest = scan_directory(os.path.join(d.data_dir, "train"))
             val_manifest = scan_directory(os.path.join(d.data_dir, "val"))
@@ -246,6 +260,7 @@ class Trainer:
                 self.model, self.tx, self.mesh,
                 accum_steps=cfg.optim.gradient_accumulation_steps,
                 lr_schedule=self.lr_schedule,
+                debug_asserts=cfg.debug_asserts,
             )
             self.eval_step = make_pretrain_eval_step(self.model, self.mesh)
         else:
@@ -254,10 +269,26 @@ class Trainer:
                 accum_steps=cfg.optim.gradient_accumulation_steps,
                 label_smoothing=cfg.optim.label_smoothing,
                 lr_schedule=self.lr_schedule,
+                debug_asserts=cfg.debug_asserts,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh, label_smoothing=cfg.optim.label_smoothing
             )
+
+    def _capture_step_flops(self, global_batch, gstep: int) -> None:
+        """Per-step FLOPs from XLA's own cost model (once, after the first
+        step so the executable cache is warm); feeds the epoch-end MFU line."""
+        self._flops_per_step = 0.0
+        try:
+            compiled = self.train_step.lower(
+                self.state, global_batch, self.rng.step_key(gstep)
+            ).compile()
+            ca = compiled.cost_analysis()
+            if isinstance(ca, (list, tuple)):
+                ca = ca[0] if ca else {}
+            self._flops_per_step = float(ca.get("flops", 0.0))
+        except Exception:  # cost_analysis availability varies by backend
+            pass
 
     def register_for_checkpointing(self, name: str, obj) -> None:
         """Add a custom object to every checkpoint (reference
@@ -348,6 +379,7 @@ class Trainer:
                 progress.set_description_str(f"Epoch: {epoch}")
             epoch_loss = MeanLoss()
             t_epoch = time.time()
+            train_steps_this_epoch = 0
 
             for step_in_epoch, batch in enumerate(self.train_loader.epoch(epoch)):
                 if cfg.profile and not profiling and gstep == 2:
@@ -357,10 +389,14 @@ class Trainer:
                     self.mesh, batch,
                     micro_dim=cfg.optim.gradient_accumulation_steps > 1,
                 )
-                self.state, metrics = self.train_step(
-                    self.state, global_batch, self.rng.step_key(gstep)
-                )
+                with jax.profiler.StepTraceAnnotation("train", step_num=gstep):
+                    self.state, metrics = self.train_step(
+                        self.state, global_batch, self.rng.step_key(gstep)
+                    )
                 gstep += 1
+                train_steps_this_epoch += 1
+                if self.trackers and self._flops_per_step is None:
+                    self._capture_step_flops(global_batch, gstep)
                 if profiling and gstep >= 6:
                     jax.profiler.stop_trace()
                     profiling = False
@@ -415,7 +451,36 @@ class Trainer:
                     epoch_metrics["val_recon_loss"] = last_val_loss
                 else:
                     epoch_metrics["accuracy"] = last_val_acc
+                # epoch throughput + (when XLA's cost model is available)
+                # achieved TFLOP/s and MFU against the chip's bf16 peak
+                steps_done = train_steps_this_epoch
+                t_train = epoch_train_times[-1]
+                if t_train > 0 and steps_done > 0:
+                    sps = steps_done / t_train
+                    epoch_metrics["steps_per_sec"] = sps
+                    epoch_metrics["clips_per_sec"] = (
+                        sps * self.train_loader.global_batch_size
+                        * self.train_loader.accum_steps
+                    )
+                    if self._flops_per_step:
+                        from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
+
+                        n_dev = len(jax.devices())
+                        tflops = self._flops_per_step * sps / 1e12 / n_dev
+                        epoch_metrics["tflops_per_sec_per_chip"] = tflops
+                        peak = peak_tflops(jax.devices()[0])
+                        if peak:
+                            epoch_metrics["mfu"] = tflops / peak
                 self.trackers.log(epoch_metrics, step=epoch)
+            if cfg.debug_desync:
+                import optax
+
+                from pytorchvideo_accelerate_tpu.parallel.distributed import (
+                    check_desync,
+                )
+
+                check_desync(float(optax.global_norm(self.state.params)),
+                             name=f"params@epoch{epoch}")
             if self.checkpointing_steps == "epoch":
                 self._save("epoch", epoch)
 

@@ -55,6 +55,27 @@ def _constrain_batch(batch: dict, mesh, leading_micro: bool) -> dict:
     return jax.tree.map(cons, batch)
 
 
+def assert_batch_contract(batch: dict, leading_micro: bool = False) -> None:
+    """Trace-time batch-contract checks (SURVEY §5 sanitizers): ranks,
+    dtypes, and matching leading dims. On when TrainConfig.debug_asserts is
+    set — pure trace-time, so zero runtime cost in the compiled step."""
+    import chex
+
+    lead = 2 if leading_micro else 1
+    clips = [batch[k] for k in ("slow", "fast", "video") if k in batch]
+    assert clips, "batch has neither 'video' nor 'slow'/'fast' clips"
+    for c in clips:
+        # (B, T, H, W, C) + optional micro axis + optional view axis
+        chex.assert_rank(c, {4 + lead, 5 + lead})
+    if "label" in batch:
+        chex.assert_rank(batch["label"], lead)
+        chex.assert_type(batch["label"], jnp.int32)
+        chex.assert_equal_shape_prefix([clips[0], batch["label"]], lead)
+    if batch.get("mask") is not None:
+        chex.assert_type(batch["mask"], jnp.float32)
+        chex.assert_equal_shape_prefix([clips[0], batch["mask"]], lead)
+
+
 def _loss_and_metrics(logits, labels, mask, label_smoothing: float):
     logits = logits.astype(jnp.float32)
     num_classes = logits.shape[-1]
@@ -75,6 +96,7 @@ def _make_update_step(
     accum_steps: int,
     lr_schedule: Optional[Callable],
     with_accuracy: bool,
+    debug_asserts: bool = False,
 ) -> Callable:
     """Shared machinery of the supervised and self-supervised steps.
 
@@ -86,6 +108,8 @@ def _make_update_step(
     donation (params update in place in HBM)."""
 
     def step(state: TrainState, batch: dict, key) -> tuple:
+        if debug_asserts:
+            assert_batch_contract(batch, leading_micro=accum_steps > 1)
         if accum_steps == 1:
             batch = _constrain_batch(batch, mesh, leading_micro=False)
             (loss, (new_stats, correct, count)), grads = grad_fn(
@@ -135,6 +159,7 @@ def make_train_step(
     accum_steps: int = 1,
     label_smoothing: float = 0.0,
     lr_schedule: Optional[Callable] = None,
+    debug_asserts: bool = False,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
     (state, metrics)` (see `_make_update_step`)."""
@@ -157,7 +182,7 @@ def make_train_step(
 
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
-                             with_accuracy=True)
+                             with_accuracy=True, debug_asserts=debug_asserts)
 
 
 def make_pretrain_step(
@@ -166,6 +191,7 @@ def make_pretrain_step(
     mesh,
     accum_steps: int = 1,
     lr_schedule: Optional[Callable] = None,
+    debug_asserts: bool = False,
 ) -> Callable:
     """Build the VideoMAE self-supervised step: `step(state, batch, key) ->
     (state, metrics)`. No labels; batch_stats pass through unchanged (pure-LN
@@ -183,7 +209,7 @@ def make_pretrain_step(
 
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
-                             with_accuracy=False)
+                             with_accuracy=False, debug_asserts=debug_asserts)
 
 
 def make_pretrain_eval_step(model, mesh) -> Callable:

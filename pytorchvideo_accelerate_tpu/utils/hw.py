@@ -1,0 +1,30 @@
+"""Hardware peak numbers for utilization reporting (bench.py + trainer MFU).
+
+bf16 peak TFLOP/s per chip by `device_kind` substring; None for platforms
+without a published peak (CPU, unknown accelerators) — callers then skip the
+MFU line rather than report nonsense.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+_PEAK_TFLOPS = [
+    ("v6", 918.0),      # Trillium / v6e
+    ("v5p", 459.0),
+    ("v5", 197.0),      # v5e / "TPU v5 lite"
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+
+def peak_tflops(device) -> Optional[float]:
+    """bf16 peak TFLOP/s for one chip, or None if unknown/non-TPU."""
+    if device.platform != "tpu":
+        return None
+    kind = device.device_kind.lower()
+    for key, tf in _PEAK_TFLOPS:
+        if key in kind:
+            return tf
+    return None

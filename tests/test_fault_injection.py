@@ -1,0 +1,168 @@
+"""Fault injection + sanitizers (SURVEY §5; VERDICT r2 missing #7):
+SIGKILLed shm workers surface a prompt error, corrupted checkpoints fail
+cleanly (and `resume auto` before any checkpoint starts fresh), chex batch
+contracts catch malformed batches at trace time, and the desync guard runs.
+"""
+
+import os
+import signal
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.data.pipeline import SyntheticClipSource
+from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+
+
+def _source():
+    tf = make_transform(training=True, num_frames=4, crop_size=32,
+                        min_short_side_scale=36, max_short_side_scale=40)
+    return SyntheticClipSource(tf, num_videos=64, num_classes=4)
+
+
+class TestShmWorkerDeath:
+    def test_sigkilled_worker_raises_promptly(self):
+        """A SIGKILLed decode worker must surface a RuntimeError naming the
+        worker within ~seconds — not hang for the full consumer timeout."""
+        from pytorchvideo_accelerate_tpu.native.shm_loader import ShmWorkerPool
+
+        pool = ShmWorkerPool(_source(), num_workers=2, timeout_ms=30_000)
+        it = pool.map_epoch(np.arange(64), epoch=0)
+        sample, done = next(it)  # workers are live
+        done()
+        os.kill(pool._pids[0], signal.SIGKILL)
+        t0 = time.monotonic()
+        with pytest.raises(RuntimeError, match="died"):
+            for sample, done in it:
+                done()
+        assert time.monotonic() - t0 < 10.0, "death detection too slow"
+
+    def test_worker_exception_delivered_in_band(self):
+        from pytorchvideo_accelerate_tpu.native.shm_loader import ShmWorkerPool
+
+        class Exploding:
+            num_classes = 4
+
+            def __len__(self):
+                return 8
+
+            def get(self, index, epoch):
+                if index >= 4 and epoch == 0:
+                    raise ValueError(f"decode exploded at {index}")
+                tf = make_transform(training=True, num_frames=2, crop_size=16,
+                                    min_short_side_scale=18,
+                                    max_short_side_scale=18)
+                rng = np.random.default_rng(index)
+                return tf((rng.random((4, 24, 32, 3)) * 255).astype(np.uint8),
+                          rng)
+
+        pool = ShmWorkerPool(Exploding(), num_workers=1, timeout_ms=20_000,
+                             probe_epoch=1)
+        with pytest.raises(RuntimeError, match="decode exploded"):
+            for sample, done in pool.map_epoch(np.arange(8), epoch=0):
+                done()
+
+
+class TestCorruptCheckpoint:
+    def test_truncated_checkpoint_fails_cleanly(self, mesh8, tmp_path):
+        """Deleting files from the latest checkpoint must raise an
+        informative error, not hang or return garbage state."""
+        import optax
+
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import Checkpointer
+        from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+
+        tx = optax.sgd(0.1)
+        params = {"w": jnp.ones((8, 8)), "b": jnp.zeros((8,))}
+        state = TrainState.create(params, {}, tx)
+        ck = Checkpointer(str(tmp_path), use_async=False)
+        ck.save(1, state, {"kind": "step", "epoch": 0})
+        ck.close()
+
+        # truncate: remove every file under the step dir's array store
+        step_dir = os.path.join(str(tmp_path), "1")
+        victims = []
+        for root, _dirs, files in os.walk(step_dir):
+            victims += [os.path.join(root, f) for f in files]
+        assert victims, "checkpoint layout changed?"
+        for f in victims:
+            os.remove(f)
+
+        ck2 = Checkpointer(str(tmp_path), use_async=False)
+        with pytest.raises(Exception) as ei:
+            ck2.restore(state)
+        assert "1" in str(ei.value) or "checkpoint" in str(ei.value).lower()
+        ck2.close()
+
+    def test_resume_auto_with_no_checkpoint_starts_fresh(self, tmp_path):
+        """`--resume_from_checkpoint auto` against an empty output dir must
+        start fresh (epoch 0), not raise."""
+        from pytorchvideo_accelerate_tpu.config import (
+            CheckpointConfig, DataConfig, ModelConfig, OptimConfig,
+            TrainConfig,
+        )
+        from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+        cfg = TrainConfig(
+            model=ModelConfig(name="tiny3d", num_classes=4),
+            data=DataConfig(synthetic=True, synthetic_num_videos=8,
+                            num_frames=4, crop_size=32, batch_size=1,
+                            num_workers=1),
+            optim=OptimConfig(num_epochs=1),
+            checkpoint=CheckpointConfig(output_dir=str(tmp_path),
+                                        resume_from_checkpoint="auto"),
+        )
+        tr = Trainer(cfg)
+        assert tr._maybe_resume() == 0
+
+
+class TestDebugAsserts:
+    def test_malformed_batch_caught_at_trace_time(self, mesh8):
+        import optax
+
+        from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
+        from pytorchvideo_accelerate_tpu.trainer import (
+            TrainState, build_optimizer, make_train_step,
+        )
+        from pytorchvideo_accelerate_tpu.config import MeshConfig, OptimConfig
+        from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+        from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+
+        mesh = mesh8
+        model = SlowR50(num_classes=4, depths=(1, 1, 1, 1), stem_features=8)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+        tx = build_optimizer(OptimConfig(), total_steps=2)
+        state = TrainState.create(variables["params"],
+                                  variables["batch_stats"], tx)
+        step = make_train_step(model, tx, mesh, debug_asserts=True)
+        bad = {
+            "video": np.zeros((8, 4, 32, 32, 3), np.float32),
+            "label": np.zeros((8, 1), np.int32),  # wrong rank
+        }
+        with pytest.raises(AssertionError):
+            step(state, shard_batch(mesh, bad), jax.random.key(0))
+
+    def test_contract_passes_on_good_batches(self):
+        from pytorchvideo_accelerate_tpu.trainer.steps import (
+            assert_batch_contract,
+        )
+
+        assert_batch_contract({
+            "video": jnp.zeros((4, 2, 8, 8, 3)),
+            "label": jnp.zeros((4,), jnp.int32),
+            "mask": jnp.ones((4,), jnp.float32),
+        })
+        assert_batch_contract({
+            "slow": jnp.zeros((2, 4, 2, 8, 8, 3)),
+            "fast": jnp.zeros((2, 4, 8, 8, 8, 3)),
+            "label": jnp.zeros((2, 4), jnp.int32),
+        }, leading_micro=True)
+
+
+def test_desync_check_single_process_noop():
+    from pytorchvideo_accelerate_tpu.parallel.distributed import check_desync
+
+    check_desync(1.234)  # must be a no-op, not raise
