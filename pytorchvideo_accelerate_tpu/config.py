@@ -60,6 +60,12 @@ class DataConfig:
     mean: tuple = (0.45, 0.45, 0.45)
     std: tuple = (0.225, 0.225, 0.225)
     horizontal_flip_p: float = 0.5
+    # cast clips to the compute dtype on the host (half the host->HBM bytes;
+    # value-preserving for the supervised models, which cast inputs on
+    # device anyway — NOT applied to VideoMAE pretraining, whose fp32
+    # regression target would be quantized). "auto" follows
+    # TrainConfig.mixed_precision; "fp32" keeps float32 clips.
+    host_cast: str = "auto"  # auto | fp32
     decode_audio: bool = False
     # multi-view val: views/video with view-averaged logits (the reference's
     # uniform clip-tiling eval, run.py:163); 1 = single center clip
@@ -200,6 +206,9 @@ _REFERENCE_ALIASES = {
     "slowfast_alpha": "model.slowfast_alpha",
     "model_name": "model.name",
     "synthetic": "data.synthetic",
+    "cache_dir": "data.cache_dir",
+    "eval_num_clips": "data.eval_num_clips",
+    "trackers": "tracking.trackers",
 }
 
 

@@ -117,12 +117,25 @@ def make_transform(
     mean: Sequence[float] = (0.45, 0.45, 0.45),
     std: Sequence[float] = (0.225, 0.225, 0.225),
     horizontal_flip_p: float = 0.5,
+    output_dtype: str = "float32",
 ) -> Callable[[np.ndarray, Optional[np.random.Generator]], Dict[str, np.ndarray]]:
     """Build the full clip transform (reference make_transform, run.py:68-102).
 
     Returns `fn(frames_uint8_THWC, rng) -> {"video": ...}` or
-    `{"slow": ..., "fast": ...}` (float32, contiguous).
+    `{"slow": ..., "fast": ...}` (contiguous).
+
+    `output_dtype="bfloat16"` casts the final clip on the host: the model
+    casts inputs to its compute dtype anyway (models/common.py), so the cast
+    loses nothing numerically while halving host-RAM, shm-ring, and
+    host->HBM transfer bytes — the transfer is the input-bound regime's
+    bottleneck at 32f/256^2 batches (~250 MB/step fp32).
     """
+    if output_dtype == "float32":
+        out_dtype = np.float32
+    else:
+        import ml_dtypes  # jax dependency, always present
+
+        out_dtype = np.dtype(getattr(ml_dtypes, output_dtype))
 
     def transform(frames: np.ndarray, rng: Optional[np.random.Generator] = None):
         if training and rng is None:
@@ -139,9 +152,12 @@ def make_transform(
         else:
             x = short_side_scale(x, min_short_side_scale)
             x = center_crop(x, crop_size)
+        # astype on a sliced view already allocates contiguous output, so
+        # cast first: one copy total in both modes
         if is_slowfast:
             out = pack_pathway(x, slowfast_alpha)
-            return {k: np.ascontiguousarray(v) for k, v in out.items()}
-        return {"video": np.ascontiguousarray(x)}
+            return {k: np.ascontiguousarray(v.astype(out_dtype, copy=False))
+                    for k, v in out.items()}
+        return {"video": np.ascontiguousarray(x.astype(out_dtype, copy=False))}
 
     return transform

@@ -19,6 +19,12 @@ import pytorchvideo_accelerate_tpu.native as native
 
 _DTYPES = [np.dtype(np.float32), np.dtype(np.int32), np.dtype(np.uint8),
            np.dtype(np.float16), np.dtype(np.int64), np.dtype(np.bool_)]
+try:  # bf16 clips (data/transforms.py output_dtype="bfloat16")
+    import ml_dtypes
+
+    _DTYPES.append(np.dtype(ml_dtypes.bfloat16))
+except Exception:  # pragma: no cover - ml_dtypes ships with jax
+    pass
 _DTYPE_CODE = {d: i for i, d in enumerate(_DTYPES)}
 
 

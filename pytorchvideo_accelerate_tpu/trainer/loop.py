@@ -130,6 +130,18 @@ class Trainer:
         cfg = self.cfg
         d = cfg.data
         is_slowfast = cfg.model.name.startswith("slowfast")
+        # host-side cast to the compute dtype: halves clip bytes end to end
+        # (worker -> shm ring -> host RAM -> HBM). For the supervised models
+        # this is value-preserving (they cast inputs to bf16 on device
+        # anyway); VideoMAE pretraining is excluded — its regression target
+        # is computed in fp32 from the raw clip (videomae.py patchify), so a
+        # host cast would quantize the objective itself.
+        if d.host_cast not in ("auto", "fp32"):
+            raise ValueError(
+                f"data.host_cast must be 'auto' or 'fp32', got {d.host_cast!r}"
+            )
+        bf16 = (cfg.mixed_precision in ("bf16", "fp16")
+                and d.host_cast == "auto" and not self.is_pretraining)
         common = dict(
             num_frames=d.num_frames,
             is_slowfast=is_slowfast,
@@ -140,6 +152,7 @@ class Trainer:
             mean=d.mean,
             std=d.std,
             horizontal_flip_p=d.horizontal_flip_p,
+            output_dtype="bfloat16" if bf16 else "float32",
         )
         train_tf = make_transform(training=True, **common)
         val_tf = make_transform(training=False, **common)

@@ -16,26 +16,41 @@ import numpy as np
 
 @dataclass
 class SumMetrics:
-    """Accumulates {loss_sum, correct, count} dicts from eval steps."""
+    """Accumulates {loss_sum, correct, count} dicts from eval steps.
+
+    `update` keeps the device scalars un-fetched (same async-dispatch
+    treatment as MeanLoss): the eval loop keeps dispatching batches while
+    earlier ones execute, and the transfers happen in one batched
+    `device_get` when a result is read.
+    """
 
     loss_sum: float = 0.0
     correct: float = 0.0
     count: float = 0.0
+    pending: list = field(default_factory=list)
 
     def update(self, step_out: dict) -> None:
-        # device->host transfer happens here, once per eval batch
-        self.loss_sum += float(step_out["loss_sum"])
-        self.correct += float(step_out["correct"])
-        self.count += float(step_out["count"])
+        self.pending.append(step_out)
+
+    def _drain(self) -> None:
+        if self.pending:
+            for out in jax.device_get(self.pending):
+                self.loss_sum += float(out["loss_sum"])
+                self.correct += float(out["correct"])
+                self.count += float(out["count"])
+            self.pending = []
 
     def accuracy(self) -> float:
+        self._drain()
         return self.correct / max(self.count, 1.0)
 
     def mean_loss(self) -> float:
+        self._drain()
         return self.loss_sum / max(self.count, 1.0)
 
     def reset(self) -> None:
         self.loss_sum = self.correct = self.count = 0.0
+        self.pending = []
 
 
 @dataclass

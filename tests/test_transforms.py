@@ -123,3 +123,24 @@ def test_train_transform_requires_rng():
     tf = make_transform(training=True)
     with pytest.raises(ValueError):
         tf(np.zeros((8, 64, 64, 3), np.uint8), None)
+
+
+def test_bf16_output_matches_fp32_cast():
+    """output_dtype="bfloat16" must equal the fp32 pipeline cast at the end
+    (the model casts on device anyway — host cast only moves the rounding)."""
+    import ml_dtypes
+
+    from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+
+    rng_frames = np.random.default_rng(0)
+    frames = (rng_frames.random((12, 48, 64, 3)) * 255).astype(np.uint8)
+    kw = dict(num_frames=4, training=True, crop_size=32,
+              min_short_side_scale=36, max_short_side_scale=40,
+              is_slowfast=True)
+    a = make_transform(**kw)(frames, np.random.default_rng(7))
+    b = make_transform(output_dtype="bfloat16", **kw)(
+        frames, np.random.default_rng(7))
+    for k in a:
+        assert b[k].dtype == np.dtype(ml_dtypes.bfloat16)
+        np.testing.assert_array_equal(
+            a[k].astype(ml_dtypes.bfloat16), b[k])
