@@ -90,6 +90,11 @@ class ModelConfig:
     attention: str = "dense"  # dense (XLA-fused) | pallas (ops/pallas_attention)
     # | ring | ulysses (context-parallel, parallel/ring_attention.py + ulysses.py)
     mask_ratio: float = 0.9  # VideoMAE pretrain tube-mask ratio
+    # per-block jax.checkpoint (rematerialization): only block-boundary
+    # activations (plus one block's interior at a time) stay resident,
+    # trading one extra forward of recompute for the activation HBM that
+    # gates long clips / bigger batches on a fixed chip
+    remat: bool = False
 
 
 @dataclass

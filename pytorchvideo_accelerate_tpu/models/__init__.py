@@ -104,6 +104,7 @@ def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
         dropout_rate=cfg.dropout_rate,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        remat=cfg.remat,
         dtype=dtype,
     )
 
@@ -116,6 +117,7 @@ def _videomae_b(cfg: ModelConfig, dtype, mesh=None):
         dropout_rate=cfg.dropout_rate,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        remat=cfg.remat,
         dtype=dtype,
     )
 
@@ -128,6 +130,7 @@ def _videomae_b_pretrain(cfg: ModelConfig, dtype, mesh=None):
         mask_ratio=cfg.mask_ratio,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        remat=cfg.remat,
         dtype=dtype,
     )
 

@@ -360,9 +360,9 @@ def x3d_torch_key_for(collection: str, path: Path) -> Optional[str]:
 #   head, so tiling it `heads` times across channels is exact. The pooling
 #   LayerNorm tiles the same way but normalizes over all channels rather
 #   than per head — an approximation, flagged in the report.
-# - dim change at the attention (qkv emits dim_out) vs torch's change in
-#   the MLP: stage-transition blocks (3 of 16 in MViT-B) keep their fresh
-#   init via load_pretrained's shape check.
+# - the flax MViT follows torch's block schedule exactly (dim change in the
+#   MLP before each stage start; see mvit.py MViTBlock), so qkv/proj/MLP/
+#   skip-proj shapes line up at every block including stage transitions.
 
 _MVIT_DIRECT = {
     "norm1": ("norm1", {"weight": "scale", "bias": "bias"}),

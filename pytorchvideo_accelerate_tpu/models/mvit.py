@@ -111,6 +111,14 @@ class MultiScaleAttention(nn.Module):
 
 
 class MViTBlock(nn.Module):
+    """One multiscale block, pytorchvideo MultiScaleBlock semantics
+    (dim_mul_in_att=False, the MViT-B/v1 layout): attention runs at the
+    INPUT dim (q-pooled grids included), the channel change to `dim_out`
+    happens in the MLP, and on dim-change blocks the residual is projected
+    from the norm2-ed activations — so every pretrained tensor of
+    pytorchvideo's create_multiscale_vision_transformers maps 1:1
+    (models/convert.py), stage-transition blocks included."""
+
     dim_out: int
     num_heads: int
     q_stride: Tuple[int, int, int] = (1, 1, 1)
@@ -124,35 +132,40 @@ class MViTBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        dim_in = x.shape[-1]
         shortcut = x
         y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
         y = MultiScaleAttention(
-            dim_out=self.dim_out, num_heads=self.num_heads,
+            dim_out=dim_in, num_heads=self.num_heads,
             q_stride=self.q_stride, kv_stride=self.kv_stride,
             attention_backend=self.attention_backend,
             context_axis=self.context_axis, context_mesh=self.context_mesh,
             dtype=self.dtype, name="attn",
         )(y)
-        # skip path: max-pool + linear when the grid/dim changes
+        # skip path: pool to the attention's q-pooled grid. pytorchvideo's
+        # pool_skip geometry: overlapping kernel = stride+1 (3 at stride 2)
+        # with padding kernel//2 — matching it keeps converted checkpoints'
+        # activations aligned with torch at stage-start blocks
         if self.q_stride != (1, 1, 1):
+            kernel = tuple(s + 1 if s > 1 else s for s in self.q_stride)
             shortcut = nn.max_pool(
                 shortcut,
-                window_shape=self.q_stride,
+                window_shape=kernel,
                 strides=self.q_stride,
-                padding="SAME",
+                padding=[(k // 2, k // 2) for k in kernel],
             )
-        if shortcut.shape[-1] != self.dim_out:
-            shortcut = nn.Dense(self.dim_out, dtype=self.dtype, name="skip_proj")(shortcut)
         rng = self.make_rng("dropout") if train and self.drop_path > 0 else None
         x = shortcut + _drop_path(y, self.drop_path, not train, rng)
 
         y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
-        y = nn.Dense(int(self.dim_out * self.mlp_ratio), dtype=self.dtype,
-                     name="mlp_fc1")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(self.dim_out, dtype=self.dtype, name="mlp_fc2")(y)
+        mlp = nn.Dense(int(dim_in * self.mlp_ratio), dtype=self.dtype,
+                       name="mlp_fc1")(y)
+        mlp = nn.gelu(mlp)
+        mlp = nn.Dense(self.dim_out, dtype=self.dtype, name="mlp_fc2")(mlp)
+        if self.dim_out != dim_in:  # residual projected from norm2(x)
+            x = nn.Dense(self.dim_out, dtype=self.dtype, name="skip_proj")(y)
         rng = self.make_rng("dropout") if train and self.drop_path > 0 else None
-        return x + _drop_path(y, self.drop_path, not train, rng)
+        return x + _drop_path(mlp, self.drop_path, not train, rng)
 
 
 class MViT(nn.Module):
@@ -173,6 +186,7 @@ class MViT(nn.Module):
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
     context_mesh: Optional[Any] = None
+    remat: bool = False  # per-block jax.checkpoint: boundary activations only
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -191,24 +205,36 @@ class MViT(nn.Module):
         )
         x = x + pos.astype(self.dtype)
 
+        # pytorchvideo's block schedule (vision_transformers.py dim_out
+        # look-ahead): the channel doubling happens in the MLP of the block
+        # BEFORE each stage start; the stage-start block then runs attention
+        # at the doubled dim with doubled heads and the (1,2,2) q-pooling,
+        # with the adaptive kv stride halving spatially at the same block.
+        # Keeps head_dim constant (96 for MViT-B) and makes every pretrained
+        # tensor shape line up (models/convert.py).
         dim, heads = self.embed_dim, self.num_heads
         kv_stride = list(self.initial_kv_stride)
         dpr = [self.drop_path_rate * i / max(self.depth - 1, 1) for i in range(self.depth)]
+        # train is static (python control flow in _drop_path)
+        block_cls = (nn.remat(MViTBlock, static_argnums=(2,)) if self.remat
+                     else MViTBlock)
         for i in range(self.depth):
             if i in self.stage_starts:
-                dim, heads = dim * 2, heads * 2
+                heads *= 2
                 q_stride = (1, 2, 2)
                 kv_stride = [max(s // 2, 1) if j > 0 else s
                              for j, s in enumerate(kv_stride)]
             else:
                 q_stride = (1, 1, 1)
-            x = MViTBlock(
-                dim_out=dim, num_heads=heads, q_stride=q_stride,
+            dim_out = dim * 2 if (i + 1) in self.stage_starts else dim
+            x = block_cls(
+                dim_out=dim_out, num_heads=heads, q_stride=q_stride,
                 kv_stride=tuple(kv_stride), mlp_ratio=self.mlp_ratio,
                 drop_path=dpr[i], attention_backend=self.attention_backend,
                 context_axis=self.context_axis, context_mesh=self.context_mesh,
                 dtype=self.dtype, name=f"block{i}",
             )(x, train)
+            dim = dim_out
 
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
         x = jnp.mean(x, axis=(1, 2, 3))

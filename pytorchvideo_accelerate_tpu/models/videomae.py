@@ -112,6 +112,7 @@ class VideoMAEEncoder(nn.Module):
     tubelet: Tuple[int, int, int] = (2, 16, 16)
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
+    remat: bool = False  # per-block jax.checkpoint: boundary activations only
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -125,8 +126,9 @@ class VideoMAEEncoder(nn.Module):
         tokens = tokens + pos.astype(tokens.dtype)
         if keep_idx is not None:
             tokens = jnp.take_along_axis(tokens, keep_idx[..., None], axis=1)
+        block_cls = nn.remat(ViTBlock) if self.remat else ViTBlock
         for i in range(self.depth):
-            tokens = ViTBlock(
+            tokens = block_cls(
                 dim=self.dim, num_heads=self.num_heads,
                 attention_backend=self.attention_backend,
                 context_mesh=self.context_mesh, dtype=self.dtype,
@@ -186,6 +188,7 @@ class VideoMAEForPretraining(nn.Module):
     norm_pix: bool = True
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
+    remat: bool = False
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -202,7 +205,8 @@ class VideoMAEForPretraining(nn.Module):
         enc, _ = VideoMAEEncoder(
             dim=self.dim, depth=self.depth, num_heads=self.num_heads,
             tubelet=self.tubelet, attention_backend=self.attention_backend,
-            context_mesh=self.context_mesh, dtype=self.dtype, name="encoder",
+            context_mesh=self.context_mesh, remat=self.remat,
+            dtype=self.dtype, name="encoder",
         )(x, keep_idx)                                   # (B, n_vis, dim)
 
         # decoder: project, scatter visible tokens + mask tokens, add pos
@@ -224,8 +228,9 @@ class VideoMAEForPretraining(nn.Module):
              mask_token.astype(dec_in.dtype) + msk_pos.astype(dec_in.dtype)],
             axis=1,
         )                                               # (B, n, dec_dim)
+        dec_block_cls = nn.remat(ViTBlock) if self.remat else ViTBlock
         for i in range(self.decoder_depth):
-            dec_tokens = ViTBlock(
+            dec_tokens = dec_block_cls(
                 dim=self.decoder_dim, num_heads=self.decoder_heads,
                 attention_backend=self.attention_backend,
                 context_mesh=self.context_mesh, dtype=self.dtype,
@@ -260,6 +265,7 @@ class VideoMAEClassifier(nn.Module):
     dropout_rate: float = 0.0
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
+    remat: bool = False
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -267,7 +273,8 @@ class VideoMAEClassifier(nn.Module):
         tokens, _ = VideoMAEEncoder(
             dim=self.dim, depth=self.depth, num_heads=self.num_heads,
             tubelet=self.tubelet, attention_backend=self.attention_backend,
-            context_mesh=self.context_mesh, dtype=self.dtype, name="encoder",
+            context_mesh=self.context_mesh, remat=self.remat,
+            dtype=self.dtype, name="encoder",
         )(x)
         feat = tokens.mean(axis=1)
         feat = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(feat)

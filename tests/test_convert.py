@@ -312,6 +312,70 @@ class TestMViTConvert:
         ln = leaves[("block0", "attn", "pool_k", "norm", "scale")]
         np.testing.assert_array_equal(ln[8:], ln[:8])
 
+    def test_stage_transition_block_fully_maps(self, tmp_path):
+        """Every tensor of a stage-transition schedule loads — the flax MViT
+        follows torch's dim-change-in-MLP block layout exactly (mvit.py)."""
+        from pytorchvideo_accelerate_tpu.models.mvit import MViT
+
+        rng = np.random.default_rng(3)
+
+        def randn(*shape):
+            return rng.standard_normal(shape).astype(np.float32)
+
+        t, h, w = 2, 8, 8
+        # block0: dim 16, heads 2, kv stride (1,2,2), dim_out 32 (MLP) + proj
+        # block1: dim 32, heads 4, q stride (1,2,2), kv stride -> (1,1,1)
+        sd = {
+            "patch_embed.patch_model.weight": randn(16, 3, 3, 7, 7),
+            "patch_embed.patch_model.bias": randn(16),
+            "cls_positional_encoding.pos_embed_spatial": randn(1, h * w, 16),
+            "cls_positional_encoding.pos_embed_temporal": randn(1, t, 16),
+            "norm.weight": randn(32), "norm.bias": randn(32),
+            "head.proj.weight": randn(7, 32), "head.proj.bias": randn(7),
+            "blocks.0.norm1.weight": randn(16), "blocks.0.norm1.bias": randn(16),
+            "blocks.0.attn.qkv.weight": randn(48, 16),
+            "blocks.0.attn.qkv.bias": randn(48),
+            "blocks.0.attn.pool_k.weight": randn(8, 1, 3, 3, 3),
+            "blocks.0.attn.norm_k.weight": randn(8),
+            "blocks.0.attn.norm_k.bias": randn(8),
+            "blocks.0.attn.pool_v.weight": randn(8, 1, 3, 3, 3),
+            "blocks.0.attn.norm_v.weight": randn(8),
+            "blocks.0.attn.norm_v.bias": randn(8),
+            "blocks.0.attn.proj.weight": randn(16, 16),
+            "blocks.0.attn.proj.bias": randn(16),
+            "blocks.0.norm2.weight": randn(16), "blocks.0.norm2.bias": randn(16),
+            "blocks.0.mlp.fc1.weight": randn(64, 16),
+            "blocks.0.mlp.fc1.bias": randn(64),
+            "blocks.0.mlp.fc2.weight": randn(32, 64),
+            "blocks.0.mlp.fc2.bias": randn(32),
+            "blocks.0.proj.weight": randn(32, 16),
+            "blocks.0.proj.bias": randn(32),
+            "blocks.1.norm1.weight": randn(32), "blocks.1.norm1.bias": randn(32),
+            "blocks.1.attn.qkv.weight": randn(96, 32),
+            "blocks.1.attn.qkv.bias": randn(96),
+            "blocks.1.attn.pool_q.weight": randn(8, 1, 3, 3, 3),
+            "blocks.1.attn.norm_q.weight": randn(8),
+            "blocks.1.attn.norm_q.bias": randn(8),
+            "blocks.1.attn.proj.weight": randn(32, 32),
+            "blocks.1.attn.proj.bias": randn(32),
+            "blocks.1.norm2.weight": randn(32), "blocks.1.norm2.bias": randn(32),
+            "blocks.1.mlp.fc1.weight": randn(128, 32),
+            "blocks.1.mlp.fc1.bias": randn(128),
+            "blocks.1.mlp.fc2.weight": randn(32, 128),
+            "blocks.1.mlp.fc2.bias": randn(32),
+        }
+        tree = convert_state_dict(sd, "mvit_b")
+        assert tree["skipped"] == [], tree["skipped"]
+        path = str(tmp_path / "mvit_trans.npz")
+        save_converted(tree, path)
+        model = MViT(num_classes=7, depth=2, embed_dim=16, num_heads=2,
+                     stage_starts=(1,), initial_kv_stride=(1, 2, 2),
+                     drop_path_rate=0.0, dropout_rate=0.0)
+        variables = model.init(jax.random.key(0),
+                               jnp.zeros((1, 4, 32, 32, 3)))
+        merged, report = load_pretrained(path, variables)
+        assert report["kept"] == [], report["kept"]
+
     def test_merge_into_model(self, tmp_path):
         sd = self._fake_sd()
         tree = convert_state_dict(sd, "mvit_b")

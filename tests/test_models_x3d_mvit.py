@@ -69,3 +69,48 @@ def test_mvit_droppath_train_mode():
                       rngs={"dropout": jax.random.key(1)})
     assert out.shape == (2, 3)
     assert np.all(np.isfinite(np.asarray(out)))
+
+
+class TestRemat:
+    """remat=True must be numerics-neutral (it only trades recompute for
+    activation HBM) for both transformer families."""
+
+    def _parity(self, mk):
+        import jax
+        import jax.numpy as jnp
+
+        x = np.random.default_rng(0).standard_normal(
+            (2, 4, 32, 32, 3)).astype(np.float32)
+        m0, m1 = mk(False), mk(True)
+        v = m0.init({"params": jax.random.key(0), "mask": jax.random.key(1)},
+                    jnp.asarray(x))
+
+        def loss(m, p):
+            out = m.apply({"params": p}, jnp.asarray(x),
+                          rngs={"mask": jax.random.key(2)})
+            return out["loss"] if isinstance(out, dict) else jnp.sum(out)
+
+        l0, g0 = jax.value_and_grad(lambda p: loss(m0, p))(v["params"])
+        l1, g1 = jax.value_and_grad(lambda p: loss(m1, p))(v["params"])
+        np.testing.assert_allclose(float(l0), float(l1), rtol=1e-5)
+        jax.tree.map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+            g0, g1,
+        )
+
+    def test_mvit_remat_parity(self):
+        from pytorchvideo_accelerate_tpu.models.mvit import MViT
+
+        self._parity(lambda r: MViT(
+            num_classes=5, depth=2, embed_dim=16, num_heads=2,
+            stage_starts=(1,), drop_path_rate=0.0, dropout_rate=0.0, remat=r))
+
+    def test_videomae_remat_parity(self):
+        from pytorchvideo_accelerate_tpu.models.videomae import (
+            VideoMAEForPretraining,
+        )
+
+        self._parity(lambda r: VideoMAEForPretraining(
+            dim=32, depth=2, num_heads=2, decoder_dim=16, decoder_depth=1,
+            decoder_heads=2, remat=r))
