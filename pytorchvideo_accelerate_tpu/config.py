@@ -232,12 +232,16 @@ def _coerce(value: str, default: Any):
     if isinstance(default, bool):
         if isinstance(value, bool):
             return value
+        if isinstance(value, (int, float)):  # config-file JSON 0/1
+            return bool(value)
         return value.lower() in ("1", "true", "yes", "y", "t")
     if isinstance(default, int):
         return int(value)
     if isinstance(default, float):
         return float(value)
     if isinstance(default, tuple):
+        if isinstance(value, (list, tuple)):  # config-file native lists
+            return tuple(type(default[0])(p) for p in value)
         parts = [p for p in str(value).replace("(", "").replace(")", "").split(",") if p]
         return tuple(type(default[0])(p) for p in parts)
     return value
@@ -256,17 +260,70 @@ def _set_dotted(cfg: TrainConfig, dotted: str, value: Any) -> None:
     setattr(obj, parts[-1], _coerce(value, current))
 
 
+def load_config_file(path: str, base: Optional[TrainConfig] = None) -> TrainConfig:
+    """Apply a JSON config file (flat or nested) onto a TrainConfig.
+
+    The `accelerate config` YAML tier's equivalent (SURVEY §5 "Config / flag
+    system"): persistent settings in a file, per-run overrides as flags.
+    Accepts `{"optim": {"lr": 0.1}}` nesting, dotted keys ("optim.lr"), or
+    the flat reference aliases ("lr"); `TrainConfig.to_json()` output loads
+    back unchanged.
+    """
+    cfg = base or TrainConfig()
+    valid = {name for name, _ in _leaf_fields()}
+    with open(path) as f:
+        data = json.load(f)
+
+    def apply(tree: dict, prefix: str) -> None:
+        for k, v in tree.items():
+            dotted = prefix + str(k).replace("-", "_")
+            if isinstance(v, dict) and dotted not in valid:
+                apply(v, dotted + ".")
+                continue
+            dotted = _REFERENCE_ALIASES.get(dotted, dotted)
+            if dotted not in valid:
+                raise ValueError(f"unknown config key {dotted!r} in {path}")
+            _set_dotted(cfg, dotted, v)
+
+    apply(data, "")
+    return cfg
+
+
 def parse_cli(argv: Optional[Sequence[str]] = None, base: Optional[TrainConfig] = None) -> TrainConfig:
     """Parse ``--flag value`` / ``--flag=value`` / bare boolean ``--flag``.
 
     Accepts both dotted names (``--optim.lr``) and the reference's flat flag
     names (``--lr``), including ``--is_slowfast`` which maps onto
     ``model.name=slowfast_r50`` for drop-in launch-script compatibility.
+    ``--config file.json`` loads a config file FIRST (flags override it) —
+    the `accelerate config` two-tier equivalent.
     """
     import sys
 
     argv = list(sys.argv[1:] if argv is None else argv)
     cfg = base or TrainConfig()
+    # config files apply before any flag, wherever --config appears
+    def load_file(path):
+        try:
+            return load_config_file(path, base=cfg)
+        except (OSError, ValueError) as e:  # ValueError covers bad JSON too
+            raise SystemExit(f"--config {path}: {e}")
+
+    remaining = []
+    i = 0
+    while i < len(argv):
+        if argv[i] in ("--config", "--config_file"):
+            if i + 1 >= len(argv):
+                raise SystemExit("--config requires a file path")
+            cfg = load_file(argv[i + 1])
+            i += 2
+        elif argv[i].startswith(("--config=", "--config_file=")):
+            cfg = load_file(argv[i].split("=", 1)[1])
+            i += 1
+        else:
+            remaining.append(argv[i])
+            i += 1
+    argv = remaining
     valid = {name for name, _ in _leaf_fields()}
 
     i = 0
@@ -307,7 +364,11 @@ def parse_cli(argv: Optional[Sequence[str]] = None, base: Optional[TrainConfig] 
 
 
 def usage() -> str:
-    lines = ["flags (dotted or reference-style):"]
+    lines = [
+        "flags (dotted or reference-style):",
+        "  --config FILE.json (JSON config applied before flags; nested,",
+        "      dotted, or flat-alias keys — see load_config_file)",
+    ]
     for name, default in _leaf_fields():
         lines.append(f"  --{name} (default: {default!r})")
     return "\n".join(lines)

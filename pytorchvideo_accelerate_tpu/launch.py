@@ -65,13 +65,38 @@ def build_commands(num_processes: int, prog: List[str]) -> List[List[str]]:
 
 def launch(num_processes: int, prog: List[str],
            coordinator_address: str = "", env_extra: Optional[dict] = None,
-           timeout: Optional[float] = None) -> int:
-    """Spawn the process group; returns the first non-zero exit code or 0."""
+           timeout: Optional[float] = None, max_restarts: int = 0) -> int:
+    """Spawn the process group; returns the first non-zero exit code or 0.
+
+    `max_restarts`: torchelastic-style supervision (the reference launch
+    path's `torch.distributed.run` restart-on-failure semantics,
+    accelerate/commands/launch.py:999,1023): on any rank failure the whole
+    group is torn down and relaunched, up to `max_restarts` times. Pair with
+    `--resume_from_checkpoint auto` so relaunched training continues from
+    the latest checkpoint. `timeout` applies per attempt.
+    """
     if num_processes < 1:
         raise ValueError(f"--num_processes must be >= 1, got {num_processes}")
-    coordinator_address = (
-        coordinator_address or f"127.0.0.1:{find_free_port()}"
-    )
+    if max_restarts < 0:
+        raise ValueError(f"--max_restarts must be >= 0, got {max_restarts}")
+    for attempt in range(max_restarts + 1):
+        # fresh coordinator port per attempt unless pinned: the previous
+        # attempt's dying coordinator may still hold the old one
+        addr = coordinator_address or f"127.0.0.1:{find_free_port()}"
+        rc = _run_group(num_processes, prog, addr, env_extra, timeout)
+        # rc 130 = KeyboardInterrupt: the user asked to stop, don't relaunch
+        if rc in (0, 130) or attempt == max_restarts:
+            return rc
+        sys.stderr.write(
+            f"[launch] group failed (rc {rc}); restart "
+            f"{attempt + 1}/{max_restarts}\n"
+        )
+    return rc
+
+
+def _run_group(num_processes: int, prog: List[str], coordinator_address: str,
+               env_extra: Optional[dict], timeout: Optional[float]) -> int:
+    """One process-group attempt."""
     cmds = build_commands(num_processes, prog)
     procs: List[subprocess.Popen] = []
     threads: List[threading.Thread] = []
@@ -139,7 +164,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     help="host:port of the jax.distributed coordinator "
                          "(default: 127.0.0.1 with a free port)")
     ap.add_argument("--timeout", type=float, default=None,
-                    help="kill the group after this many seconds")
+                    help="kill the group after this many seconds (per attempt)")
+    ap.add_argument("--max_restarts", type=int, default=0,
+                    help="relaunch the whole group on failure up to N times "
+                         "(pair with --resume_from_checkpoint auto)")
     ap.add_argument("prog", nargs=argparse.REMAINDER,
                     help="script.py + args, or args for the default "
                          "training module")
@@ -149,7 +177,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         prog = prog[1:]
     return launch(args.num_processes, prog,
                   coordinator_address=args.coordinator_address,
-                  timeout=args.timeout)
+                  timeout=args.timeout, max_restarts=args.max_restarts)
 
 
 if __name__ == "__main__":

@@ -65,3 +65,65 @@ def test_unknown_flag_rejected():
 def test_tuple_coercion():
     cfg = parse_cli(["--data.mean", "0.5,0.5,0.5"])
     assert cfg.data.mean == (0.5, 0.5, 0.5)
+
+
+class TestConfigFile:
+    """--config file.json: the `accelerate config` two-tier equivalent
+    (persistent file, per-run flag overrides; SURVEY §5 config system)."""
+
+    def test_nested_dotted_and_alias_keys(self, tmp_path):
+        import json
+
+        from pytorchvideo_accelerate_tpu.config import parse_cli
+
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({
+            "optim": {"lr": 0.05, "num_epochs": 3},
+            "data.crop_size": 128,
+            "batch_size": 4,            # flat reference alias
+            "mesh": {"fsdp": 2},
+            "data": {"mean": [0.5, 0.5, 0.5]},
+        }))
+        cfg = parse_cli(["--config", str(p)])
+        assert cfg.optim.lr == 0.05
+        assert cfg.optim.num_epochs == 3
+        assert cfg.data.crop_size == 128
+        assert cfg.data.batch_size == 4
+        assert cfg.mesh.fsdp == 2
+        assert cfg.data.mean == (0.5, 0.5, 0.5)
+
+    def test_flags_override_config_file(self, tmp_path):
+        import json
+
+        from pytorchvideo_accelerate_tpu.config import parse_cli
+
+        p = tmp_path / "cfg.json"
+        p.write_text(json.dumps({"optim": {"lr": 0.05}}))
+        cfg = parse_cli(["--config", str(p), "--lr", "0.2"])
+        assert cfg.optim.lr == 0.2
+        cfg = parse_cli(["--lr", "0.2", f"--config={p}"])
+        assert cfg.optim.lr == 0.2  # file applies first regardless of order
+
+    def test_to_json_round_trips(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.config import TrainConfig, parse_cli
+
+        src = TrainConfig()
+        src.optim.lr = 0.33
+        src.model.name = "x3d_s"
+        p = tmp_path / "dump.json"
+        p.write_text(src.to_json())
+        cfg = parse_cli(["--config", str(p)])
+        assert cfg.optim.lr == 0.33
+        assert cfg.model.name == "x3d_s"
+
+    def test_unknown_key_rejected(self, tmp_path):
+        import json
+
+        import pytest
+
+        from pytorchvideo_accelerate_tpu.config import load_config_file
+
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"optim": {"learning_rate_typo": 1}}))
+        with pytest.raises(ValueError, match="learning_rate_typo"):
+            load_config_file(str(p))

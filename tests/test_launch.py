@@ -78,3 +78,31 @@ def test_failure_propagates_and_tears_down(tmp_path):
         env=env, capture_output=True, text=True, timeout=120,
     )
     assert proc.returncode == 3
+
+
+def test_max_restarts_recovers_transient_failure(tmp_path):
+    """torchelastic-style supervision: a rank that crashes once is cured by
+    a whole-group relaunch (resume path's recovery contract, SURVEY §5)."""
+    flaky = tmp_path / "flaky.py"
+    marker = tmp_path / "attempted"
+    flaky.write_text(
+        "import os, sys, pathlib\n"
+        f"m = pathlib.Path({str(marker)!r})\n"
+        "if not m.exists():\n"
+        "    m.touch()\n"
+        "    sys.exit(7)  # first group attempt fails\n"
+        "sys.exit(0)\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    base = [sys.executable, "-m", "pytorchvideo_accelerate_tpu.launch",
+            "--num_processes", "2", "--timeout", "60"]
+    # without supervision the failure is final
+    proc = subprocess.run(base + ["--", str(flaky)], env=env,
+                          capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 7
+    marker.unlink()
+    proc = subprocess.run(base + ["--max_restarts", "2", "--", str(flaky)],
+                          env=env, capture_output=True, text=True, timeout=240)
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "restart 1/2" in proc.stderr
