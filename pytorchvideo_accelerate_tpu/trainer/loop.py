@@ -497,6 +497,9 @@ class Trainer:
             if self.checkpointing_steps == "epoch":
                 self._save("epoch", epoch)
 
+        if profiling:  # runs shorter than the step window still get a trace
+            jax.profiler.stop_trace()
+            main_print(f"profile trace written to {cfg.profile_dir}")
         if self.trackers:
             self.trackers.finish()
         # final save (reference run.py:325, minus its NameError footgun)

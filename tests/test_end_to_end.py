@@ -150,3 +150,14 @@ def test_register_for_checkpointing_round_trip(tmp_path):
     import pytest as _pytest
     with _pytest.raises(TypeError):
         tr2.register_for_checkpointing("bad", object())
+
+
+def test_profile_writes_trace(tmp_path):
+    """--profile captures a jax.profiler trace of the step window
+    (SURVEY §5 tracing; trainer/loop.py steps 2-6)."""
+    cfg = _cfg(tmp_path, **{"optim.num_epochs": 2})
+    cfg.profile = True
+    cfg.profile_dir = str(tmp_path / "trace")
+    Trainer(cfg).fit()
+    found = list((tmp_path / "trace").rglob("*"))
+    assert any(f.is_file() for f in found), "no trace artifacts written"
