@@ -546,6 +546,70 @@ def load_converted(path: str) -> dict:
     return tree
 
 
+def export_checkpoint_params(ckpt_dir: str, dst: str,
+                             step: Optional[int] = None) -> int:
+    """Orbax training checkpoint (trainer/checkpoint.py layout) -> flat npz
+    weight artifact usable as `ModelConfig.pretrained_path`.
+
+    This is the pretrain->fine-tune handoff of BASELINE config 5: export a
+    `videomae_b_pretrain` run's checkpoint, then fine-tune `videomae_b` with
+    `--model.pretrained --model.pretrained_path out.npz` — the shared
+    `encoder` subtree merges name-for-name, the fresh classifier head stays
+    (same head-swap semantics as the torch-hub path, run.py:109,117).
+    Returns the exported step.
+    """
+    import os
+
+    import jax
+    import orbax.checkpoint as ocp
+
+    mgr = ocp.CheckpointManager(os.path.abspath(ckpt_dir))
+    try:
+        if step is None:
+            step = mgr.latest_step()
+        if step is None:
+            raise FileNotFoundError(f"no checkpoint in {ckpt_dir}")
+    finally:
+        mgr.close()
+
+    state_path = os.path.join(os.path.abspath(ckpt_dir), str(step), "state")
+    ckptr = ocp.PyTreeCheckpointer()
+    try:
+        # partial restore: read ONLY params/batch_stats — opt_state is
+        # 1-2x the params size and irrelevant to a weight artifact
+        meta = ckptr.metadata(state_path).item_metadata
+        wanted = {k: meta[k] for k in ("params", "batch_stats") if k in meta}
+        template = jax.tree.map(
+            lambda m: jax.ShapeDtypeStruct(m.shape, m.dtype), wanted
+        )
+        restore_args = jax.tree.map(lambda _: ocp.RestoreArgs(), template)
+        state = ckptr.restore(
+            state_path,
+            args=ocp.args.PyTreeRestore(item=template, transforms={},
+                                        restore_args=restore_args),
+        )
+    except Exception:  # orbax API drift: fall back to a full restore
+        from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+        get_logger("pva_tpu").warning(
+            "partial checkpoint restore failed; falling back to "
+            "full-state restore (reads opt_state too)")
+        with ocp.CheckpointManager(os.path.abspath(ckpt_dir)) as mgr2:
+            state = mgr2.restore(
+                int(step),
+                args=ocp.args.Composite(state=ocp.args.StandardRestore()),
+            )["state"]
+    finally:
+        ckptr.close()
+    tree = {
+        "params": jax.tree.map(np.asarray, state["params"]),
+        "batch_stats": jax.tree.map(np.asarray,
+                                    state.get("batch_stats") or {}),
+    }
+    save_converted(tree, dst)
+    return int(step)
+
+
 # --- entry point used by the Trainer ---------------------------------------
 
 def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
@@ -618,18 +682,34 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
 
 
 def main(argv=None):
-    """CLI: convert a torch hub checkpoint to the npz artifact.
+    """CLI: convert weights to the npz artifact.
 
-    python -m pytorchvideo_accelerate_tpu.models.convert SRC.pth OUT.npz \
-        --model slowfast_r50
+    torch hub checkpoint:
+        python -m pytorchvideo_accelerate_tpu.models.convert SRC.pth OUT.npz \
+            --model slowfast_r50
+    own orbax checkpoint (pretrain -> fine-tune handoff):
+        python -m pytorchvideo_accelerate_tpu.models.convert CKPT_DIR OUT.npz
     """
     import argparse
+    import os
 
     ap = argparse.ArgumentParser(description=main.__doc__)
     ap.add_argument("src")
     ap.add_argument("dst")
     ap.add_argument("--model", default="slow_r50")
+    ap.add_argument("--step", type=int, default=None,
+                    help="checkpoint step (orbax dirs; default: latest)")
     args = ap.parse_args(argv)
+
+    if os.path.isdir(args.src):  # orbax checkpoint directory
+        # host-side tool: never let orbax's jax touch wake an accelerator
+        # backend (the axon tunnel can hang at init)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        step = export_checkpoint_params(args.src, args.dst, step=args.step)
+        print(f"exported params of step {step} from {args.src} -> {args.dst}")
+        return
 
     import torch
 

@@ -386,120 +386,129 @@ class Trainer:
         # dispatching the next one, killing async-dispatch pipelining
         # (VERDICT r2 weak #4) — metrics are only fetched every `log_every`
         gstep = int(self.state.step)
+        # profile window is relative to THIS run's first step, so resumed
+        # runs (gstep >> 0) still capture a trace
+        run_start_step = gstep
         metrics = None
-        for epoch in range(starting_epoch, cfg.optim.num_epochs):
-            if use_tqdm:
-                progress.set_description_str(f"Epoch: {epoch}")
-            epoch_loss = MeanLoss()
-            t_epoch = time.time()
-            train_steps_this_epoch = 0
-
-            for step_in_epoch, batch in enumerate(self.train_loader.epoch(epoch)):
-                if cfg.profile and not profiling and gstep == 2:
-                    jax.profiler.start_trace(cfg.profile_dir)
-                    profiling = True
-                global_batch = shard_batch(
-                    self.mesh, batch,
-                    micro_dim=cfg.optim.gradient_accumulation_steps > 1,
-                )
-                with jax.profiler.StepTraceAnnotation("train", step_num=gstep):
-                    self.state, metrics = self.train_step(
-                        self.state, global_batch, self.rng.step_key(gstep)
-                    )
-                gstep += 1
-                train_steps_this_epoch += 1
-                if self.trackers and self._flops_per_step is None:
-                    self._capture_step_flops(global_batch, gstep)
-                if profiling and gstep >= 6:
-                    jax.profiler.stop_trace()
-                    profiling = False
-                    main_print(f"profile trace written to {cfg.profile_dir}")
-
+        try:
+            for epoch in range(starting_epoch, cfg.optim.num_epochs):
                 if use_tqdm:
-                    progress.update(1)
-                # device scalar; the host->device sync happens at epoch end
-                # (MeanLoss.mean) or at the log_every fetch below
-                epoch_loss.update_async(metrics["loss"])
-                if self.trackers and gstep % cfg.tracking.log_every == 0:
-                    self.trackers.log(
-                        {"train_loss_step": float(metrics["loss"]),
-                         "lr": float(metrics["lr"]),
-                         "grad_norm": float(metrics["grad_norm"])},
-                        step=gstep,
+                    progress.set_description_str(f"Epoch: {epoch}")
+                epoch_loss = MeanLoss()
+                t_epoch = time.time()
+                train_steps_this_epoch = 0
+
+                for step_in_epoch, batch in enumerate(self.train_loader.epoch(epoch)):
+                    if (cfg.profile and not profiling
+                            and gstep - run_start_step == 2):
+                        jax.profiler.start_trace(cfg.profile_dir)
+                        profiling = True
+                    global_batch = shard_batch(
+                        self.mesh, batch,
+                        micro_dim=cfg.optim.gradient_accumulation_steps > 1,
                     )
-                if isinstance(self.checkpointing_steps, int) and (
-                    gstep % self.checkpointing_steps == 0
-                ):
-                    self._save("step", epoch)
-                    main_print(f"saved checkpoint at step {gstep}")
-                if 0 <= cfg.data.limit_train_batches <= step_in_epoch + 1:
-                    break
-            if metrics is not None:
-                jax.block_until_ready(metrics["loss"])
-            epoch_train_times.append(time.time() - t_epoch)
+                    with jax.profiler.StepTraceAnnotation("train", step_num=gstep):
+                        self.state, metrics = self.train_step(
+                            self.state, global_batch, self.rng.step_key(gstep)
+                        )
+                    gstep += 1
+                    train_steps_this_epoch += 1
+                    if self.trackers and self._flops_per_step is None:
+                        self._capture_step_flops(global_batch, gstep)
+                    if profiling and gstep - run_start_step >= 6:
+                        jax.profiler.stop_trace()
+                        profiling = False
+                        main_print(f"profile trace written to {cfg.profile_dir}")
 
-            # Evaluation (reference run.py:287-304, in-graph metric sums)
-            val = SumMetrics()
-            for step_in_epoch, batch in enumerate(self.val_loader.epoch(epoch)):
-                out = self.eval_step(self.state, shard_batch(self.mesh, batch))
-                val.update(out)
-                if 0 <= cfg.data.limit_val_batches <= step_in_epoch + 1:
-                    break
-            last_val_acc = val.accuracy()
-            last_val_loss = val.mean_loss()
-            last_train_loss = epoch_loss.mean()
-            val_str = (
-                f"val_recon_loss={last_val_loss:.4f}" if self.is_pretraining
-                else f"val_acc={last_val_acc:.4f}"
-            )
-            main_print(
-                f"epoch {epoch}: {val_str} "
-                f"train_loss={last_train_loss:.4f} "
-                f"({time.time() - t_epoch:.1f}s)"
-            )
-            if self.trackers:
-                epoch_metrics = {"train_loss_epoch": last_train_loss,
-                                 "epoch": epoch}
-                if self.is_pretraining:
-                    epoch_metrics["val_recon_loss"] = last_val_loss
-                else:
-                    epoch_metrics["accuracy"] = last_val_acc
-                # epoch throughput + (when XLA's cost model is available)
-                # achieved TFLOP/s and MFU against the chip's bf16 peak
-                steps_done = train_steps_this_epoch
-                t_train = epoch_train_times[-1]
-                if t_train > 0 and steps_done > 0:
-                    sps = steps_done / t_train
-                    epoch_metrics["steps_per_sec"] = sps
-                    epoch_metrics["clips_per_sec"] = (
-                        sps * self.train_loader.global_batch_size
-                        * self.train_loader.accum_steps
-                    )
-                    if self._flops_per_step:
-                        from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
+                    if use_tqdm:
+                        progress.update(1)
+                    # device scalar; the host->device sync happens at epoch end
+                    # (MeanLoss.mean) or at the log_every fetch below
+                    epoch_loss.update_async(metrics["loss"])
+                    if self.trackers and gstep % cfg.tracking.log_every == 0:
+                        self.trackers.log(
+                            {"train_loss_step": float(metrics["loss"]),
+                             "lr": float(metrics["lr"]),
+                             "grad_norm": float(metrics["grad_norm"])},
+                            step=gstep,
+                        )
+                    if isinstance(self.checkpointing_steps, int) and (
+                        gstep % self.checkpointing_steps == 0
+                    ):
+                        self._save("step", epoch)
+                        main_print(f"saved checkpoint at step {gstep}")
+                    if 0 <= cfg.data.limit_train_batches <= step_in_epoch + 1:
+                        break
+                if metrics is not None:
+                    jax.block_until_ready(metrics["loss"])
+                epoch_train_times.append(time.time() - t_epoch)
 
-                        n_dev = len(jax.devices())
-                        tflops = self._flops_per_step * sps / 1e12 / n_dev
-                        epoch_metrics["tflops_per_sec_per_chip"] = tflops
-                        peak = peak_tflops(jax.devices()[0])
-                        if peak:
-                            epoch_metrics["mfu"] = tflops / peak
-                self.trackers.log(epoch_metrics, step=epoch)
-            if cfg.debug_desync:
-                import optax
-
-                from pytorchvideo_accelerate_tpu.parallel.distributed import (
-                    check_desync,
+                # Evaluation (reference run.py:287-304, in-graph metric sums)
+                val = SumMetrics()
+                for step_in_epoch, batch in enumerate(self.val_loader.epoch(epoch)):
+                    out = self.eval_step(self.state, shard_batch(self.mesh, batch))
+                    val.update(out)
+                    if 0 <= cfg.data.limit_val_batches <= step_in_epoch + 1:
+                        break
+                last_val_acc = val.accuracy()
+                last_val_loss = val.mean_loss()
+                last_train_loss = epoch_loss.mean()
+                val_str = (
+                    f"val_recon_loss={last_val_loss:.4f}" if self.is_pretraining
+                    else f"val_acc={last_val_acc:.4f}"
                 )
+                main_print(
+                    f"epoch {epoch}: {val_str} "
+                    f"train_loss={last_train_loss:.4f} "
+                    f"({time.time() - t_epoch:.1f}s)"
+                )
+                if self.trackers:
+                    epoch_metrics = {"train_loss_epoch": last_train_loss,
+                                     "epoch": epoch}
+                    if self.is_pretraining:
+                        epoch_metrics["val_recon_loss"] = last_val_loss
+                    else:
+                        epoch_metrics["accuracy"] = last_val_acc
+                    # epoch throughput + (when XLA's cost model is available)
+                    # achieved TFLOP/s and MFU against the chip's bf16 peak
+                    steps_done = train_steps_this_epoch
+                    t_train = epoch_train_times[-1]
+                    if t_train > 0 and steps_done > 0:
+                        sps = steps_done / t_train
+                        epoch_metrics["steps_per_sec"] = sps
+                        epoch_metrics["clips_per_sec"] = (
+                            sps * self.train_loader.global_batch_size
+                            * self.train_loader.accum_steps
+                        )
+                        if self._flops_per_step:
+                            from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
 
-                check_desync(float(optax.global_norm(self.state.params)),
-                             name=f"params@epoch{epoch}")
-            if self.checkpointing_steps == "epoch":
-                self._save("epoch", epoch)
+                            n_dev = len(jax.devices())
+                            tflops = self._flops_per_step * sps / 1e12 / n_dev
+                            epoch_metrics["tflops_per_sec_per_chip"] = tflops
+                            peak = peak_tflops(jax.devices()[0])
+                            if peak:
+                                epoch_metrics["mfu"] = tflops / peak
+                    self.trackers.log(epoch_metrics, step=epoch)
+                if cfg.debug_desync:
+                    import optax
 
-        if profiling:  # runs shorter than the step window still get a trace
-            jax.profiler.stop_trace()
-            main_print(f"profile trace written to {cfg.profile_dir}")
+                    from pytorchvideo_accelerate_tpu.parallel.distributed import (
+                        check_desync,
+                    )
+
+                    check_desync(float(optax.global_norm(self.state.params)),
+                                 name=f"params@epoch{epoch}")
+                if self.checkpointing_steps == "epoch":
+                    self._save("epoch", epoch)
+
+        finally:
+            # flush a partial trace even when the run dies mid-window —
+            # that trace is most valuable exactly when diagnosing a crash
+            if profiling:
+                jax.profiler.stop_trace()
+                main_print(f"profile trace written to {cfg.profile_dir}")
+
         if self.trackers:
             self.trackers.finish()
         # final save (reference run.py:325, minus its NameError footgun)
