@@ -573,6 +573,10 @@ def export_checkpoint_params(ckpt_dir: str, dst: str,
         mgr.close()
 
     state_path = os.path.join(os.path.abspath(ckpt_dir), str(step), "state")
+    if not os.path.isdir(state_path):
+        raise FileNotFoundError(
+            f"checkpoint step {step} has no state at {state_path}"
+        )
     ckptr = ocp.PyTreeCheckpointer()
     try:
         # partial restore: read ONLY params/batch_stats — opt_state is

@@ -98,5 +98,5 @@ def test_pretrain_export_finetune(tmp_path):
 
 
 def test_export_missing_checkpoint_raises(tmp_path):
-    with pytest.raises(Exception):
+    with pytest.raises(FileNotFoundError):
         export_checkpoint_params(str(tmp_path / "empty"), str(tmp_path / "o.npz"))
