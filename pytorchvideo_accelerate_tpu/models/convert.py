@@ -469,6 +469,132 @@ def convert_mvit_state_dict(sd: Dict[str, np.ndarray]) -> dict:
     return out
 
 
+# --- VideoMAE (HF transformers VideoMAE* tree) ------------------------------
+#
+# Torch tree (transformers models/videomae/modeling_videomae.py):
+# [videomae.]embeddings.patch_embeddings.projection Conv3d;
+# [videomae.]encoder.layer.i = attention.attention.{query,key,value}.weight
+# (bias=False) + separate q_bias/v_bias params (k bias is zero by
+# construction), attention.output.dense, intermediate.dense, output.dense,
+# layernorm_before/after; [videomae.]layernorm (only when
+# use_mean_pooling=False); classification head = fc_norm + classifier;
+# pretraining adds encoder_to_decoder (no bias), mask_token,
+# decoder.decoder_layers.i (same layer tree), decoder.norm, decoder.head.
+# Position embeddings are fixed sin-cos tensors (not in the state_dict) —
+# videomae.sincos_pos_embed reproduces the exact table.
+#
+# Our flax tree (models/videomae.py): encoder/patch_embed/proj,
+# encoder/block{i}/{norm1,qkv,proj,norm2,mlp_fc1,mlp_fc2}, encoder/norm,
+# fc_norm + head (classifier), enc_to_dec + mask_token + dec_block{i} +
+# dec_norm + dec_pred (pretraining). The q/k/v linears fuse into one qkv
+# kernel; the qkv bias is [q_bias, zeros, v_bias].
+
+_HF_VIT_LAYER = {
+    "layernorm_before": "norm1",
+    "layernorm_after": "norm2",
+    "attention.output.dense": "proj",
+    "intermediate.dense": "mlp_fc1",
+    "output.dense": "mlp_fc2",
+}
+_HF_VIDEOMAE_TOP = {
+    "embeddings.patch_embeddings.projection.weight":
+        ("encoder", "patch_embed", "proj", "kernel"),
+    "embeddings.patch_embeddings.projection.bias":
+        ("encoder", "patch_embed", "proj", "bias"),
+    "layernorm.weight": ("encoder", "norm", "scale"),
+    "layernorm.bias": ("encoder", "norm", "bias"),
+    "fc_norm.weight": ("fc_norm", "scale"),
+    "fc_norm.bias": ("fc_norm", "bias"),
+    "classifier.weight": ("head", "kernel"),
+    "classifier.bias": ("head", "bias"),
+    "encoder_to_decoder.weight": ("enc_to_dec", "kernel"),
+    "mask_token": ("mask_token",),
+    "decoder.norm.weight": ("dec_norm", "scale"),
+    "decoder.norm.bias": ("dec_norm", "bias"),
+    "decoder.head.weight": ("dec_pred", "kernel"),
+    "decoder.head.bias": ("dec_pred", "bias"),
+}
+
+
+def convert_videomae_state_dict(sd: Dict[str, np.ndarray]) -> dict:
+    """HF VideoMAE{Model,ForVideoClassification,ForPreTraining} state_dict ->
+    flax tree for videomae.py's models (cross-key: q/k/v fuse into one qkv)."""
+    out: dict = {"params": {}, "batch_stats": {}, "skipped": []}
+    plain = {}
+    for k, v in sd.items():
+        plain[k[len("videomae."):] if k.startswith("videomae.") else k] = \
+            np.asarray(v)
+
+    def layer_target(key):
+        m = re.match(r"encoder\.layer\.(\d+)\.(.*)", key)
+        if m:
+            return ("encoder", f"block{m.group(1)}"), m.group(2)
+        m = re.match(r"decoder\.decoder_layers\.(\d+)\.(.*)", key)
+        if m:
+            return (f"dec_block{m.group(1)}",), m.group(2)
+        return None, None
+
+    layers: Dict[Path, Dict[str, np.ndarray]] = {}
+    for key, arr in plain.items():
+        block, rest = layer_target(key)
+        if block is not None:
+            layers.setdefault(block, {})[rest] = arr
+            continue
+        if key in _HF_VIDEOMAE_TOP:
+            path = _HF_VIDEOMAE_TOP[key]
+            _set_path(out["params"], path, convert_tensor(path, arr))
+        else:
+            out["skipped"].append(key)
+
+    # use_mean_pooling=False classifiers read the CLS-position token
+    # (sequence_output[:, 0]) instead of mean-pool + fc_norm; our
+    # VideoMAEClassifier can't represent that readout, so flag it loudly
+    # rather than convert to a silently different function.
+    if "classifier.weight" in plain and "fc_norm.weight" not in plain:
+        out["skipped"].append(
+            "(!) classifier without fc_norm (use_mean_pooling=False): "
+            "token-0 readout is not representable by VideoMAEClassifier's "
+            "mean-pool head — fc_norm stays fresh-initialized"
+        )
+
+    for block, members in layers.items():
+        qw = members.pop("attention.attention.query.weight", None)
+        kw = members.pop("attention.attention.key.weight", None)
+        vw = members.pop("attention.attention.value.weight", None)
+        if qw is not None and kw is not None and vw is not None:
+            _set_path(out["params"], block + ("qkv", "kernel"),
+                      np.concatenate([w.T for w in (qw, kw, vw)], axis=1))
+        elif any(w is not None for w in (qw, kw, vw)):  # partial q/k/v: report,
+            for name, w in (("query.weight", qw), ("key.weight", kw),
+                            ("value.weight", vw)):  # don't silently drop
+                if w is not None:
+                    out["skipped"].append(
+                        "/".join(block) + ".attention.attention." + name)
+        qb = members.pop("attention.attention.q_bias", None)
+        vb = members.pop("attention.attention.v_bias", None)
+        if qb is not None and vb is not None:
+            _set_path(out["params"], block + ("qkv", "bias"),
+                      np.concatenate([qb, np.zeros_like(qb), vb]))
+        elif any(b is not None for b in (qb, vb)):
+            for name, b in (("q_bias", qb), ("v_bias", vb)):
+                if b is not None:
+                    out["skipped"].append(
+                        "/".join(block) + ".attention.attention." + name)
+        for rest, arr in members.items():
+            for torch_name, flax_name in _HF_VIT_LAYER.items():
+                m = re.match(rf"{re.escape(torch_name)}\.(weight|bias)$", rest)
+                if m:
+                    leaf = ("kernel" if m.group(1) == "weight" else "bias") \
+                        if "dense" in torch_name else \
+                        ("scale" if m.group(1) == "weight" else "bias")
+                    path = block + (flax_name, leaf)
+                    _set_path(out["params"], path, convert_tensor(path, arr))
+                    break
+            else:
+                out["skipped"].append("/".join(block) + "." + rest)
+    return out
+
+
 def convert_tensor(path: Path, arr: np.ndarray) -> np.ndarray:
     """Apply the torch->flax layout transpose for one tensor."""
     if path[-1] == "kernel":
@@ -496,6 +622,20 @@ def _set_path(tree: dict, path: Path, value) -> None:
     node[path[-1]] = value
 
 
+def detect_model(sd: Dict) -> str:
+    """Guess the model family from a torch state_dict's key shapes (used when
+    the caller gives no --model hint)."""
+    if any("multipathway" in k for k in sd):
+        return "slowfast"
+    if any(k.startswith("cls_positional_encoding") for k in sd):
+        return "mvit_b"
+    if any("patch_embeddings.projection" in k for k in sd):
+        return "videomae_b"
+    if "blocks.0.conv.conv_t.weight" in sd:
+        return "x3d_s"
+    return "slow_r50"
+
+
 def convert_state_dict(sd: Dict[str, np.ndarray], model: str) -> dict:
     """torch state_dict -> {"params": pytree, "batch_stats": pytree}.
 
@@ -504,6 +644,8 @@ def convert_state_dict(sd: Dict[str, np.ndarray], model: str) -> dict:
     might)."""
     if model.startswith("mvit"):
         return convert_mvit_state_dict(sd)
+    if model.startswith("videomae"):
+        return convert_videomae_state_dict(sd)
     out: dict = {"params": {}, "batch_stats": {}, "skipped": []}
     for key, value in sd.items():
         arr = np.asarray(value)
@@ -639,14 +781,7 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
         if isinstance(sd, dict) and "state_dict" in sd:
             sd = sd["state_dict"]
         if not model:
-            if any("multipathway" in k for k in sd):
-                model = "slowfast"
-            elif any(k.startswith("cls_positional_encoding") for k in sd):
-                model = "mvit_b"
-            elif "blocks.0.conv.conv_t.weight" in sd:
-                model = "x3d_s"
-            else:
-                model = "slow_r50"
+            model = detect_model(sd)
         source = convert_state_dict(
             {k: v.numpy() for k, v in sd.items()}, model
         )
@@ -700,7 +835,8 @@ def main(argv=None):
     ap = argparse.ArgumentParser(description=main.__doc__)
     ap.add_argument("src")
     ap.add_argument("dst")
-    ap.add_argument("--model", default="slow_r50")
+    ap.add_argument("--model", default="",
+                    help="model family (default: auto-detect from the keys)")
     ap.add_argument("--step", type=int, default=None,
                     help="checkpoint step (orbax dirs; default: latest)")
     args = ap.parse_args(argv)
@@ -720,10 +856,17 @@ def main(argv=None):
     sd = torch.load(args.src, map_location="cpu", weights_only=True)
     if isinstance(sd, dict) and "model_state" in sd:
         sd = sd["model_state"]
-    tree = convert_state_dict({k: v.numpy() for k, v in sd.items()}, args.model)
-    save_converted(tree, args.dst)
+    model = args.model or detect_model(sd)
+    tree = convert_state_dict({k: v.numpy() for k, v in sd.items()}, model)
     n = len(_flatten(tree["params"])) + len(_flatten(tree["batch_stats"]))
-    print(f"wrote {n} tensors to {args.dst}; skipped: {tree['skipped']}")
+    if n == 0:  # bail BEFORE touching dst — don't clobber a good artifact
+        raise SystemExit(
+            f"no tensors mapped for model {model!r} — wrong --model for this "
+            f"checkpoint? skipped keys: {tree['skipped'][:8]}..."
+        )
+    save_converted(tree, args.dst)
+    print(f"wrote {n} tensors to {args.dst} (model {model}); "
+          f"skipped: {tree['skipped']}")
 
 
 if __name__ == "__main__":

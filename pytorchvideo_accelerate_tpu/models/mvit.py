@@ -160,7 +160,8 @@ class MViTBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
         mlp = nn.Dense(int(dim_in * self.mlp_ratio), dtype=self.dtype,
                        name="mlp_fc1")(y)
-        mlp = nn.gelu(mlp)
+        mlp = nn.gelu(mlp, approximate=False)  # erf GELU, matching torch
+        # nn.GELU for exact converted-checkpoint numerics
         mlp = nn.Dense(self.dim_out, dtype=self.dtype, name="mlp_fc2")(mlp)
         if self.dim_out != dim_in:  # residual projected from norm2(x)
             x = nn.Dense(self.dim_out, dtype=self.dtype, name="skip_proj")(y)

@@ -46,11 +46,19 @@ Dtype = Any
 
 
 def sincos_pos_embed(n_pos: int, dim: int) -> np.ndarray:
-    """Fixed 1-D sin-cos table (n_pos, dim), float32."""
+    """Fixed 1-D sin-cos table (n_pos, dim), float32, interleaved layout
+    (sin on even dims, cos on odd — angle 10000^(-2*(j//2)/dim)).
+
+    This is the original-transformer convention that VideoMAE (Tong et al.
+    2022) and its public checkpoints use, so weights converted via
+    models/convert.py see the exact positional code they were trained with.
+    """
     pos = np.arange(n_pos, dtype=np.float64)[:, None]
-    omega = 1.0 / (10000 ** (np.arange(dim // 2, dtype=np.float64) / (dim / 2)))
+    omega = 10000.0 ** (-(np.arange(dim, dtype=np.float64) // 2 * 2) / dim)
     ang = pos * omega[None, :]
-    emb = np.concatenate([np.sin(ang), np.cos(ang)], axis=1)
+    emb = np.empty((n_pos, dim))
+    emb[:, 0::2] = np.sin(ang[:, 0::2])
+    emb[:, 1::2] = np.cos(ang[:, 1::2])
     return emb.astype(np.float32)
 
 
@@ -81,7 +89,8 @@ class ViTBlock(nn.Module):
         y = nn.LayerNorm(dtype=self.dtype, name="norm2")(x)
         y = nn.Dense(int(self.dim * self.mlp_ratio), dtype=self.dtype,
                      name="mlp_fc1")(y)
-        y = nn.gelu(y)
+        y = nn.gelu(y, approximate=False)  # erf GELU: what torch nn.GELU
+        # computes, so converted public checkpoints match exactly
         y = nn.Dense(self.dim, dtype=self.dtype, name="mlp_fc2")(y)
         return x + y
 
@@ -113,6 +122,8 @@ class VideoMAEEncoder(nn.Module):
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
     remat: bool = False  # per-block jax.checkpoint: boundary activations only
+    final_norm: bool = True  # off for mean-pooling classifiers (fc_norm after
+    # the pool instead — the official VideoMAE fine-tune arrangement)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -134,7 +145,8 @@ class VideoMAEEncoder(nn.Module):
                 context_mesh=self.context_mesh, dtype=self.dtype,
                 name=f"block{i}",
             )(tokens)
-        tokens = nn.LayerNorm(dtype=self.dtype, name="norm")(tokens)
+        if self.final_norm:
+            tokens = nn.LayerNorm(dtype=self.dtype, name="norm")(tokens)
         return tokens, (t, h, w)
 
 
@@ -254,8 +266,14 @@ class VideoMAEForPretraining(nn.Module):
 
 
 class VideoMAEClassifier(nn.Module):
-    """Fine-tuning model: full-token encoder + mean-pool + linear head
-    (the SSv2/K400 fine-tune path of BASELINE config 5)."""
+    """Fine-tuning model: full-token encoder + mean-pool + fc_norm + linear
+    head (the SSv2/K400 fine-tune path of BASELINE config 5).
+
+    Norm placement follows the official VideoMAE fine-tune arrangement (and
+    HF transformers' `use_mean_pooling=True`): the encoder's final LayerNorm
+    is dropped and a fresh `fc_norm` is applied AFTER the token mean-pool,
+    so classifiers converted from public checkpoints compute the same
+    function here."""
 
     num_classes: int
     dim: int = 768
@@ -274,9 +292,10 @@ class VideoMAEClassifier(nn.Module):
             dim=self.dim, depth=self.depth, num_heads=self.num_heads,
             tubelet=self.tubelet, attention_backend=self.attention_backend,
             context_mesh=self.context_mesh, remat=self.remat,
-            dtype=self.dtype, name="encoder",
+            final_norm=False, dtype=self.dtype, name="encoder",
         )(x)
         feat = tokens.mean(axis=1)
+        feat = nn.LayerNorm(dtype=self.dtype, name="fc_norm")(feat)
         feat = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(feat)
         return nn.Dense(
             self.num_classes, dtype=jnp.float32, name="head",
@@ -285,4 +304,6 @@ class VideoMAEClassifier(nn.Module):
 
     @staticmethod
     def backbone_param_filter(path: Tuple[str, ...]) -> bool:
-        return path[0] != "head"
+        # fc_norm is fresh at fine-tune time (like the head), so
+        # freeze-backbone training keeps both trainable
+        return path[0] not in ("head", "fc_norm")

@@ -1,0 +1,180 @@
+"""HF-transformers VideoMAE -> flax conversion, verified by NUMERIC PARITY
+against the installed `transformers` implementation (torch CPU), not just
+key round-trips: a random-init HF model and our flax model with converted
+weights must compute the same function.
+
+This is the N12 hub-weight path for BASELINE config 5's model family
+(reference pretrained-backbone semantics, run.py:107-117, applied to the
+public VideoMAE checkpoints, e.g. MCG-NJU/videomae-base).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pytorchvideo_accelerate_tpu.models.convert import (  # noqa: E402
+    convert_state_dict,
+    convert_videomae_state_dict,
+    load_pretrained,
+    save_converted,
+)
+from pytorchvideo_accelerate_tpu.models.videomae import (  # noqa: E402
+    VideoMAEClassifier,
+    VideoMAEEncoder,
+    sincos_pos_embed,
+)
+
+
+def _tiny_hf_config(**over):
+    from transformers import VideoMAEConfig
+
+    kw = dict(
+        image_size=16, patch_size=4, num_channels=3, num_frames=4,
+        tubelet_size=2, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=2, intermediate_size=128,
+        decoder_hidden_size=16, decoder_num_hidden_layers=1,
+        decoder_num_attention_heads=2, decoder_intermediate_size=64,
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation="eager",
+    )
+    kw.update(over)
+    return VideoMAEConfig(**kw)
+
+
+def _rand_video(seed, b=2, t=4, s=16):
+    rng = np.random.default_rng(seed)
+    return rng.standard_normal((b, t, s, s, 3)).astype(np.float32)
+
+
+def test_sincos_table_matches_hf():
+    """Our fixed positional code == HF's get_sinusoid_encoding_table, so
+    converted weights see the embeddings they were trained with."""
+    from transformers.models.videomae.modeling_videomae import (
+        get_sinusoid_encoding_table,
+    )
+
+    theirs = get_sinusoid_encoding_table(12, 32).numpy()[0]
+    np.testing.assert_allclose(sincos_pos_embed(12, 32), theirs, atol=1e-6)
+
+
+def test_encoder_forward_parity():
+    """Full-model check: HF VideoMAEModel (with final layernorm) vs our
+    VideoMAEEncoder on the same input, converted weights."""
+    from transformers import VideoMAEModel
+
+    torch.manual_seed(0)
+    cfg = _tiny_hf_config(use_mean_pooling=False)  # keeps videomae.layernorm
+    hf = VideoMAEModel(cfg).eval()
+
+    x = _rand_video(1)
+    with torch.no_grad():
+        # HF input layout: (B, T, C, H, W)
+        theirs = hf(torch.from_numpy(x).permute(0, 1, 4, 2, 3)).last_hidden_state
+
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    tree = convert_videomae_state_dict(sd)
+    assert tree["skipped"] == [], tree["skipped"]
+
+    model = VideoMAEEncoder(dim=32, depth=2, num_heads=2, tubelet=(2, 4, 4))
+    ours, _ = model.apply({"params": tree["params"]["encoder"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_classifier_forward_parity_via_npz(tmp_path):
+    """End-to-end artifact path: HF VideoMAEForVideoClassification ->
+    state_dict -> npz -> load_pretrained merge -> same logits. Every leaf of
+    our classifier must come from the checkpoint (report['kept'] empty)."""
+    from transformers import VideoMAEForVideoClassification
+
+    torch.manual_seed(1)
+    cfg = _tiny_hf_config(num_labels=5)  # use_mean_pooling=True default
+    hf = VideoMAEForVideoClassification(cfg).eval()
+
+    x = _rand_video(2)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(x).permute(0, 1, 4, 2, 3)).logits
+
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    tree = convert_state_dict(sd, "videomae_b")  # routing by model name
+    assert tree["skipped"] == [], tree["skipped"]
+    npz = str(tmp_path / "videomae.npz")
+    save_converted(tree, npz)
+
+    model = VideoMAEClassifier(num_classes=5, dim=32, depth=2, num_heads=2,
+                               tubelet=(2, 4, 4), dropout_rate=0.0)
+    variables = model.init(jax.random.key(0), jnp.asarray(x))
+    merged, report = load_pretrained(npz, variables)
+    assert report["kept"] == [], report["kept"]
+
+    ours = model.apply({"params": merged["params"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_pretraining_tree_maps_completely():
+    """VideoMAEForPreTraining: encoder + decoder weights all land on our
+    VideoMAEForPretraining paths (enc_to_dec has no bias in HF — our fresh
+    zero-init bias is the identity match)."""
+    from transformers import VideoMAEForPreTraining
+
+    torch.manual_seed(2)
+    hf = VideoMAEForPreTraining(_tiny_hf_config())
+    sd = {k: v.numpy() for k, v in hf.state_dict().items()}
+    tree = convert_videomae_state_dict(sd)
+    assert tree["skipped"] == [], tree["skipped"]
+    p = tree["params"]
+    assert p["enc_to_dec"]["kernel"].shape == (32, 16)
+    assert p["mask_token"].shape == (1, 1, 16)
+    assert p["dec_norm"]["scale"].shape == (16,)
+    assert p["dec_pred"]["kernel"].shape == (16, 2 * 4 * 4 * 3)
+    assert "qkv" in p["dec_block0"]
+    # fused qkv bias: [q_bias, zeros, v_bias]
+    qkv_b = p["encoder"]["block0"]["qkv"]["bias"]
+    assert qkv_b.shape == (96,)
+    np.testing.assert_array_equal(qkv_b[32:64], np.zeros(32))
+
+
+def test_cls_readout_checkpoint_is_flagged():
+    """use_mean_pooling=False classifiers read token 0, which our mean-pool
+    classifier can't represent — conversion must say so, not silently
+    produce a different function."""
+    from transformers import VideoMAEForVideoClassification
+
+    torch.manual_seed(4)
+    hf = VideoMAEForVideoClassification(
+        _tiny_hf_config(num_labels=3, use_mean_pooling=False))
+    tree = convert_videomae_state_dict(
+        {k: v.numpy() for k, v in hf.state_dict().items()})
+    assert any("use_mean_pooling" in s for s in tree["skipped"]), tree["skipped"]
+
+
+def test_partial_qkv_is_reported_not_dropped():
+    sd = {"encoder.layer.0.attention.attention.query.weight":
+          np.zeros((8, 8), np.float32)}  # no key/value
+    tree = convert_videomae_state_dict(sd)
+    assert tree["params"] == {}
+    assert any("query.weight" in s for s in tree["skipped"]), tree["skipped"]
+
+
+def test_torch_checkpoint_autodetects_videomae(tmp_path):
+    """load_pretrained on a raw .pt of an HF classifier picks the videomae
+    converter without an explicit model hint."""
+    from transformers import VideoMAEForVideoClassification
+
+    torch.manual_seed(3)
+    hf = VideoMAEForVideoClassification(_tiny_hf_config(num_labels=3)).eval()
+    pt = str(tmp_path / "hf.pt")
+    torch.save(hf.state_dict(), pt)
+
+    model = VideoMAEClassifier(num_classes=3, dim=32, depth=2, num_heads=2,
+                               tubelet=(2, 4, 4))
+    x = jnp.zeros((1, 4, 16, 16, 3), jnp.float32)
+    variables = model.init(jax.random.key(0), x)
+    merged, report = load_pretrained(pt, variables)
+    assert report["kept"] == [], report["kept"]
