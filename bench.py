@@ -20,7 +20,8 @@ Self-audit (so impossible numbers can't pass unremarked):
   (each step synced before the next dispatch — no async-dispatch inflation);
 - the pipelined throughput loop rotates distinct batches so a
   constant-folding/caching runtime can't replay one result;
-- if pipelined step time is <50%% of blocked step time, the run is flagged
+- if pipelined step time is <50%% of blocked step time, OR the implied MFU
+  exceeds 100%% of the chip's bf16 peak, the run is flagged
   ("suspect": true) — the platform isn't executing with real device timing.
 """
 
@@ -164,11 +165,14 @@ def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
         tflops = flops_per_step / (blocked_ms / 1e3) / 1e12 / n_chips
         if peak:
             mfu = tflops / peak
+            if mfu > 1.0:  # >100% of bf16 peak is physically impossible:
+                suspect = True  # the platform isn't timing real execution
+                # (e.g. a forwarding backend acking block_until_ready early)
     log(f"[{name}] {args.steps} steps: blocked {blocked_ms:.1f} ms/step, "
         f"pipelined {pipelined_ms:.1f} ms/step -> {per_chip:.2f} clips/s/chip"
         f"{f', {tflops:.1f} TFLOP/s/chip' if tflops else ''}"
         f"{f', MFU {mfu:.1%}' if mfu else ''}"
-        f"{' SUSPECT (pipelined << blocked: timing not trustworthy)' if suspect else ''}, "
+        f"{' SUSPECT (device timing not trustworthy)' if suspect else ''}, "
         f"final loss {float(metrics['loss']):.3f}")
 
     out = {

@@ -358,8 +358,9 @@ def x3d_torch_key_for(collection: str, path: Path) -> Optional[str]:
 # - per-head pooling as ONE depthwise conv over heads*head_dim channels:
 #   torch applies the SAME (head_dim,1,3,3,3) depthwise kernel to every
 #   head, so tiling it `heads` times across channels is exact. The pooling
-#   LayerNorm tiles the same way but normalizes over all channels rather
-#   than per head — an approximation, flagged in the report.
+#   LayerNorm keeps torch's (head_dim,) parameters verbatim — PoolHeads
+#   normalizes each head's slice with the shared params (mvit.py), so the
+#   converted function is exact, no tiling and no approximation.
 # - the flax MViT follows torch's block schedule exactly (dim change in the
 #   MLP before each stage start; see mvit.py MViTBlock), so qkv/proj/MLP/
 #   skip-proj shapes line up at every block including stage transitions.
@@ -452,8 +453,7 @@ def convert_mvit_state_dict(sd: Dict[str, np.ndarray]) -> dict:
             elif name.startswith("norm") and leaf in ("weight", "bias"):
                 _set_path(out["params"],
                           (block, "attn", flax_pool, "norm",
-                           "scale" if leaf == "weight" else "bias"),
-                          np.tile(arr, n_heads))
+                           "scale" if leaf == "weight" else "bias"), arr)
             else:
                 out["skipped"].append(key)
             continue
@@ -788,7 +788,11 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
     else:
         source = load_converted(path)
 
-    report = {"loaded": [], "kept": []}
+    # "kept": path absent from the artifact (fresh head, new params);
+    # "mismatched": present but wrong shape — expected ONLY for the swapped
+    # classification head; anything else usually means a stale artifact
+    # (e.g. converted with an older layout) and is worth a loud warning.
+    report = {"loaded": [], "kept": [], "mismatched": []}
 
     def merge(target: dict, src: dict, prefix: Path) -> dict:
         out = {}
@@ -802,7 +806,8 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
                 report["loaded"].append("/".join(p))
             else:
                 out[k] = v
-                report["kept"].append("/".join(p))
+                (report["mismatched"] if k in src and not isinstance(src[k], dict)
+                 else report["kept"]).append("/".join(p))
         return out
 
     merged = {

@@ -46,10 +46,17 @@ def _drop_path(x, rate: float, deterministic: bool, rng):
 
 class PoolHeads(nn.Module):
     """Depthwise conv pooling of a per-head token grid + LN (MHPA pooling,
-    paper §3.1 'conv' mode). Operates on (B, T, H, W, heads*head_dim)."""
+    paper §3.1 'conv' mode). Operates on (B, T, H, W, heads*head_dim).
+
+    The LayerNorm matches torch's exactly: one shared (head_dim,)-parameter
+    LayerNorm normalizing each head's slice separately (pytorchvideo applies
+    `LayerNorm(head_dim)` with heads folded into the batch), not a joint norm
+    over all heads*head_dim channels — so converted pretrained pool norms
+    are numerically exact, not an approximation."""
 
     channels: int
     stride: Tuple[int, int, int]
+    head_dim: int = 0  # 0 = single group (heads*head_dim normed jointly)
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -69,7 +76,11 @@ class PoolHeads(nn.Module):
             dtype=self.dtype,
             name="pool",
         )(x)
-        return nn.LayerNorm(dtype=self.dtype, name="norm")(x)
+        hd = self.head_dim or self.channels
+        shape = x.shape
+        x = x.reshape(*shape[:-1], shape[-1] // hd, hd)
+        x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)  # over head_dim
+        return x.reshape(shape)
 
 
 class MultiScaleAttention(nn.Module):
@@ -90,12 +101,15 @@ class MultiScaleAttention(nn.Module):
         qkv = nn.Dense(3 * self.dim_out, dtype=self.dtype, name="qkv")(x)
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
-        q = PoolHeads(self.dim_out, self.q_stride, self.dtype, name="pool_q")(q)
-        k = PoolHeads(self.dim_out, self.kv_stride, self.dtype, name="pool_k")(k)
-        v = PoolHeads(self.dim_out, self.kv_stride, self.dtype, name="pool_v")(v)
+        head_dim = self.dim_out // self.num_heads
+        q = PoolHeads(self.dim_out, self.q_stride, head_dim, self.dtype,
+                      name="pool_q")(q)
+        k = PoolHeads(self.dim_out, self.kv_stride, head_dim, self.dtype,
+                      name="pool_k")(k)
+        v = PoolHeads(self.dim_out, self.kv_stride, head_dim, self.dtype,
+                      name="pool_v")(v)
 
         tq, hq, wq = q.shape[1:4]
-        head_dim = self.dim_out // self.num_heads
 
         def to_tokens(t):
             return t.reshape(B, -1, self.num_heads, head_dim)

@@ -280,8 +280,23 @@ class Trainer:
             )
             main_print(
                 f"pretrained: loaded {len(report['loaded'])} tensors, "
-                f"kept {len(report['kept'])} fresh (head swap / mismatches)"
+                f"kept {len(report['kept'])} fresh"
             )
+            mism = report.get("mismatched", [])
+            nonhead = [p for p in mism
+                       if not ("/head/" in p or p.endswith(("/head", "proj/kernel", "proj/bias")))]
+            if mism:
+                main_print(f"pretrained: {len(mism)} shape-mismatched leaves "
+                           "kept fresh (expected for a swapped head): "
+                           + ", ".join(mism[:4])
+                           + ("..." if len(mism) > 4 else ""))
+            if nonhead:
+                logger.warning(
+                    "pretrained artifact has %d NON-head shape mismatches "
+                    "(stale artifact from an older layout? regenerate with "
+                    "models.convert): %s",
+                    len(nonhead), ", ".join(nonhead[:8]),
+                )
 
         if self.is_pretraining:
             self.train_step = make_pretrain_step(
@@ -390,7 +405,7 @@ class Trainer:
             progress = tqdm(total=cfg.optim.num_epochs * steps_per_epoch,
                             initial=int(self.state.step))
         last_val_acc, last_train_loss = 0.0, float("nan")
-        last_val_loss = float("nan")
+        last_val_acc5, last_val_loss = 0.0, float("nan")
         # train-section wall time per epoch (excludes eval/ckpt; epoch 0
         # includes compile) — lets benchmarks measure steady-state throughput
         epoch_train_times = []
@@ -466,11 +481,13 @@ class Trainer:
                     if 0 <= cfg.data.limit_val_batches <= step_in_epoch + 1:
                         break
                 last_val_acc = val.accuracy()
+                last_val_acc5 = val.accuracy_top5()
                 last_val_loss = val.mean_loss()
                 last_train_loss = epoch_loss.mean()
                 val_str = (
                     f"val_recon_loss={last_val_loss:.4f}" if self.is_pretraining
-                    else f"val_acc={last_val_acc:.4f}"
+                    else f"val_acc={last_val_acc:.4f} "
+                         f"val_acc5={last_val_acc5:.4f}"
                 )
                 main_print(
                     f"epoch {epoch}: {val_str} "
@@ -484,6 +501,7 @@ class Trainer:
                         epoch_metrics["val_recon_loss"] = last_val_loss
                     else:
                         epoch_metrics["accuracy"] = last_val_acc
+                        epoch_metrics["accuracy_top5"] = last_val_acc5
                     # epoch throughput + (when XLA's cost model is available)
                     # achieved TFLOP/s and MFU against the chip's bf16 peak
                     steps_done = train_steps_this_epoch
@@ -540,4 +558,5 @@ class Trainer:
             result["val_recon_loss"] = last_val_loss
         else:
             result["val_accuracy"] = last_val_acc
+            result["val_accuracy_top5"] = last_val_acc5
         return result

@@ -26,6 +26,7 @@ class SumMetrics:
 
     loss_sum: float = 0.0
     correct: float = 0.0
+    correct5: float = 0.0
     count: float = 0.0
     pending: list = field(default_factory=list)
 
@@ -37,6 +38,7 @@ class SumMetrics:
             for out in jax.device_get(self.pending):
                 self.loss_sum += float(out["loss_sum"])
                 self.correct += float(out["correct"])
+                self.correct5 += float(out.get("correct5", 0.0))
                 self.count += float(out["count"])
             self.pending = []
 
@@ -44,12 +46,16 @@ class SumMetrics:
         self._drain()
         return self.correct / max(self.count, 1.0)
 
+    def accuracy_top5(self) -> float:
+        self._drain()
+        return self.correct5 / max(self.count, 1.0)
+
     def mean_loss(self) -> float:
         self._drain()
         return self.loss_sum / max(self.count, 1.0)
 
     def reset(self) -> None:
-        self.loss_sum = self.correct = self.count = 0.0
+        self.loss_sum = self.correct = self.correct5 = self.count = 0.0
         self.pending = []
 
 

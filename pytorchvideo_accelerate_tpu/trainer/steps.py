@@ -89,6 +89,15 @@ def _loss_and_metrics(logits, labels, mask, label_smoothing: float):
     return loss, correct, count
 
 
+def _topk_correct(logits, labels, mask, k: int = 5):
+    """Masked top-k hit count (Kinetics convention reports top-1 AND top-5;
+    the reference's torchmetrics Accuracy is top-1 only)."""
+    k = min(k, logits.shape[-1])
+    _, top = lax.top_k(logits.astype(jnp.float32), k)
+    hit = (top == labels[..., None]).any(-1)
+    return (hit * mask).sum()
+
+
 def _make_update_step(
     grad_fn: Callable,
     tx: optax.GradientTransformation,
@@ -275,6 +284,8 @@ def make_eval_step(model, mesh, label_smoothing: float = 0.0) -> Callable:
         loss, correct, count = _loss_and_metrics(
             logits, batch["label"], mask, label_smoothing
         )
-        return {"loss_sum": loss * count, "correct": correct, "count": count}
+        return {"loss_sum": loss * count, "correct": correct,
+                "correct5": _topk_correct(logits, batch["label"], mask),
+                "count": count}
 
     return jax.jit(eval_step)

@@ -158,8 +158,11 @@ def test_head_swap_keeps_fresh_head(tmp_path, slow_vars):
         jax.random.key(1), jnp.zeros((1, 2, 32, 32, 3))
     )
     merged, report = load_pretrained(path, target)
-    kept = set(report["kept"])
-    assert kept == {"params/head/proj/kernel", "params/head/proj/bias"}, kept
+    # the artifact HAS a head, at the pretrain label count -> "mismatched"
+    # (distinct from "kept" = absent), the expected head-swap signal
+    mism = set(report["mismatched"])
+    assert mism == {"params/head/proj/kernel", "params/head/proj/bias"}, mism
+    assert report["kept"] == []
     got_head = dict(_leaves(merged["params"]))[("head", "proj", "kernel")]
     np.testing.assert_array_equal(
         np.asarray(got_head),
@@ -228,8 +231,8 @@ def test_x3d_merge_head_swap(tmp_path, x3d_vars):
         jax.random.key(1), jnp.zeros((1, 4, 32, 32, 3))
     )
     merged, report = load_pretrained(path, target)
-    kept = set(report["kept"])
-    assert kept == {"params/proj/kernel", "params/proj/bias"}, kept
+    mism = set(report["mismatched"])
+    assert mism == {"params/proj/kernel", "params/proj/bias"}, mism
 
 
 class TestMViTConvert:
@@ -309,8 +312,10 @@ class TestMViTConvert:
         src = sd["blocks.0.attn.pool_k.weight"]
         # channel h*head_dim+c carries the same kernel as channel c
         np.testing.assert_array_equal(k[..., 0, 8 + 3], src[3, 0])
+        # pooling LN params stay (head_dim,) — PoolHeads applies them
+        # per head, matching torch exactly (no tiling)
         ln = leaves[("block0", "attn", "pool_k", "norm", "scale")]
-        np.testing.assert_array_equal(ln[8:], ln[:8])
+        np.testing.assert_array_equal(ln, sd["blocks.0.attn.norm_k.weight"])
 
     def test_stage_transition_block_fully_maps(self, tmp_path):
         """Every tensor of a stage-transition schedule loads — the flax MViT

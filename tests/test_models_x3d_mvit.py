@@ -51,6 +51,30 @@ def test_mvit_multiscale_geometry():
     assert p["patch_embed"]["kernel"].shape[-1] == 96
 
 
+def test_pool_heads_normalizes_per_head():
+    """The MHPA pooling LayerNorm is torch-exact: one shared (head_dim,)
+    parameter set, each head's channel slice normalized SEPARATELY (no
+    cross-head statistics)."""
+    from pytorchvideo_accelerate_tpu.models.mvit import PoolHeads
+
+    head_dim, heads = 4, 2
+    m = PoolHeads(channels=heads * head_dim, stride=(1, 2, 2),
+                  head_dim=head_dim)
+    x = jnp.asarray(
+        np.random.default_rng(0).standard_normal((1, 2, 4, 4, heads * head_dim)),
+        jnp.float32)
+    variables = m.init(jax.random.key(0), x)
+    assert variables["params"]["norm"]["scale"].shape == (head_dim,)
+    out = m.apply(variables, x)
+
+    # LN law: each head slice of the output has ~zero mean / unit var
+    # (scale=1, bias=0 at init) — cross-head statistics would break this
+    # whenever the heads' input scales differ
+    y = np.asarray(out).reshape(1, 2, 2, 2, heads, head_dim)
+    np.testing.assert_allclose(y.mean(-1), 0.0, atol=1e-5)
+    np.testing.assert_allclose(y.var(-1), 1.0, atol=1e-3)
+
+
 def test_mvit_b_param_count():
     """MViT-B/16 is ~36.6M (paper Table 2)."""
     model = MViT(num_classes=400)

@@ -125,6 +125,27 @@ def test_eval_step_masked_metrics(mesh8):
 
     out_full = eval_step(state, shard_batch(mesh8, batch))
     assert float(out_full["count"]) == 16.0
+    # top-5 dominates top-1 and respects the mask (Kinetics convention;
+    # the reference's torchmetrics Accuracy is top-1 only)
+    assert float(out["correct5"]) >= float(out["correct"])
+    assert float(out["correct5"]) <= 8.0
+    assert float(out_full["correct5"]) >= float(out_full["correct"])
+
+
+def test_topk_correct_exact():
+    from pytorchvideo_accelerate_tpu.trainer.steps import _topk_correct
+
+    logits = jnp.asarray([
+        [9.0, 5.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # label 5 in top-5? rank 5 -> no
+        [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 6.0],   # label 5 rank 1 -> yes
+        [1.0, 0.0, 0.0, 0.0, 0.0, 0.0, 0.0],   # label 0 rank 0 -> yes
+    ])
+    labels = jnp.asarray([5, 5, 0])
+    mask = jnp.ones(3, jnp.float32)
+    assert float(_topk_correct(logits, labels, mask)) == 2.0
+    assert float(_topk_correct(logits, labels, jnp.asarray([1.0, 0.0, 0.0]))) == 0.0
+    # k clamps to num_classes
+    assert float(_topk_correct(logits[:, :3], jnp.asarray([2, 2, 0]), mask)) == 3.0
 
 
 def test_freeze_backbone_blocks_updates(mesh8):
