@@ -193,6 +193,97 @@ def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
     return out
 
 
+def bench_data(args) -> dict:
+    """Host input-pipeline microbench (SURVEY §7 hard-part 1): encodes a
+    small synthetic video tree, then measures raw cv2 decode vs pre-decoded
+    cache clips/sec and ClipLoader end-to-end throughput on both transports.
+
+    These numbers are host-CPU-real — trustworthy on any box, including when
+    device timing is not — and they bound the chips/host ratio the input
+    pipeline can feed."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    try:
+        import cv2
+    except ImportError:
+        return {"error": "cv2 unavailable"}
+
+    from pytorchvideo_accelerate_tpu.data.cache import (
+        bench_decode_vs_cache, build_cache,
+    )
+    from pytorchvideo_accelerate_tpu.data.manifest import scan_directory
+    from pytorchvideo_accelerate_tpu.data.pipeline import (
+        ClipLoader, VideoClipSource,
+    )
+    from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+
+    tmp = tempfile.mkdtemp(prefix="pva_bench_data_")
+    fps = 30.0
+    n_videos, n_frames = (4, 24) if args.smoke else (8, 64)
+    w_px, h_px = (96, 64) if args.smoke else (320, 256)
+    crop = 64 if args.smoke else 224
+    num_frames = 8
+    clip_duration = num_frames * 2 / fps  # sampling_rate 2
+    out: dict = {"video_px": f"{w_px}x{h_px}", "num_videos": n_videos}
+    rng = np.random.default_rng(0)
+    try:
+        root = os.path.join(tmp, "train")
+        for c in range(2):
+            cls = os.path.join(root, f"class{c}")
+            os.makedirs(cls)
+            for v in range(n_videos // 2):
+                wr = cv2.VideoWriter(
+                    os.path.join(cls, f"v{v}.mp4"),
+                    cv2.VideoWriter_fourcc(*"mp4v"), fps, (w_px, h_px))
+                if not wr.isOpened():
+                    return {"error": "mp4v codec unavailable"}
+                for _ in range(n_frames):
+                    wr.write(rng.integers(0, 255, (h_px, w_px, 3), np.uint8))
+                wr.release()
+
+        cache_dir = os.path.join(tmp, "cache")
+        t0 = time.perf_counter()
+        build_cache(root, cache_dir, fps=fps, short_side=min(h_px, w_px),
+                    num_workers=2)
+        out["cache_build_s"] = round(time.perf_counter() - t0, 2)
+        out.update(bench_decode_vs_cache(
+            root, cache_dir, clip_duration=clip_duration,
+            n_clips=16 if args.smoke else 48, num_workers=2))
+
+        # loader end-to-end: decode + transforms + batch assembly
+        tf = make_transform(num_frames=num_frames, training=True,
+                            min_short_side_scale=crop,
+                            max_short_side_scale=crop + 16, crop_size=crop)
+        manifest = scan_directory(root)
+        epochs = 2 if args.smoke else 4
+        for transport in ("thread", "process"):
+            src = VideoClipSource(manifest, tf, clip_duration, training=True,
+                                  seed=0)
+            loader = ClipLoader(src, global_batch_size=4, shuffle=True,
+                                num_workers=2, transport=transport)
+            try:
+                clips = 0
+                next(iter(loader.epoch(0)))  # warm pools/caches
+                t0 = time.perf_counter()
+                for ep in range(1, epochs + 1):
+                    for batch in loader.epoch(ep):
+                        clips += batch["label"].shape[0]
+                dt = time.perf_counter() - t0
+                key = f"loader_{transport}_clips_per_sec"
+                out[key] = round(clips / dt, 2)
+                if loader.transport != transport:  # native lib unavailable
+                    out[key + "_note"] = f"fell back to {loader.transport}"
+            finally:
+                loader.close()
+        log(f"[data] {out}")
+        return out
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--models", default="all",
@@ -203,8 +294,15 @@ def main():
     ap.add_argument("--trainer", action="store_true",
                     help="also run Trainer.fit() on synthetic data and report "
                          "its throughput vs the raw step (hot-loop overhead)")
+    ap.add_argument("--data", action="store_true",
+                    help="also run the host input-pipeline microbench "
+                         "(decode vs cache vs loader clips/sec; CPU-real)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe shapes for harness verification")
+    ap.add_argument("--per_model_timeout", type=int, default=900,
+                    help="seconds before a model's bench is abandoned "
+                         "(a wedged compile/backend must not prevent the "
+                         "final JSON line; 0 = no limit)")
     args = ap.parse_args()
 
     # The axon tunnel to the chip can wedge at backend init (observed: device
@@ -253,18 +351,88 @@ def main():
 
     names = list(WORKLOADS) if args.models == "all" else args.models.split(",")
     results = {}
+
+    # BaseException: must NOT be swallowed by any `except Exception` inside
+    # bench_model (e.g. the cost_analysis guard) — only the model-loop
+    # handler below may consume it
+    class _Timeout(BaseException):
+        pass
+
+    import signal
+
+    def _alarm(signum, frame):
+        raise _Timeout(f"exceeded --per_model_timeout={args.per_model_timeout}s")
+
+    can_alarm = hasattr(signal, "SIGALRM") and args.per_model_timeout > 0
+    if can_alarm:
+        signal.signal(signal.SIGALRM, _alarm)
+
+    # Last-resort watchdog for hangs that SIGALRM can't interrupt (a wedged
+    # compile inside a GIL-holding C call): after the total budget, emit the
+    # final JSON with whatever finished and hard-exit — the driver must
+    # always get the one-line result.
+    import threading
+
+    emitted = threading.Event()
+    emit_lock = threading.Lock()
+    extras: dict = {}
+
+    def emit_final():
+        with emit_lock:  # exactly ONE JSON line, even racing the watchdog
+            if emitted.is_set():
+                return
+            emitted.set()
+        print(json.dumps(finalize(results, extras, args, tpu_unreachable)))
+        sys.stdout.flush()
+
+    watchdog_timer = None
+    if can_alarm:
+        total_budget = args.per_model_timeout * (len(names) + 1)
+
+        def watchdog():
+            for name in names:  # mark whatever never finished
+                results.setdefault(name, {"error": "total watchdog timeout"})
+            extras["error"] = f"watchdog: exceeded {total_budget}s total"
+            log(extras["error"])
+            emit_final()
+            os._exit(2)
+
+        watchdog_timer = threading.Timer(total_budget, watchdog)
+        watchdog_timer.daemon = True
+        watchdog_timer.start()
+
     for name in names:
         try:
+            if can_alarm:
+                signal.alarm(args.per_model_timeout)
             results[name] = bench_model(name, WORKLOADS[name], args, mesh,
                                         n_chips)
-        except Exception as e:
+        except (Exception, _Timeout) as e:
             log(f"[{name}] FAILED: {type(e).__name__}: {e}")
             results[name] = {"error": f"{type(e).__name__}: {e}"}
+        finally:
+            if can_alarm:
+                signal.alarm(0)
 
-    trainer_ratio = None
     if args.trainer:
-        trainer_ratio = bench_trainer(args, results)
+        try:
+            extras["trainer_vs_rawstep"] = bench_trainer(args, results)
+        except Exception as e:
+            log(f"[trainer] FAILED: {type(e).__name__}: {e}")
+            extras["trainer_error"] = f"{type(e).__name__}: {e}"
+    if args.data:
+        try:
+            extras["data_pipeline"] = bench_data(args)
+        except Exception as e:
+            log(f"[data] FAILED: {type(e).__name__}: {e}")
+            extras["data_pipeline"] = {"error": f"{type(e).__name__}: {e}"}
+    if watchdog_timer is not None:
+        watchdog_timer.cancel()
+    emit_final()
 
+
+def finalize(results: dict, extras: dict, args, tpu_unreachable: bool) -> dict:
+    """Assemble the single JSON line from per-model results + extras."""
     flag_name = "slowfast_r50"
     flag = results.get(flag_name, {})
     if "clips_per_sec_per_chip" not in flag:  # flagship failed: next best
@@ -296,13 +464,20 @@ def main():
         "suspect": flag.get("suspect"),
         "models": results,
     }
-    if trainer_ratio is not None:
-        out["trainer_vs_rawstep"] = round(trainer_ratio, 3)
+    tr = extras.get("trainer_vs_rawstep")
+    if tr is not None:
+        out["trainer_vs_rawstep"] = round(tr, 3)
+    if "trainer_error" in extras:
+        out["trainer_error"] = extras["trainer_error"]
+    if "data_pipeline" in extras:
+        out["data_pipeline"] = extras["data_pipeline"]
+    if "error" in extras:
+        out["error"] = extras["error"]
     if tpu_unreachable:
         out["suspect"] = True
         out["error"] = ("tpu backend init unreachable; CPU smoke fallback — "
                         "not device numbers")
-    print(json.dumps(out))
+    return out
 
 
 def bench_trainer(args, results: dict) -> float | None:

@@ -283,8 +283,13 @@ class Trainer:
                 f"kept {len(report['kept'])} fresh"
             )
             mism = report.get("mismatched", [])
+            # head paths by model family: .../head/... (resnet/slowfast,
+            # mvit, videomae) or X3D's top-level params/proj — exact
+            # anchors only, so e.g. an MViT block's attn/proj mismatch is
+            # NOT mistaken for a head swap
             nonhead = [p for p in mism
-                       if not ("/head/" in p or p.endswith(("/head", "proj/kernel", "proj/bias")))]
+                       if "/head/" not in p
+                       and p not in ("params/proj/kernel", "params/proj/bias")]
             if mism:
                 main_print(f"pretrained: {len(mism)} shape-mismatched leaves "
                            "kept fresh (expected for a swapped head): "

@@ -116,6 +116,20 @@ class TestConfigFile:
         assert cfg.optim.lr == 0.33
         assert cfg.model.name == "x3d_s"
 
+    def test_write_config_resolves_and_round_trips(self, tmp_path):
+        """--write_config dumps the post-flag config and exits without
+        training (the `accelerate config` persist-once workflow); the dump
+        reloads via --config with flags still overriding."""
+        from pytorchvideo_accelerate_tpu.config import parse_cli
+        from pytorchvideo_accelerate_tpu.run import main
+
+        p = str(tmp_path / "resolved.json")
+        res = main(["--write_config", p, "--lr", "0.07", "--is_slowfast"])
+        assert res == {"config_written": p}
+        cfg = parse_cli(["--config", p, "--lr", "0.09"])
+        assert cfg.model.name == "slowfast_r50"  # persisted
+        assert cfg.optim.lr == 0.09              # flag overrides file
+
     def test_unknown_key_rejected(self, tmp_path):
         import json
 
