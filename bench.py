@@ -294,9 +294,11 @@ def main():
     ap.add_argument("--trainer", action="store_true",
                     help="also run Trainer.fit() on synthetic data and report "
                          "its throughput vs the raw step (hot-loop overhead)")
-    ap.add_argument("--data", action="store_true",
-                    help="also run the host input-pipeline microbench "
-                         "(decode vs cache vs loader clips/sec; CPU-real)")
+    ap.add_argument("--data", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="host input-pipeline microbench (decode vs cache vs "
+                         "loader clips/sec; CPU-real numbers regardless of "
+                         "device-timing trustworthiness); --no-data skips")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe shapes for harness verification")
     ap.add_argument("--per_model_timeout", type=int, default=900,

@@ -93,6 +93,14 @@ def _x3d_m(cfg: ModelConfig, dtype, mesh=None):
                dtype=dtype)
 
 
+@register_model("x3d_l")
+def _x3d_l(cfg: ModelConfig, dtype, mesh=None):
+    # depth-factor 5.0 trunk (pytorchvideo create_x3d stage depths
+    # (1,2,5,3) x 5.0 -> (5,10,25,15)); sampled 16f@312px in the paper
+    return X3D(num_classes=cfg.num_classes, depths=(5, 10, 25, 15),
+               dropout_rate=cfg.dropout_rate, dtype=dtype)
+
+
 @register_model("mvit_b")
 def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
     if cfg.attention not in ("dense", "pallas", "ring", "ulysses"):

@@ -126,6 +126,9 @@ class TestConfigFile:
         p = str(tmp_path / "resolved.json")
         res = main(["--write_config", p, "--lr", "0.07", "--is_slowfast"])
         assert res == {"config_written": p}
+        # = form parses identically
+        res = main([f"--write_config={p}", "--lr", "0.07", "--is_slowfast"])
+        assert res == {"config_written": p}
         cfg = parse_cli(["--config", p, "--lr", "0.09"])
         assert cfg.model.name == "slowfast_r50"  # persisted
         assert cfg.optim.lr == 0.09              # flag overrides file

@@ -36,6 +36,18 @@ def test_x3d_s_param_count():
     assert 3e6 < n < 7e6, n
 
 
+def test_x3d_l_registry_and_param_count():
+    """X3D-L = depth-factor 5.0 trunk (~6.2M params, paper Table 3)."""
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+
+    model = create_model(ModelConfig(name="x3d_l", num_classes=400), "bf16")
+    assert model.depths == (5, 10, 25, 15)
+    variables = model.init(jax.random.key(0), jnp.zeros((1, 4, 64, 64, 3)))
+    n = _count(variables["params"])
+    assert 5e6 < n < 8e6, n
+
+
 def test_mvit_multiscale_geometry():
     """Grid halves spatially at each stage; dims 96->192->384->768."""
     model = MViT(num_classes=5, depth=16, drop_path_rate=0.0, dropout_rate=0.0)
