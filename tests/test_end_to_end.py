@@ -68,6 +68,48 @@ def test_fit_trains_and_reports(tmp_path):
     assert np.isfinite(result["train_loss"])
 
 
+def test_fit_with_fsdp_axis(tmp_path):
+    """Full Trainer.fit() (not just the raw step) over a data=4 x fsdp=2
+    mesh: the Trainer's own param/batch sharding, eval, and checkpoint
+    plumbing under ZeRO-style sharding."""
+    cfg = _cfg(tmp_path, **{"mesh.data": 4, "mesh.fsdp": 2})
+    result = Trainer(cfg).fit()
+    assert result["steps"] == 4
+    assert np.isfinite(result["train_loss"])
+
+
+def test_fit_with_tp_cp_axes(tmp_path, monkeypatch):
+    """Full Trainer.fit() of a transformer over data=2 x tensor=2 x
+    context=2 — Megatron layouts + ring attention reached from the CLI
+    config path, not just the library-level composition tests."""
+    from pytorchvideo_accelerate_tpu import models
+    from pytorchvideo_accelerate_tpu.models.videomae import VideoMAEClassifier
+
+    def tiny_vmae(cfg, dtype, mesh=None):
+        # mirrors the real builder (models/__init__.py): backend and
+        # context mesh come from cfg.attention, so the CLI plumbing
+        # (--model.attention ring) is what's under test
+        return VideoMAEClassifier(
+            num_classes=cfg.num_classes, dim=32, depth=2, num_heads=2,
+            tubelet=(2, 8, 8), dropout_rate=0.0,
+            attention_backend=cfg.attention,
+            context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+            dtype=dtype,
+        )
+
+    monkeypatch.setitem(models._REGISTRY, "videomae_b", tiny_vmae)
+    cfg = _cfg(tmp_path, **{
+        "mesh.data": 2, "mesh.tensor": 2, "mesh.context": 2,
+        "model.name": "videomae_b", "model.attention": "ring",
+        "data.batch_size": 2,
+    })
+    result = Trainer(cfg).fit()
+    # 16 videos / global batch 4 (data=2 shards x 2/shard) x 2 epochs
+    assert result["steps"] == 8
+    assert np.isfinite(result["train_loss"])
+    assert 0.0 <= result["val_accuracy"] <= 1.0
+
+
 def test_fit_with_tracking_and_epoch_checkpoints(tmp_path):
     cfg = _cfg(tmp_path, **{
         "tracking.with_tracking": True, "tracking.trackers": "jsonl",
