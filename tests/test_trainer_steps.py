@@ -65,6 +65,44 @@ def test_loss_decreases_on_mesh(mesh8):
     assert int(state.step) == 8
 
 
+def test_sync_bn_dp_parity(mesh8):
+    """BN under data parallelism is SYNC-BN by construction: stats are
+    reductions over the globally-sharded batch inside the compiled step
+    (XLA inserts the cross-shard collectives), so DP=8 must produce the
+    SAME batch_stats, loss, and updated params as DP=1 on the same global
+    batch — unlike torch DDP's default per-replica BN (SURVEY §7
+    hard-part 4: 'BN cross-replica behavior under DP')."""
+    from pytorchvideo_accelerate_tpu.config import MeshConfig
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+
+    model = _tiny_model()
+    batch = _synthetic_batch(16)
+    variables = model.init(jax.random.key(0), jnp.asarray(batch["video"]))
+    # host copies: the compiled step donates its state, so each run needs
+    # fresh arrays
+    params_host = jax.tree.map(np.asarray, variables["params"])
+    stats_host = jax.tree.map(np.asarray, variables["batch_stats"])
+    tx = build_optimizer(OptimConfig(lr=0.05, weight_decay=0.0), total_steps=10)
+
+    def run(mesh):
+        state = TrainState.create(jax.tree.map(jnp.asarray, params_host),
+                                  jax.tree.map(jnp.asarray, stats_host), tx)
+        step = make_train_step(model, tx, mesh)
+        state, metrics = step(state, shard_batch(mesh, batch), jax.random.key(1))
+        return (float(metrics["loss"]),
+                jax.tree.map(np.asarray, jax.device_get(state.batch_stats)),
+                jax.tree.map(np.asarray, jax.device_get(state.params)))
+
+    loss1, stats1, params1 = run(make_mesh(MeshConfig(data=1),
+                                           devices=jax.devices()[:1]))
+    loss8, stats8, params8 = run(mesh8)
+    np.testing.assert_allclose(loss8, loss1, rtol=1e-5)
+    for a, b in zip(jax.tree.leaves(stats1), jax.tree.leaves(stats8)):
+        np.testing.assert_allclose(b, a, rtol=1e-5, atol=1e-6)
+    for a, b in zip(jax.tree.leaves(params1), jax.tree.leaves(params8)):
+        np.testing.assert_allclose(b, a, rtol=1e-4, atol=1e-6)
+
+
 def test_grad_accum_parity_exact(mesh8):
     """accum=G over micro-batches == accum=1 over the full batch (BN-free):
     the reference's every-micro-step allreduce and our one-sync scan must be
