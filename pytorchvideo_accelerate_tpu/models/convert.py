@@ -622,6 +622,36 @@ def _set_path(tree: dict, path: Path, value) -> None:
     node[path[-1]] = value
 
 
+def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
+    """Read a checkpoint file into {key: np.ndarray}.
+
+    `.safetensors` (the modern HF download format) reads via the
+    safetensors library — no torch needed; `.pt/.pth/.bin` via torch
+    (CPU wheel, conversion only — SURVEY §7 env notes). Wrapper dicts
+    (`model_state`, `state_dict`) are unwrapped.
+    """
+    if path.endswith(".safetensors"):
+        from safetensors.numpy import load_file
+
+        return load_file(path)
+    import torch
+
+    sd = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(sd, dict) and "model_state" in sd:
+        sd = sd["model_state"]
+    if isinstance(sd, dict) and "state_dict" in sd:
+        sd = sd["state_dict"]
+
+    def to_np(v):
+        # numpy has no bfloat16: go through fp32 (exact — fp32 ⊃ bf16);
+        # the merge casts to the target param dtype anyway
+        if v.dtype == torch.bfloat16:
+            return v.detach().float().numpy()
+        return v.numpy()
+
+    return {k: to_np(v) for k, v in sd.items()}
+
+
 def detect_model(sd: Dict) -> str:
     """Guess the model family from a torch state_dict's key shapes (used when
     the caller gives no --model hint)."""
@@ -766,25 +796,16 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
     replaced (cast to the target dtype); mismatches — most commonly the
     classification head when `num_classes` differs from the pretrain
     dataset (reference head-swap semantics, run.py:109,117) — keep the
-    fresh initialization. Accepts a converted `.npz` or a raw torch
-    `.pt/.pth` (converted on the fly; needs `model` and the torch package).
+    fresh initialization. Accepts a converted `.npz`, a raw torch
+    `.pt/.pth/.bin` (converted on the fly via torch), or an HF
+    `.safetensors` file (no torch needed).
     Returns (merged_variables, report) where report lists loaded/kept paths.
     """
     import jax.numpy as jnp
 
-    if path.endswith((".pt", ".pth", ".bin")):
-        import torch  # CPU wheel, conversion only (SURVEY §7 env notes)
-
-        sd = torch.load(path, map_location="cpu", weights_only=True)
-        if isinstance(sd, dict) and "model_state" in sd:
-            sd = sd["model_state"]
-        if isinstance(sd, dict) and "state_dict" in sd:
-            sd = sd["state_dict"]
-        if not model:
-            model = detect_model(sd)
-        source = convert_state_dict(
-            {k: v.numpy() for k, v in sd.items()}, model
-        )
+    if path.endswith((".pt", ".pth", ".bin", ".safetensors")):
+        sd = load_torch_state_dict(path)
+        source = convert_state_dict(sd, model or detect_model(sd))
     else:
         source = load_converted(path)
 
@@ -856,13 +877,9 @@ def main(argv=None):
         print(f"exported params of step {step} from {args.src} -> {args.dst}")
         return
 
-    import torch
-
-    sd = torch.load(args.src, map_location="cpu", weights_only=True)
-    if isinstance(sd, dict) and "model_state" in sd:
-        sd = sd["model_state"]
+    sd = load_torch_state_dict(args.src)
     model = args.model or detect_model(sd)
-    tree = convert_state_dict({k: v.numpy() for k, v in sd.items()}, model)
+    tree = convert_state_dict(sd, model)
     n = len(_flatten(tree["params"])) + len(_flatten(tree["batch_stats"]))
     if n == 0:  # bail BEFORE touching dst — don't clobber a good artifact
         raise SystemExit(

@@ -178,3 +178,45 @@ def test_torch_checkpoint_autodetects_videomae(tmp_path):
     variables = model.init(jax.random.key(0), x)
     merged, report = load_pretrained(pt, variables)
     assert report["kept"] == [], report["kept"]
+
+
+def test_bf16_torch_checkpoint_converts(tmp_path):
+    """Modern HF fine-tunes often save bf16 .bin checkpoints; numpy has no
+    bfloat16, so the loader must bridge through fp32 (exact)."""
+    from pytorchvideo_accelerate_tpu.models.convert import load_torch_state_dict
+
+    sd = {"w": torch.randn(4, 4).to(torch.bfloat16),
+          "b": torch.randn(4)}
+    pt = str(tmp_path / "bf16.pt")
+    torch.save(sd, pt)
+    out = load_torch_state_dict(pt)
+    assert out["w"].dtype == np.float32
+    np.testing.assert_array_equal(out["w"], sd["w"].float().numpy())
+    assert out["b"].dtype == np.float32
+
+
+def test_safetensors_checkpoint_loads_without_torch_io(tmp_path):
+    """HF's modern download format (.safetensors) converts directly —
+    same logits as the .pt path."""
+    pytest.importorskip("safetensors")
+    from safetensors.torch import save_file
+
+    from transformers import VideoMAEForVideoClassification
+
+    torch.manual_seed(5)
+    hf = VideoMAEForVideoClassification(_tiny_hf_config(num_labels=3)).eval()
+    st = str(tmp_path / "hf.safetensors")
+    save_file(hf.state_dict(), st)
+
+    x = _rand_video(6, b=1)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(x).permute(0, 1, 4, 2, 3)).logits
+
+    model = VideoMAEClassifier(num_classes=3, dim=32, depth=2, num_heads=2,
+                               tubelet=(2, 4, 4), dropout_rate=0.0)
+    variables = model.init(jax.random.key(0), jnp.asarray(x))
+    merged, report = load_pretrained(st, variables)
+    assert report["kept"] == [], report["kept"]
+    ours = model.apply({"params": merged["params"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ours), theirs.numpy(),
+                               rtol=1e-4, atol=1e-4)
