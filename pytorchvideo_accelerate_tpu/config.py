@@ -371,6 +371,8 @@ def usage() -> str:
         "flags (dotted or reference-style):",
         "  --config FILE.json (JSON config applied before flags; nested,",
         "      dotted, or flat-alias keys — see load_config_file)",
+        "  --write_config FILE.json (resolve all flags/config files into",
+        "      one JSON and exit; reuse via --config)",
     ]
     for name, default in _leaf_fields():
         lines.append(f"  --{name} (default: {default!r})")

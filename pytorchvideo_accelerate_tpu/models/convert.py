@@ -633,7 +633,16 @@ def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
     if path.endswith(".safetensors"):
         from safetensors.numpy import load_file
 
-        return load_file(path)
+        out = {}
+        for k, v in load_file(path).items():
+            # ml_dtypes bfloat16 is not a native numpy dtype: np.savez
+            # would silently store it as raw void ("|V2") and corrupt the
+            # artifact — bridge through fp32 (exact), mirroring the torch
+            # branch below
+            if v.dtype.kind == "V" or v.dtype.name == "bfloat16":
+                v = v.astype(np.float32)
+            out[k] = v
+        return out
     import torch
 
     sd = torch.load(path, map_location="cpu", weights_only=True)

@@ -266,6 +266,15 @@ class Trainer:
         batch_stats = shard_params(self.mesh, variables.get("batch_stats", {}))
         self.state = TrainState.create(params, batch_stats, self.tx)
 
+        if cfg.model.pretrained and not cfg.model.pretrained_path:
+            # unlike the reference there is no runtime hub fetch (zero
+            # network dependency in the training job) — a converted
+            # artifact path is required, so say so instead of silently
+            # training from scratch
+            logger.warning(
+                "--model.pretrained set but --model.pretrained_path empty: "
+                "training from scratch. Convert a checkpoint first "
+                "(pva-tpu-convert SRC.pth OUT.npz) and pass its path.")
         if cfg.model.pretrained and cfg.model.pretrained_path:
             from pytorchvideo_accelerate_tpu.models.convert import load_pretrained
 

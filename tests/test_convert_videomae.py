@@ -195,6 +195,29 @@ def test_bf16_torch_checkpoint_converts(tmp_path):
     assert out["b"].dtype == np.float32
 
 
+def test_bf16_safetensors_round_trips_through_npz(tmp_path):
+    """bf16 safetensors -> npz artifact -> merge must survive: ml_dtypes
+    bfloat16 isn't a native numpy dtype and np.savez would corrupt it to
+    void bytes unless bridged to fp32 at read time."""
+    pytest.importorskip("safetensors")
+    from safetensors.torch import save_file
+
+    from pytorchvideo_accelerate_tpu.models.convert import (
+        load_converted, load_torch_state_dict,
+    )
+
+    sd = {"w": torch.randn(4, 4).to(torch.bfloat16)}
+    st = str(tmp_path / "bf16.safetensors")
+    save_file(sd, st)
+    out = load_torch_state_dict(st)
+    assert out["w"].dtype == np.float32
+    np.testing.assert_array_equal(out["w"], sd["w"].float().numpy())
+    # and the npz round-trip keeps real values
+    np.savez(str(tmp_path / "a.npz"), **{"params/w": out["w"]})
+    back = load_converted(str(tmp_path / "a.npz"))
+    np.testing.assert_array_equal(back["params"]["w"], out["w"])
+
+
 def test_safetensors_checkpoint_loads_without_torch_io(tmp_path):
     """HF's modern download format (.safetensors) converts directly —
     same logits as the .pt path."""
