@@ -22,6 +22,7 @@ from typing import Any
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -34,7 +35,15 @@ def pmean(x: Any, axis_name) -> Any:
 
 
 def all_gather(x: Any, axis_name, axis: int = 0, tiled: bool = True) -> Any:
-    """`accelerator.gather` equivalent for shard_map code paths."""
+    """`accelerator.gather` equivalent for shard_map code paths.
+
+    Note (jax >= 0.8 shard_map varying-mesh-axes checking): the gathered
+    value is identical on every shard but still *tracked* as varying over
+    `axis_name`, so returning it directly with `out_specs=P()` fails the
+    static replication check. Either consume it inside the shard_map (the
+    usual case — e.g. ring attention), or pass `check_vma=False` to
+    `jax.shard_map` when you really want the replicated gather as an
+    output (tested in tests/test_mesh_sharding.py)."""
     return lax.all_gather(x, axis_name, axis=axis, tiled=tiled)
 
 
@@ -52,3 +61,40 @@ def host_allgather(x: Any) -> Any:
     from jax.experimental import multihost_utils
 
     return multihost_utils.process_allgather(x)
+
+
+def host_broadcast(x: Any) -> Any:
+    """`accelerator.broadcast` equivalent, host level: every process returns
+    process 0's value (run-name, resolved checkpoint path, sampled seed —
+    anything one host decides for all).
+
+    Numeric leaves ride `multihost_utils.broadcast_one_to_all` and come back
+    as numpy arrays on EVERY process — including single-process runs, so dev
+    and pod behavior can't diverge. str/bytes leaves (which psum-based
+    broadcast can't carry) are broadcast as length then a uint8 buffer and
+    come back as str/bytes."""
+    from jax.experimental import multihost_utils
+
+    bcast = multihost_utils.broadcast_one_to_all
+
+    def leaf(v):
+        if isinstance(v, (str, bytes)):
+            raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
+            data = np.frombuffer(raw, np.uint8)
+            # non-root processes don't know root's length: broadcast it
+            # first so every process presents a matching buffer shape
+            n = int(bcast(np.int64(data.size)))
+            buf = np.zeros(n, np.uint8)
+            buf[: min(data.size, n)] = data[:n]
+            out = bytes(np.asarray(bcast(buf), np.uint8))
+            return out.decode("utf-8") if isinstance(v, str) else out
+        return np.asarray(bcast(v))
+
+    return jax.tree.map(leaf, x)
+
+
+def host_reduce_sum(x: Any) -> Any:
+    """`accelerator.reduce(op="sum")` equivalent for host-side counters
+    (clips decoded, batches dropped): sums each leaf across processes."""
+    gathered = host_allgather(x)
+    return jax.tree.map(lambda a: a.sum(axis=0), gathered)

@@ -63,6 +63,45 @@ def test_two_process_cpu_training(tmp_path):
     assert "epoch 0" in out, out[-4000:]
 
 
+def test_two_process_host_broadcast(tmp_path):
+    """host_broadcast across 2 REAL processes: every rank must come back
+    with process 0's value — including string leaves, which ride a
+    length-then-bytes broadcast (psum can't carry '<U' dtypes)."""
+    script = tmp_path / "bcast.py"
+    script.write_text(
+        "import os\n"
+        "os.environ['XLA_FLAGS'] = '--xla_force_host_platform_device_count=1'\n"
+        "import jax\n"
+        "jax.config.update('jax_platforms', 'cpu')\n"
+        "import numpy as np\n"
+        "from pytorchvideo_accelerate_tpu.parallel.distributed import (\n"
+        "    initialize_distributed, process_index)\n"
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import (\n"
+        "    host_broadcast, host_reduce_sum)\n"
+        "initialize_distributed()\n"
+        "rank = process_index()\n"
+        "out = host_broadcast({'run': f'run-from-{rank}',\n"
+        "                      'seed': np.int64(100 + rank)})\n"
+        "assert out['run'] == 'run-from-0', out\n"
+        "assert int(out['seed']) == 100, out\n"
+        "total = host_reduce_sum(np.float32(rank + 1))\n"
+        "assert float(total) == 3.0, total  # 1 + 2\n"
+        "print(f'rank {rank}: broadcast ok')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorchvideo_accelerate_tpu.launch",
+         "--num_processes", "2", "--timeout", "240", "--", str(script)],
+        env=env, capture_output=True, text=True, timeout=300,
+    )
+    out = proc.stdout + proc.stderr
+    assert proc.returncode == 0, out[-4000:]
+    assert "rank 0: broadcast ok" in out, out[-4000:]
+    assert "rank 1: broadcast ok" in out, out[-4000:]
+
+
 def test_failure_propagates_and_tears_down(tmp_path):
     """A crashing rank must fail the whole group with its exit code."""
     bad = tmp_path / "bad.py"
