@@ -77,20 +77,31 @@ def host_broadcast(x: Any) -> Any:
 
     bcast = multihost_utils.broadcast_one_to_all
 
-    def leaf(v):
-        if isinstance(v, (str, bytes)):
-            raw = v.encode("utf-8") if isinstance(v, str) else bytes(v)
-            data = np.frombuffer(raw, np.uint8)
-            # non-root processes don't know root's length: broadcast it
-            # first so every process presents a matching buffer shape
-            n = int(bcast(np.int64(data.size)))
+    leaves, treedef = jax.tree.flatten(x)
+    out = list(leaves)
+    num_idx = [i for i, v in enumerate(leaves)
+               if not isinstance(v, (str, bytes))]
+    str_idx = [i for i, v in enumerate(leaves) if isinstance(v, (str, bytes))]
+    # one collective for ALL numeric leaves (broadcast_one_to_all takes a
+    # pytree) + one for all string lengths; only the string buffers (rare)
+    # need a round trip each, since their shapes depend on root's lengths
+    if num_idx:
+        nums = bcast([leaves[i] for i in num_idx])
+        for i, v in zip(num_idx, nums):
+            out[i] = np.asarray(v)
+    if str_idx:
+        raws = [leaves[i].encode("utf-8") if isinstance(leaves[i], str)
+                else bytes(leaves[i]) for i in str_idx]
+        lens = [int(n) for n in np.asarray(
+            bcast(np.array([len(r) for r in raws], np.int64)))]
+        for i, raw, n in zip(str_idx, raws, lens):
             buf = np.zeros(n, np.uint8)
+            data = np.frombuffer(raw, np.uint8)
             buf[: min(data.size, n)] = data[:n]
-            out = bytes(np.asarray(bcast(buf), np.uint8))
-            return out.decode("utf-8") if isinstance(v, str) else out
-        return np.asarray(bcast(v))
-
-    return jax.tree.map(leaf, x)
+            res = bytes(np.asarray(bcast(buf), np.uint8))
+            out[i] = (res.decode("utf-8") if isinstance(leaves[i], str)
+                      else res)
+    return jax.tree.unflatten(treedef, out)
 
 
 def host_reduce_sum(x: Any) -> Any:

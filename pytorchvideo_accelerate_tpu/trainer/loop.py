@@ -368,7 +368,20 @@ class Trainer:
         """Restore state + data position; returns starting epoch."""
         if not (self.cfg.checkpoint.resume_from_checkpoint and self.checkpointer):
             return 0
-        if self.checkpointer.latest_step() is None:
+        latest = self.checkpointer.latest_step()
+        if jax.process_count() > 1:
+            # the directory scan can race across hosts (a checkpoint landing
+            # mid-scan -> host A sees step 200, host B step 100 or none, and
+            # the collective restore diverges or hangs): process 0's answer
+            # is authoritative for the whole pod (-1 encodes "none")
+            from pytorchvideo_accelerate_tpu.parallel.collectives import (
+                host_broadcast,
+            )
+
+            latest = int(host_broadcast(
+                np.int64(-1 if latest is None else latest)))
+            latest = None if latest < 0 else latest
+        if latest is None:
             if self.cfg.checkpoint.resume_from_checkpoint == "auto":
                 main_print("resume=auto: no checkpoint found, starting fresh")
                 return 0
@@ -376,7 +389,7 @@ class Trainer:
                 f"no checkpoint to resume in {self.checkpointer.directory}"
             )
         self.state, extra, step = self.checkpointer.restore(
-            self.state, mesh=self.mesh
+            self.state, step=latest, mesh=self.mesh
         )
         main_print(f"resumed from checkpoint step {step}")
         for name, obj in self._registered.items():
