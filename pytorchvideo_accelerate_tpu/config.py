@@ -149,6 +149,9 @@ class TrainConfig:
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
 
     seed: int = 42  # run.py:138 set_seed(42); run.py:355 exposes --seed
+    # run the validation loop once and exit (score a resumed/converted
+    # checkpoint); no reference equivalent — run.py always trains
+    eval_only: bool = False
     # "bf16" = bf16 compute / fp32 params (TPU-native replacement for the
     # reference's fp16 GradScaler path, SURVEY §2.3-N7); "fp32" = full fp32.
     mixed_precision: str = "bf16"

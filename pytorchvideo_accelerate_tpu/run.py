@@ -60,6 +60,8 @@ def main(argv: Optional[Sequence[str]] = None) -> dict:
               f"(reuse with --config {write_to})")
         return {"config_written": write_to}
     trainer = Trainer(cfg)
+    if cfg.eval_only:
+        return trainer.evaluate()
     return trainer.fit()
 
 

@@ -421,6 +421,52 @@ class Trainer:
             },
         )
 
+    def _run_eval(self, epoch: int) -> tuple:
+        """One pass over the val loader with in-graph masked metric sums
+        (shared by fit()'s per-epoch eval and evaluate());
+        returns (top1, top5, mean_loss)."""
+        val = SumMetrics()
+        for step_in_epoch, batch in enumerate(self.val_loader.epoch(epoch)):
+            val.update(self.eval_step(self.state,
+                                      shard_batch(self.mesh, batch)))
+            if 0 <= self.cfg.data.limit_val_batches <= step_in_epoch + 1:
+                break
+        return val.accuracy(), val.accuracy_top5(), val.mean_loss()
+
+    def evaluate(self) -> dict:
+        """Run the validation loop once, without training — for scoring a
+        resumed or converted checkpoint (`--resume_from_checkpoint` /
+        `--model.pretrained_path` decide the weights). Same in-graph masked
+        metrics as fit()'s epoch eval; logs to the configured trackers and
+        closes loaders/trackers before returning."""
+        if not (self.cfg.checkpoint.resume_from_checkpoint
+                or (self.cfg.model.pretrained
+                    and self.cfg.model.pretrained_path)):
+            logger.warning(
+                "evaluate() without --resume_from_checkpoint or "
+                "--model.pretrained_path: scoring freshly-initialized "
+                "random weights — the result is meaningless.")
+        try:
+            self._maybe_resume()
+            acc, acc5, loss = self._run_eval(epoch=0)
+            if self.is_pretraining:
+                result = {"val_recon_loss": loss}
+                main_print(f"evaluate: val_recon_loss={loss:.4f}")
+            else:
+                result = {"val_accuracy": acc, "val_accuracy_top5": acc5,
+                          "val_loss": loss}
+                main_print(f"evaluate: val_acc={acc:.4f} val_acc5={acc5:.4f}")
+            if self.trackers:
+                self.trackers.log(result, step=int(self.state.step))
+            return result
+        finally:
+            if self.trackers:
+                self.trackers.finish()
+            if self.checkpointer is not None:
+                self.checkpointer.close()
+            self.train_loader.close()
+            self.val_loader.close()
+
     def fit(self) -> dict:
         cfg = self.cfg
         starting_epoch = self._maybe_resume()
@@ -501,15 +547,8 @@ class Trainer:
                 epoch_train_times.append(time.time() - t_epoch)
 
                 # Evaluation (reference run.py:287-304, in-graph metric sums)
-                val = SumMetrics()
-                for step_in_epoch, batch in enumerate(self.val_loader.epoch(epoch)):
-                    out = self.eval_step(self.state, shard_batch(self.mesh, batch))
-                    val.update(out)
-                    if 0 <= cfg.data.limit_val_batches <= step_in_epoch + 1:
-                        break
-                last_val_acc = val.accuracy()
-                last_val_acc5 = val.accuracy_top5()
-                last_val_loss = val.mean_loss()
+                last_val_acc, last_val_acc5, last_val_loss = \
+                    self._run_eval(epoch)
                 last_train_loss = epoch_loss.mean()
                 val_str = (
                     f"val_recon_loss={last_val_loss:.4f}" if self.is_pretraining

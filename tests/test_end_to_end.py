@@ -68,6 +68,36 @@ def test_fit_trains_and_reports(tmp_path):
     assert np.isfinite(result["train_loss"])
 
 
+def test_eval_only_scores_a_checkpoint(tmp_path):
+    """--eval_only: train with an epoch checkpoint, then score it without
+    training (no such mode in the reference — run.py always trains)."""
+    from pytorchvideo_accelerate_tpu.run import main as run_main
+
+    cfg = _cfg(tmp_path, **{
+        "checkpoint.checkpointing_steps": "epoch",
+        "optim.num_epochs": 1,
+    })
+    fit_res = Trainer(cfg).fit()
+
+    ev = run_main([
+        "--cpu", "--synthetic", "--eval_only",
+        "--data.synthetic_num_videos", "16",
+        "--data.num_frames", "4", "--data.crop_size", "32",
+        "--data.min_short_side_scale", "32",
+        "--data.max_short_side_scale", "40",
+        "--data.batch_size", "1", "--data.num_workers", "2",
+        "--model.name", "slow_r50", "--model.num_classes", "4",
+        "--checkpoint.output_dir", str(tmp_path),
+        "--resume_from_checkpoint", "auto",
+    ])
+    assert 0.0 <= ev["val_accuracy"] <= 1.0
+    assert ev["val_accuracy_top5"] >= ev["val_accuracy"]
+    assert np.isfinite(ev["val_loss"])
+    # the checkpointed weights really got scored: matches fit()'s final eval
+    np.testing.assert_allclose(ev["val_accuracy"], fit_res["val_accuracy"],
+                               atol=1e-6)
+
+
 def test_fit_with_fsdp_axis(tmp_path):
     """Full Trainer.fit() (not just the raw step) over a data=4 x fsdp=2
     mesh: the Trainer's own param/batch sharding, eval, and checkpoint
