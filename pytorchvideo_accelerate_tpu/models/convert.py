@@ -638,9 +638,15 @@ def load_torch_state_dict(path: str) -> Dict[str, np.ndarray]:
             # ml_dtypes bfloat16 is not a native numpy dtype: np.savez
             # would silently store it as raw void ("|V2") and corrupt the
             # artifact — bridge through fp32 (exact), mirroring the torch
-            # branch below
-            if v.dtype.kind == "V" or v.dtype.name == "bfloat16":
+            # branch below. Raw-void arrays (safetensors read without
+            # ml_dtypes registered) can't astype directly: reinterpret the
+            # bf16 bits first.
+            if v.dtype.name == "bfloat16":
                 v = v.astype(np.float32)
+            elif v.dtype.kind == "V" and v.dtype.itemsize == 2:
+                import ml_dtypes
+
+                v = v.view(ml_dtypes.bfloat16).astype(np.float32)
             out[k] = v
         return out
     import torch
@@ -829,14 +835,23 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
         for k, v in target.items():
             p = prefix + (k,)
             if isinstance(v, dict):
-                out[k] = merge(v, src.get(k, {}), p)
+                if k in src and not isinstance(src[k], dict):
+                    # structural mismatch: source has a leaf where the
+                    # target expects a subtree — a stale/wrong-layout
+                    # artifact, not a fresh head; must trip the loud warning
+                    report["mismatched"].append("/".join(p))
+                    out[k] = merge(v, {}, p)
+                else:
+                    out[k] = merge(v, src.get(k, {}), p)
             elif k in src and not isinstance(src[k], dict) \
                     and tuple(np.shape(src[k])) == tuple(v.shape):
                 out[k] = jnp.asarray(src[k], dtype=v.dtype)
                 report["loaded"].append("/".join(p))
             else:
                 out[k] = v
-                (report["mismatched"] if k in src and not isinstance(src[k], dict)
+                # wrong shape OR a subtree where a leaf is expected ->
+                # mismatched; absent entirely -> kept (fresh param)
+                (report["mismatched"] if k in src
                  else report["kept"]).append("/".join(p))
         return out
 

@@ -90,13 +90,25 @@ class Checkpointer:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-        restored = self._mgr.restore(
-            int(step),
-            args=ocp.args.Composite(
-                state=ocp.args.StandardRestore(state_template),
-                extra=ocp.args.JsonRestore(),
-            ),
-        )
+        try:
+            restored = self._mgr.restore(
+                int(step),
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardRestore(state_template),
+                    extra=ocp.args.JsonRestore(),
+                ),
+            )
+        except (ValueError, KeyError) as e:
+            # a structure/shape mismatch here usually means the checkpoint
+            # was written by an older model layout (e.g. the 0.4 videomae_b/
+            # mvit_b param-tree change) — say so instead of the raw orbax error
+            raise RuntimeError(
+                f"checkpoint at {self.directory} step {step} does not match "
+                "the current model's parameter tree. If it was written by an "
+                "older version (<0.4 changed videomae_b/mvit_b layouts), "
+                "re-convert the original weights or retrain; see MIGRATING.md "
+                "'Checkpoint layout changes'."
+            ) from e
         return restored["state"], dict(restored["extra"] or {}), int(step)
 
     def latest_step(self) -> Optional[int]:

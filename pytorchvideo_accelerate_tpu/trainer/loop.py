@@ -11,7 +11,7 @@ from __future__ import annotations
 
 import os
 import time
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
 import numpy as np
@@ -479,6 +479,7 @@ class Trainer:
                             initial=int(self.state.step))
         last_val_acc, last_train_loss = 0.0, float("nan")
         last_val_acc5, last_val_loss = 0.0, float("nan")
+        last_perf: Dict[str, float] = {}
         # train-section wall time per epoch (excludes eval/ckpt; epoch 0
         # includes compile) — lets benchmarks measure steady-state throughput
         epoch_train_times = []
@@ -516,7 +517,9 @@ class Trainer:
                         )
                     gstep += 1
                     train_steps_this_epoch += 1
-                    if self.trackers and self._flops_per_step is None:
+                    if self._flops_per_step is None:
+                        # unconditional (not tracking-gated): fit()'s return
+                        # dict and the bench harness both need FLOPs/step
                         self._capture_step_flops(global_batch, gstep)
                     if profiling and gstep - run_start_step >= 6:
                         jax.profiler.stop_trace()
@@ -560,6 +563,30 @@ class Trainer:
                     f"train_loss={last_train_loss:.4f} "
                     f"({time.time() - t_epoch:.1f}s)"
                 )
+                # epoch throughput + (when XLA's cost model is available)
+                # achieved TFLOP/s and MFU against the chip's bf16 peak —
+                # computed unconditionally so fit()'s return dict carries
+                # them even without --with_tracking
+                steps_done = train_steps_this_epoch
+                t_train = epoch_train_times[-1]
+                if t_train > 0 and steps_done > 0:
+                    sps = steps_done / t_train
+                    last_perf = {
+                        "steps_per_sec": sps,
+                        "clips_per_sec": (
+                            sps * self.train_loader.global_batch_size
+                            * self.train_loader.accum_steps
+                        ),
+                    }
+                    if self._flops_per_step:
+                        from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
+
+                        n_dev = len(jax.devices())
+                        tflops = self._flops_per_step * sps / 1e12 / n_dev
+                        last_perf["tflops_per_sec_per_chip"] = tflops
+                        peak = peak_tflops(jax.devices()[0])
+                        if peak:
+                            last_perf["mfu"] = tflops / peak
                 if self.trackers:
                     epoch_metrics = {"train_loss_epoch": last_train_loss,
                                      "epoch": epoch}
@@ -568,26 +595,7 @@ class Trainer:
                     else:
                         epoch_metrics["accuracy"] = last_val_acc
                         epoch_metrics["accuracy_top5"] = last_val_acc5
-                    # epoch throughput + (when XLA's cost model is available)
-                    # achieved TFLOP/s and MFU against the chip's bf16 peak
-                    steps_done = train_steps_this_epoch
-                    t_train = epoch_train_times[-1]
-                    if t_train > 0 and steps_done > 0:
-                        sps = steps_done / t_train
-                        epoch_metrics["steps_per_sec"] = sps
-                        epoch_metrics["clips_per_sec"] = (
-                            sps * self.train_loader.global_batch_size
-                            * self.train_loader.accum_steps
-                        )
-                        if self._flops_per_step:
-                            from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
-
-                            n_dev = len(jax.devices())
-                            tflops = self._flops_per_step * sps / 1e12 / n_dev
-                            epoch_metrics["tflops_per_sec_per_chip"] = tflops
-                            peak = peak_tflops(jax.devices()[0])
-                            if peak:
-                                epoch_metrics["mfu"] = tflops / peak
+                    epoch_metrics.update(last_perf)
                     self.trackers.log(epoch_metrics, step=epoch)
                 if cfg.debug_desync:
                     import optax
@@ -619,7 +627,8 @@ class Trainer:
         self.train_loader.close()
         self.val_loader.close()
         result = {"train_loss": last_train_loss, "steps": int(self.state.step),
-                  "epoch_train_times": epoch_train_times}
+                  "epoch_train_times": epoch_train_times,
+                  "flops_per_step": self._flops_per_step, **last_perf}
         if self.is_pretraining:
             result["val_recon_loss"] = last_val_loss
         else:
