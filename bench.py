@@ -3,7 +3,8 @@
 
 Prints exactly ONE JSON line to stdout:
     {"metric": "...", "value": N, "unit": "clips/sec/chip", "vs_baseline": N,
-     "mfu": ..., "tflops_per_sec": ..., "step_ms_blocked": ..., "models": {...}}
+     "mfu": ..., "tflops_per_sec": ..., "step_ms_blocked": ...,
+     "models": {...}, "probe_attempts": [...]}
 (everything else goes to stderr). Runs on the attached TPU by default; pass
 --smoke for a CPU-sized sanity run.
 
@@ -13,6 +14,20 @@ batch 8 per chip, bf16 compute (standing in for the recipe's fp16 AMP),
 measuring the compiled train step (fwd+bwd+update, BN stats, metrics) end to
 end. The BASELINE configs 2/4/5 (x3d_s, mvit_b, videomae_b_pretrain) are
 benched too and reported under "models".
+
+Wedge resilience (the axon tunnel to the chip can block forever at backend
+init or mid-compile, and a kill can leave it wedged for hours — observed in
+rounds 3-4):
+- the PARENT process never touches devices (it forces the CPU platform);
+  every device-facing bench runs in a DISPOSABLE CHILD subprocess with its
+  own kill timeout, so one wedged compile loses one model, not the round;
+- TPU reachability is probed in a subprocess before each device attempt and
+  re-probed (with backoff) between models; every probe is timestamped into
+  "probe_attempts" in the final JSON — the evidence trail for rounds where
+  the device was unreachable throughout;
+- models that had to fall back to CPU smoke are re-tried on the device at
+  the end of the run if a late probe succeeds;
+- partial results are flushed to bench_partial.json after every model.
 
 Self-audit (so impossible numbers can't pass unremarked):
 - per-step FLOPs come from XLA's own `compiled.cost_analysis()`;
@@ -26,9 +41,10 @@ Self-audit (so impossible numbers can't pass unremarked):
 """
 
 import argparse
+import datetime
 import json
-import math
 import os
+import signal
 import statistics
 import subprocess
 import sys
@@ -39,7 +55,8 @@ def log(*args):
     print(*args, file=sys.stderr, flush=True)
 
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
 from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops  # noqa: E402
 
 
@@ -53,6 +70,26 @@ WORKLOADS = {
     "videomae_b_pretrain": dict(num_frames=16, crop=224, batch_size=8,
                                 pretrain=True),
 }
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%FT%TZ")
+
+
+def _setup_jax(smoke: bool):
+    """Backend + persistent compile cache config (child processes and the
+    device-free parent both go through here)."""
+    import jax
+
+    if smoke:
+        jax.config.update("jax_platforms", "cpu")
+    cache_dir = os.path.join(HERE, ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:
+        log(f"compilation cache unavailable: {e}")
+    return jax
 
 
 def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
@@ -78,7 +115,6 @@ def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
     model = create_model(model_cfg, "bf16")
 
     B = bsz * n_chips  # global batch: bench batch is per chip
-    rng = np.random.default_rng(0)
 
     def make_batch(seed):
         r = np.random.default_rng(seed)
@@ -184,6 +220,8 @@ def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
         "frames": frames,
         "crop": crop,
         "suspect": suspect,
+        "smoke": bool(args.smoke),
+        "platform": dev.platform,
     }
     if flops_per_step:
         out["flops_per_step"] = flops_per_step
@@ -193,12 +231,50 @@ def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
     return out
 
 
+def bench_trainer(args) -> dict:
+    """Trainer.fit() on synthetic data — its steady-state clips/s/chip is
+    compared (in the parent) against the raw-step number to prove the hot
+    loop doesn't sync away the pipelining (VERDICT r2 weak #4)."""
+    import jax
+
+    from pytorchvideo_accelerate_tpu.config import (
+        DataConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    frames, crop, bsz = (8, 64, 2) if args.smoke else (32, 256, 8)
+    n_videos = bsz * len(jax.devices()) * (4 if args.smoke else 16)
+    cfg = TrainConfig(
+        model=ModelConfig(name="slowfast_r50", num_classes=700),
+        data=DataConfig(synthetic=True, synthetic_num_videos=n_videos,
+                        num_frames=frames, crop_size=crop, batch_size=bsz,
+                        num_workers=2, limit_val_batches=1),
+        optim=OptimConfig(num_epochs=2),  # epoch 1 excludes compile
+        mixed_precision="bf16",
+    )
+    tr = Trainer(cfg)
+    res = tr.fit()
+    # steady-state: train-section wall time of the post-compile epoch only
+    # (excludes compile, eval, checkpointing — the quantity the raw-step
+    # number measures)
+    steps_per_epoch = res["steps"] // cfg.optim.num_epochs
+    dt = res["epoch_train_times"][-1]
+    clips = steps_per_epoch * bsz * len(jax.devices())
+    cps_chip = clips / dt / len(jax.devices())
+    log(f"[trainer] fit() steady-state epoch: {steps_per_epoch} steps in "
+        f"{dt:.2f}s = {cps_chip:.2f} clips/s/chip (incl. data pipeline)")
+    return {"trainer_cps_chip": cps_chip,
+            "mfu": res.get("mfu"), "smoke": bool(args.smoke)}
+
+
 def bench_data(args) -> dict:
     """Host input-pipeline microbench (SURVEY §7 hard-part 1): encodes a
     small synthetic video tree, then measures raw cv2 decode vs pre-decoded
     cache clips/sec and ClipLoader end-to-end throughput on both transports.
 
-    These numbers are host-CPU-real — trustworthy on any box, including when
+    Non-smoke shapes are the production-bound ones (320x256 source ->
+    256^2 crops, the reference transform geometry, run_slowfast_r50.sh):
+    these numbers are host-CPU-real — trustworthy on any box, including when
     device timing is not — and they bound the chips/host ratio the input
     pipeline can feed."""
     import shutil
@@ -224,10 +300,11 @@ def bench_data(args) -> dict:
     fps = 30.0
     n_videos, n_frames = (4, 24) if args.smoke else (8, 64)
     w_px, h_px = (96, 64) if args.smoke else (320, 256)
-    crop = 64 if args.smoke else 224
+    crop = 64 if args.smoke else 256  # reference crop (run_slowfast_r50.sh)
     num_frames = 8
     clip_duration = num_frames * 2 / fps  # sampling_rate 2
-    out: dict = {"video_px": f"{w_px}x{h_px}", "num_videos": n_videos}
+    out: dict = {"video_px": f"{w_px}x{h_px}", "crop": crop,
+                 "num_videos": n_videos}
     rng = np.random.default_rng(0)
     try:
         root = os.path.join(tmp, "train")
@@ -256,17 +333,20 @@ def bench_data(args) -> dict:
         # loader end-to-end: decode + transforms + batch assembly
         tf = make_transform(num_frames=num_frames, training=True,
                             min_short_side_scale=crop,
-                            max_short_side_scale=crop + 16, crop_size=crop)
+                            max_short_side_scale=crop + 64, crop_size=crop)
         manifest = scan_directory(root)
         epochs = 2 if args.smoke else 4
+        n_workers = 2 if args.smoke else 4
+        out["num_workers"] = n_workers
         for transport in ("thread", "process"):
             src = VideoClipSource(manifest, tf, clip_duration, training=True,
                                   seed=0)
             loader = ClipLoader(src, global_batch_size=4, shuffle=True,
-                                num_workers=2, transport=transport)
+                                num_workers=n_workers, transport=transport)
             try:
                 clips = 0
-                next(iter(loader.epoch(0)))  # warm pools/caches
+                for _ in loader.epoch(0):  # warm pools/caches; drain fully
+                    pass                   # so no background decode skews timing
                 t0 = time.perf_counter()
                 for ep in range(1, epochs + 1):
                     for batch in loader.epoch(ep):
@@ -284,6 +364,160 @@ def bench_data(args) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_transport_crossover(args) -> dict:
+    """Thread vs process worker pools on a transform-heavy (GIL-bound)
+    workload — no video decode, pure numpy per-item work — at >=4 workers
+    (VERDICT r3 item 6: find where, if anywhere, the process transport wins
+    on this host, and record the machine context the answer depends on)."""
+    from pytorchvideo_accelerate_tpu.data.pipeline import (
+        ClipLoader, SyntheticClipSource,
+    )
+
+    import numpy as np
+
+    n_items = 32 if args.smoke else 96
+    frames, size = (8, 112) if args.smoke else (16, 224)
+    out: dict = {"cpus": os.cpu_count(), "num_workers": 4,
+                 "frames": frames, "size": size}
+
+    def heavy_transform(raw, rng):
+        # deliberately GIL-holding numpy work sized like a real augment stack
+        v = raw[:frames].astype(np.float32) / 255.0
+        for _ in range(6):
+            v = np.clip(v * 1.01 + 0.001, -3, 3)
+            v = (v - v.mean(axis=(1, 2), keepdims=True)) / (
+                v.std(axis=(1, 2), keepdims=True) + 1e-5)
+        return {"video": v}
+
+    for transport in ("thread", "process"):
+        src = SyntheticClipSource(heavy_transform, num_videos=n_items,
+                                  num_classes=5, raw_frames=frames,
+                                  raw_size=(size, size), seed=0)
+        loader = ClipLoader(src, global_batch_size=4, shuffle=False,
+                            num_workers=4, transport=transport)
+        try:
+            for _ in loader.epoch(0):  # warm, fully drained
+                pass
+            t0 = time.perf_counter()
+            clips = 0
+            for ep in (1, 2):
+                for batch in loader.epoch(ep):
+                    clips += batch["label"].shape[0]
+            out[f"{transport}_clips_per_sec"] = round(
+                clips / (time.perf_counter() - t0), 2)
+            if loader.transport != transport:
+                out[f"{transport}_note"] = f"fell back to {loader.transport}"
+        finally:
+            loader.close()
+    t, p = out.get("thread_clips_per_sec"), out.get("process_clips_per_sec")
+    # no verdict when the process run silently fell back to threads — a
+    # thread-vs-thread comparison would answer the crossover question wrong
+    if t and p and "process_note" not in out:
+        out["winner"] = "process" if p > t else "thread"
+        out["ratio_process_over_thread"] = round(p / t, 3)
+    log(f"[transport] {out}")
+    return out
+
+
+# --- parent orchestration ---------------------------------------------------
+
+def probe_device(probe_attempts: list, timeout: int = 240) -> bool:
+    """Can a fresh process enumerate the TPU and run one op? Timestamped
+    evidence either way; also appended to .probe_log.jsonl."""
+    rec = {"ts": _utcnow(), "timeout_s": timeout}
+    t0 = time.time()
+    code = ("import jax, numpy as np\n"
+            "d = jax.devices()[0]\n"
+            "assert d.platform != 'cpu', d.platform\n"
+            "x = jax.device_put(np.ones((128, 128), np.float32), d)\n"
+            "jax.jit(lambda a: a @ a)(x).block_until_ready()\n"
+            "print(d.platform, d.device_kind)\n")
+    try:
+        r = subprocess.run([sys.executable, "-c", code], timeout=timeout,
+                           capture_output=True, text=True)
+        if r.returncode == 0:
+            rec.update(ok=True, elapsed_s=round(time.time() - t0, 1),
+                       device=r.stdout.strip())
+        else:
+            rec.update(ok=False, elapsed_s=round(time.time() - t0, 1),
+                       error=(r.stderr.strip() or "nonzero exit")[-200:])
+    except subprocess.TimeoutExpired:
+        rec.update(ok=False, elapsed_s=round(time.time() - t0, 1),
+                   error="timeout (backend init wedged)")
+    except Exception as e:
+        rec.update(ok=False, error=f"{type(e).__name__}: {e}")
+    probe_attempts.append(rec)
+    log(f"[probe] {rec}")
+    try:
+        with open(os.path.join(HERE, ".probe_log.jsonl"), "a") as f:
+            f.write(json.dumps({"probe": "bench", **rec}) + "\n")
+    except OSError:
+        pass
+    return bool(rec.get("ok"))
+
+
+def _model_timeout(args):
+    """0 = no limit (matches the historical --per_model_timeout contract)."""
+    return args.per_model_timeout if args.per_model_timeout > 0 else None
+
+
+def run_child(target: str, args, smoke: bool, timeout) -> dict:
+    """One bench in a disposable subprocess (own process group; killed
+    wholesale on timeout so a wedged backend can't hang the round);
+    `timeout=None` = no limit."""
+    cmd = [sys.executable, os.path.abspath(__file__), "--child", target,
+           "--steps", str(args.steps), "--warmup", str(args.warmup),
+           "--alpha", str(args.alpha)]
+    if smoke:
+        cmd.append("--smoke")
+    p = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
+                         text=True, start_new_session=True)
+    try:
+        out, _ = p.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        try:
+            os.killpg(p.pid, signal.SIGKILL)
+        except OSError:
+            pass
+        p.wait()
+        log(f"[{target}] child killed after {timeout}s")
+        return {"error": f"child timeout after {timeout}s", "smoke": smoke}
+    if p.returncode != 0:
+        return {"error": f"child exited {p.returncode}", "smoke": smoke}
+    for line in reversed((out or "").strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    return {"error": "no JSON from child", "smoke": smoke}
+
+
+def child_main(args) -> None:
+    """--child entry: run ONE bench and print its JSON as the last line."""
+    jax = _setup_jax(args.smoke)
+    if args.smoke:
+        args.steps, args.warmup = min(args.steps, 3), 1
+
+    from pytorchvideo_accelerate_tpu.config import MeshConfig
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+
+    if args.child == "__trainer__":
+        res = bench_trainer(args)
+    else:
+        devices = jax.devices()
+        n_chips = len(devices)
+        peak = peak_tflops(devices[0])
+        log(f"devices: {n_chips} x {devices[0].device_kind} "
+            f"({devices[0].platform}), bf16 peak "
+            f"{f'{peak:.0f} TFLOP/s/chip' if peak else 'unknown'}")
+        mesh = make_mesh(MeshConfig(), devices=devices)
+        res = bench_model(args.child, WORKLOADS[args.child], args, mesh,
+                          n_chips)
+        res["n_chips"] = n_chips
+    print("\n" + json.dumps(res))
+    sys.stdout.flush()
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--models", default="all",
@@ -291,7 +525,8 @@ def main():
     ap.add_argument("--alpha", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--warmup", type=int, default=3)
-    ap.add_argument("--trainer", action="store_true",
+    ap.add_argument("--trainer", action=argparse.BooleanOptionalAction,
+                    default=True,
                     help="also run Trainer.fit() on synthetic data and report "
                          "its throughput vs the raw step (hot-loop overhead)")
     ap.add_argument("--data", action=argparse.BooleanOptionalAction,
@@ -302,138 +537,161 @@ def main():
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe shapes for harness verification")
     ap.add_argument("--per_model_timeout", type=int, default=900,
-                    help="seconds before a model's bench is abandoned "
-                         "(a wedged compile/backend must not prevent the "
-                         "final JSON line; 0 = no limit)")
+                    help="seconds before a model's child bench is killed "
+                         "(0 = no limit)")
+    ap.add_argument("--probe_timeout", type=int, default=240)
+    ap.add_argument("--child", default="", help=argparse.SUPPRESS)
     args = ap.parse_args()
 
-    # The axon tunnel to the chip can wedge at backend init (observed: device
-    # enumeration blocks forever, hanging any process that touches it). Probe
-    # reachability in a DISPOSABLE subprocess first: if it can't enumerate
-    # devices in time, fall back to CPU smoke shapes and say so in the JSON
-    # line instead of timing out with no output at all.
-    tpu_unreachable = False
-    if not args.smoke:
+    if args.child:
+        child_main(args)
+        return
+
+    # The parent must NEVER touch devices: a wedged axon init inside this
+    # process would lose the whole round. All device work happens in
+    # children; the parent pins itself to CPU before any jax import can act.
+    _setup_jax(smoke=True)
+
+    user_smoke = args.smoke
+    probe_attempts: list = []
+    partial_path = os.path.join(HERE, "bench_partial.json")
+    results: dict = {}
+    extras: dict = {"probe_attempts": probe_attempts}
+
+    def flush_partial():
         try:
-            subprocess.run(
-                [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=240, check=True,
-                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
-            )
-        except Exception as e:
-            tpu_unreachable = True
-            args.smoke = True
-            log(f"TPU backend unreachable ({type(e).__name__}); falling back "
-                "to CPU smoke shapes — numbers are NOT device numbers")
+            with open(partial_path, "w") as f:
+                json.dump({"results": results, **extras}, f, indent=1)
+        except OSError:
+            pass
 
-    import jax
-
-    if args.smoke:
-        args.steps, args.warmup = 3, 1
-        jax.config.update("jax_platforms", "cpu")
-    # persistent compilation cache: pays off every driver re-run/restart
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:
-        log(f"compilation cache unavailable: {e}")
-
-    from pytorchvideo_accelerate_tpu.config import MeshConfig
-    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
-
-    devices = jax.devices()
-    n_chips = len(devices)
-    peak = peak_tflops(devices[0])
-    log(f"devices: {n_chips} x {devices[0].device_kind} "
-        f"({devices[0].platform}), bf16 peak "
-        f"{f'{peak:.0f} TFLOP/s/chip' if peak else 'unknown'}")
-    mesh = make_mesh(MeshConfig(), devices=devices)
+    device_ok = False
+    if not user_smoke:
+        device_ok = probe_device(probe_attempts, args.probe_timeout)
+        if not device_ok:
+            log("TPU unreachable on first probe; will re-probe between "
+                "models — CPU smoke numbers are NOT device numbers")
 
     names = list(WORKLOADS) if args.models == "all" else args.models.split(",")
-    results = {}
 
-    # BaseException: must NOT be swallowed by any `except Exception` inside
-    # bench_model (e.g. the cost_analysis guard) — only the model-loop
-    # handler below may consume it
-    class _Timeout(BaseException):
-        pass
+    def bench_one(name, smoke):
+        # smoke children are capped tighter (tiny shapes) but still honor
+        # the user's limit, including 0 = no limit
+        timeout = (_model_timeout(args) if not smoke
+                   else (min(args.per_model_timeout, 600)
+                         if args.per_model_timeout > 0 else None))
+        res = run_child(name, args, smoke, timeout)
+        results[name] = res
+        flush_partial()
+        return res
 
-    import signal
+    for i, name in enumerate(names):
+        if not user_smoke and not device_ok and probe_attempts:
+            # re-probe (shorter) before each model once the first probe
+            # failed — the tunnel demonstrably comes and goes within a round
+            if i > 0:
+                device_ok = probe_device(probe_attempts,
+                                         min(args.probe_timeout, 120))
+        res = bench_one(name, smoke=user_smoke or not device_ok)
+        if not user_smoke and device_ok and "error" in res:
+            # device attempt wedged/died: don't trust the tunnel until a
+            # fresh probe says otherwise; record a smoke fallback now
+            log(f"[{name}] device bench failed; falling back to smoke")
+            device_ok = False
+            results[name + "__device_error"] = res
+            bench_one(name, smoke=True)
 
-    def _alarm(signum, frame):
-        raise _Timeout(f"exceeded --per_model_timeout={args.per_model_timeout}s")
-
-    can_alarm = hasattr(signal, "SIGALRM") and args.per_model_timeout > 0
-    if can_alarm:
-        signal.signal(signal.SIGALRM, _alarm)
-
-    # Last-resort watchdog for hangs that SIGALRM can't interrupt (a wedged
-    # compile inside a GIL-holding C call): after the total budget, emit the
-    # final JSON with whatever finished and hard-exit — the driver must
-    # always get the one-line result.
-    import threading
-
-    emitted = threading.Event()
-    emit_lock = threading.Lock()
-    extras: dict = {}
-
-    def emit_final():
-        with emit_lock:  # exactly ONE JSON line, even racing the watchdog
-            if emitted.is_set():
-                return
-            emitted.set()
-        print(json.dumps(finalize(results, extras, args, tpu_unreachable)))
-        sys.stdout.flush()
-
-    watchdog_timer = None
-    if can_alarm:
-        total_budget = args.per_model_timeout * (len(names) + 1)
-
-        def watchdog():
-            for name in names:  # mark whatever never finished
-                results.setdefault(name, {"error": "total watchdog timeout"})
-            extras["error"] = f"watchdog: exceeded {total_budget}s total"
-            log(extras["error"])
-            emit_final()
-            os._exit(2)
-
-        watchdog_timer = threading.Timer(total_budget, watchdog)
-        watchdog_timer.daemon = True
-        watchdog_timer.start()
-
-    for name in names:
-        try:
-            if can_alarm:
-                signal.alarm(args.per_model_timeout)
-            results[name] = bench_model(name, WORKLOADS[name], args, mesh,
-                                        n_chips)
-        except (Exception, _Timeout) as e:
-            log(f"[{name}] FAILED: {type(e).__name__}: {e}")
-            results[name] = {"error": f"{type(e).__name__}: {e}"}
-        finally:
-            if can_alarm:
-                signal.alarm(0)
+    # late recovery: any model that had to run as CPU smoke gets retried on
+    # the device — whether the tunnel is already back (mid-round recovery)
+    # or comes back for one final probe now
+    if not user_smoke:
+        # platform missing covers error-only results (both children died):
+        # those deserve a device retry too
+        needs_retry = [n for n in names
+                       if results.get(n, {}).get("platform", "cpu") == "cpu"]
+        if needs_retry and not device_ok:
+            device_ok = probe_device(probe_attempts, args.probe_timeout)
+        for name in needs_retry:
+            if not device_ok:
+                break
+            log(f"[{name}] retrying on recovered device")
+            res = run_child(name, args, False, _model_timeout(args))
+            if "error" not in res:
+                results[name + "__smoke_fallback"] = results[name]
+                results[name] = res
+            else:
+                # model-specific failure or tunnel loss? a probe tells them
+                # apart — only a dead tunnel should stop the other retries
+                device_ok = probe_device(probe_attempts, 120)
+            flush_partial()
 
     if args.trainer:
-        try:
-            extras["trainer_vs_rawstep"] = bench_trainer(args, results)
-        except Exception as e:
-            log(f"[trainer] FAILED: {type(e).__name__}: {e}")
-            extras["trainer_error"] = f"{type(e).__name__}: {e}"
+        # device only when the tunnel is known-good AND the flagship number
+        # it will be compared against (when benched at all) is a device number
+        flag = results.get("slowfast_r50")
+        flag_mode_smoke = (user_smoke or not device_ok
+                           or (flag is not None
+                               and flag.get("platform", "cpu") == "cpu"))
+        tr = run_child("__trainer__", args, flag_mode_smoke,
+                       _model_timeout(args))
+        if "trainer_cps_chip" in tr:
+            extras["trainer_cps_chip"] = round(tr["trainer_cps_chip"], 3)
+            if tr.get("mfu") is not None:
+                extras["trainer_mfu"] = round(tr["mfu"], 4)
+            raw = (results.get("slowfast_r50") or {}).get(
+                "clips_per_sec_per_chip")
+            # only a same-mode comparison is meaningful
+            if raw and (results["slowfast_r50"].get("smoke")
+                        == tr.get("smoke")):
+                extras["trainer_vs_rawstep"] = round(
+                    tr["trainer_cps_chip"] / raw, 3)
+        else:
+            extras["trainer_error"] = tr.get("error", "unknown")
+        flush_partial()
+
     if args.data:
-        try:
-            extras["data_pipeline"] = bench_data(args)
-        except Exception as e:
-            log(f"[data] FAILED: {type(e).__name__}: {e}")
-            extras["data_pipeline"] = {"error": f"{type(e).__name__}: {e}"}
-    if watchdog_timer is not None:
-        watchdog_timer.cancel()
-    emit_final()
+        # host-side benches run in the parent but bounded: a wedged decode
+        # or forked worker must not break the one-JSON-line contract (the
+        # final os._exit below reaps any stuck daemon thread)
+        from concurrent.futures import ThreadPoolExecutor
+        from concurrent.futures import TimeoutError as FutTimeout
+
+        pool = ThreadPoolExecutor(max_workers=1)
+        for key, fn in (("data_pipeline", bench_data),
+                        ("transport_crossover", bench_transport_crossover)):
+            try:
+                extras[key] = pool.submit(fn, args).result(timeout=900)
+            except FutTimeout:
+                log(f"[{key}] timed out after 900s")
+                extras[key] = {"error": "timeout after 900s"}
+                pool.shutdown(wait=False)
+                pool = ThreadPoolExecutor(max_workers=1)
+            except Exception as e:
+                log(f"[{key}] FAILED: {type(e).__name__}: {e}")
+                extras[key] = {"error": f"{type(e).__name__}: {e}"}
+        pool.shutdown(wait=False)
+        # how many host cores feed one chip at the measured device rate?
+        dp = extras.get("data_pipeline", {})
+        flag = results.get("slowfast_r50", {})
+        loader_cps = dp.get("loader_thread_clips_per_sec")
+        chip_cps = flag.get("clips_per_sec_per_chip")
+        if loader_cps and chip_cps and dp.get("num_workers"):
+            per_worker = loader_cps / dp["num_workers"]
+            dp["loader_clips_per_sec_per_worker"] = round(per_worker, 2)
+            dp["workers_to_feed_one_chip"] = round(chip_cps / per_worker, 1)
+            dp["chip_demand_clips_per_sec"] = chip_cps
+            dp["chip_demand_is_smoke"] = bool(flag.get("smoke"))
+        flush_partial()
+
+    print(json.dumps(finalize(results, extras, user_smoke)))
+    sys.stdout.flush()
+    sys.stderr.flush()
+    # hard exit: stuck host-bench threads or lingering forked loader workers
+    # must not keep the process alive after the JSON line is out
+    os._exit(0)
 
 
-def finalize(results: dict, extras: dict, args, tpu_unreachable: bool) -> dict:
+def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     """Assemble the single JSON line from per-model results + extras."""
     flag_name = "slowfast_r50"
     flag = results.get(flag_name, {})
@@ -445,18 +703,18 @@ def finalize(results: dict, extras: dict, args, tpu_unreachable: bool) -> dict:
     baseline = None
     try:
         published = json.load(
-            open(os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                              "BASELINE.json"))).get("published", {})
+            open(os.path.join(HERE, "BASELINE.json"))).get("published", {})
         baseline = published.get("clips_per_sec_per_chip")
     except Exception:
         pass
     value = flag.get("clips_per_sec_per_chip", 0.0)
     vs = value / baseline if baseline else 1.0
 
+    smoke_tag = ", smoke" if flag.get("smoke") else ""
     out = {
         "metric": f"train clips/sec/chip ({flag_name}, "
                   f"{flag.get('frames', '?')}f, {flag.get('crop', '?')}px, "
-                  "bf16" + (", smoke" if args.smoke else "") + ")",
+                  f"bf16{smoke_tag})",
         "value": value,
         "unit": "clips/sec/chip",
         "vs_baseline": round(vs, 3),
@@ -466,56 +724,28 @@ def finalize(results: dict, extras: dict, args, tpu_unreachable: bool) -> dict:
         "suspect": flag.get("suspect"),
         "models": results,
     }
-    tr = extras.get("trainer_vs_rawstep")
-    if tr is not None:
-        out["trainer_vs_rawstep"] = round(tr, 3)
-    if "trainer_error" in extras:
-        out["trainer_error"] = extras["trainer_error"]
-    if "data_pipeline" in extras:
-        out["data_pipeline"] = extras["data_pipeline"]
-    if "error" in extras:
-        out["error"] = extras["error"]
-    if tpu_unreachable:
+    for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
+                "trainer_error", "data_pipeline", "transport_crossover",
+                "probe_attempts", "error"):
+        if key in extras:
+            out[key] = extras[key]
+    # whole-round probe evidence: .probe_log.jsonl accumulates every probe
+    # made this round (manual + bench), not just this invocation's
+    try:
+        with open(os.path.join(HERE, ".probe_log.jsonl")) as f:
+            lines = f.read().strip().splitlines()
+        out["probe_log_tail"] = [json.loads(ln) for ln in lines[-20:]]
+    except (OSError, ValueError):
+        pass
+    # missing platform covers error-only and empty flagship results too:
+    # the driver must never read a silent zero as a real measurement
+    if flag.get("platform", "cpu") == "cpu" and not user_smoke:
         out["suspect"] = True
-        out["error"] = ("tpu backend init unreachable; CPU smoke fallback — "
+        out["error"] = ("no trustworthy device number for the flagship "
+                        "(unreachable tunnel or failed bench — see "
+                        "probe_attempts and models); CPU/smoke values are "
                         "not device numbers")
     return out
-
-
-def bench_trainer(args, results: dict) -> float | None:
-    """Trainer.fit() on synthetic data vs the raw-step number — proves the
-    hot loop doesn't sync away the pipelining (VERDICT r2 weak #4)."""
-    import jax
-
-    from pytorchvideo_accelerate_tpu.config import (
-        DataConfig, ModelConfig, OptimConfig, TrainConfig,
-    )
-    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
-
-    frames, crop, bsz = (8, 64, 2) if args.smoke else (32, 256, 8)
-    n_videos = bsz * len(jax.devices()) * (4 if args.smoke else 16)
-    cfg = TrainConfig(
-        model=ModelConfig(name="slowfast_r50", num_classes=700),
-        data=DataConfig(synthetic=True, synthetic_num_videos=n_videos,
-                        num_frames=frames, crop_size=crop, batch_size=bsz,
-                        num_workers=2, limit_val_batches=1),
-        optim=OptimConfig(num_epochs=2),  # epoch 1 excludes compile
-        mixed_precision="bf16",
-    )
-    tr = Trainer(cfg)
-    res = tr.fit()
-    # steady-state: train-section wall time of the post-compile epoch only
-    # (excludes compile, eval, checkpointing — the quantity the raw-step
-    # number measures)
-    steps_per_epoch = res["steps"] // cfg.optim.num_epochs
-    dt = res["epoch_train_times"][-1]
-    clips = steps_per_epoch * bsz * len(jax.devices())
-    fit_cps_chip = clips / dt / len(jax.devices())
-    raw = (results.get("slowfast_r50") or {}).get("clips_per_sec_per_chip")
-    log(f"[trainer] fit() steady-state epoch: {steps_per_epoch} steps in "
-        f"{dt:.2f}s = {fit_cps_chip:.2f} clips/s/chip (incl. data pipeline)"
-        + (f" = {fit_cps_chip / raw:.0%} of raw step" if raw else ""))
-    return fit_cps_chip / raw if raw else None
 
 
 if __name__ == "__main__":

@@ -52,7 +52,10 @@ class DataConfig:
     sampling_rate: int = 8
     frames_per_second: int = 30
     batch_size: int = 8  # per data-parallel shard, matching per-rank semantics
-    transport: str = "thread"  # thread | process (native shm decode workers)
+    # auto | thread | process (native shm decode workers). auto = threads
+    # unless the host has >=16 cores and >=4 workers: cv2/numpy release the
+    # GIL, so threads win on small hosts (measured, bench transport_crossover)
+    transport: str = "auto"
     num_workers: int = 8
     crop_size: int = 256
     min_short_side_scale: int = 256

@@ -26,6 +26,7 @@ independent deterministic sample.
 
 from __future__ import annotations
 
+import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -214,8 +215,9 @@ class ClipLoader:
                 f"global_batch_size {global_batch_size} not divisible by "
                 f"process_count {process_count}"
             )
-        if transport not in ("thread", "process"):
-            raise ValueError(f"transport must be thread|process, got {transport!r}")
+        if transport not in ("auto", "thread", "process"):
+            raise ValueError(
+                f"transport must be auto|thread|process, got {transport!r}")
         self.source = source
         self.global_batch_size = global_batch_size
         self.local_batch_size = global_batch_size // process_count
@@ -230,10 +232,24 @@ class ClipLoader:
         self.state = LoaderState()
         self._pool = ThreadPoolExecutor(max_workers=self.num_workers)
         # "process": forked decode workers + native shm ring (SURVEY N8);
-        # falls back to threads when the native lib can't build
+        # falls back to threads when the native lib can't build.
+        # "auto" picks threads unless the host has enough cores for forked
+        # workers to beat them: cv2 decode and numpy transforms release the
+        # GIL, so on few-core hosts threads win outright (measured — bench.py
+        # transport_crossover; r3 saw threads 7x ahead on 1 core), while the
+        # fork + shm-ring overhead only pays off when many workers of
+        # Python-heavy work would serialize on the GIL.
         self.transport = transport
         self._shm_pool = None
-        if transport == "process":
+        if transport == "auto":
+            try:  # cores actually available (cgroup quota / affinity aware)
+                n_cores = len(os.sched_getaffinity(0))
+            except (AttributeError, OSError):
+                n_cores = os.cpu_count() or 1
+            self.transport = ("process"
+                              if n_cores >= 16 and self.num_workers >= 4
+                              else "thread")
+        if self.transport == "process":
             import pytorchvideo_accelerate_tpu.native as native
 
             if native.load() is None:
@@ -297,12 +313,19 @@ class ClipLoader:
             }
         return batch
 
-    def epoch(self, epoch: Optional[int] = None) -> Iterator[dict]:
+    def epoch(self, epoch: Optional[int] = None,
+              from_start: bool = False) -> Iterator[dict]:
         """Iterate one epoch, honoring and updating `self.state` (resume
-        mid-epoch by restoring state before calling)."""
-        if epoch is not None:
-            if epoch != self.state.epoch:
-                self.state = LoaderState(epoch=epoch, position=0)
+        mid-epoch by restoring state before calling).
+
+        `from_start=True` ignores any stored mid-epoch position — the eval
+        contract: a previous early-broken pass (limit_val_batches) must not
+        make the next pass silently skip its head batches."""
+        if from_start:
+            self.state = LoaderState(
+                epoch=self.state.epoch if epoch is None else epoch, position=0)
+        elif epoch is not None and epoch != self.state.epoch:
+            self.state = LoaderState(epoch=epoch, position=0)
         epoch = self.state.epoch
         indices = self._epoch_indices(epoch)
         spy = self.samples_per_yield

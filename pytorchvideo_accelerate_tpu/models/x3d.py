@@ -127,13 +127,18 @@ class X3D(nn.Module):
                     name=f"res{stage_idx + 2}_block{i}",
                 )(x, train)
 
-        # conv5 + head (pytorchvideo create_x3d_head shape)
+        # conv5 + head (pytorchvideo create_x3d_head / ProjectedPool order:
+        # pre_conv -> BN -> relu -> GLOBAL POOL -> post_conv -> relu — the
+        # 2048-d projection runs on pooled features, per the X3D paper; the
+        # ReLU between makes the order numerically load-bearing for
+        # converted weights, and pooling first is also cheaper)
         f5 = int(round(self.stage_features[-1] * self.expansion))
         x = ConvBNAct(f5, kernel=(1, 1, 1), dtype=self.dtype, name="conv5")(x, train)
+        x = jnp.mean(x, axis=(1, 2, 3), keepdims=True)  # (B,1,1,1,C)
         x = nn.Conv(self.head_features, (1, 1, 1), use_bias=False,
                     dtype=self.dtype, name="head_conv")(x)
         x = nn.relu(x)
-        x = jnp.mean(x, axis=(1, 2, 3))
+        x = x.reshape(x.shape[0], -1)
         x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="proj")(
             x.astype(jnp.float32)

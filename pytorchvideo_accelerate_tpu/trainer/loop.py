@@ -426,7 +426,10 @@ class Trainer:
         (shared by fit()'s per-epoch eval and evaluate());
         returns (top1, top5, mean_loss)."""
         val = SumMetrics()
-        for step_in_epoch, batch in enumerate(self.val_loader.epoch(epoch)):
+        # from_start: eval is stateless — a prior early-broken pass (e.g.
+        # limit_val_batches) must not make this one resume mid-epoch
+        for step_in_epoch, batch in enumerate(
+                self.val_loader.epoch(epoch, from_start=True)):
             val.update(self.eval_step(self.state,
                                       shard_batch(self.mesh, batch)))
             if 0 <= self.cfg.data.limit_val_batches <= step_in_epoch + 1:

@@ -2,7 +2,6 @@
 {"metric", "value", "unit", "vs_baseline", ...} — lock the assembly logic
 (finalize) without paying for a compile."""
 
-import argparse
 import importlib.util
 import os
 import sys
@@ -20,63 +19,79 @@ def _load_bench():
 
 
 bench = _load_bench()
-ARGS = argparse.Namespace(smoke=False)
 
 
 def _model(name="slowfast_r50", **over):
     d = dict(clips_per_sec_per_chip=100.0, step_ms_blocked=10.0,
              step_ms_pipelined=9.0, frames=32, crop=256, suspect=False,
-             tflops_per_sec_per_chip=50.0, mfu=0.25)
+             tflops_per_sec_per_chip=50.0, mfu=0.25, platform="tpu",
+             smoke=False)
     d.update(over)
     return {name: d}
 
 
 def test_finalize_headline_fields():
-    out = bench.finalize(_model(), {}, ARGS, tpu_unreachable=False)
+    out = bench.finalize(_model(), {}, user_smoke=False)
     for key in ("metric", "value", "unit", "vs_baseline", "models"):
         assert key in out, key
     assert out["value"] == 100.0
     assert out["unit"] == "clips/sec/chip"
     assert out["mfu"] == 0.25
     assert "slowfast_r50" in out["metric"]
+    assert "error" not in out  # real device number: nothing to flag
 
 
 def test_finalize_flagship_fallback_on_error():
     models = {"slowfast_r50": {"error": "Timeout"}}
     models.update(_model("x3d_s", clips_per_sec_per_chip=42.0))
-    out = bench.finalize(models, {}, ARGS, tpu_unreachable=False)
+    out = bench.finalize(models, {}, user_smoke=False)
     assert out["value"] == 42.0
     assert "x3d_s" in out["metric"]
     assert out["models"]["slowfast_r50"]["error"] == "Timeout"
 
 
-def test_finalize_all_failed_still_valid():
+def test_finalize_all_failed_is_flagged_not_silent():
     models = {"slowfast_r50": {"error": "boom"}}
-    out = bench.finalize(models, {}, ARGS, tpu_unreachable=False)
+    out = bench.finalize(models, {}, user_smoke=False)
     assert out["value"] == 0.0  # parseable, honest zero
     assert "none" in out["metric"]
-
-
-def test_finalize_unreachable_marks_suspect_and_error():
-    out = bench.finalize(_model(), {"data_pipeline": {"decode_clips_per_sec": 5}},
-                         ARGS, tpu_unreachable=True)
+    # an error-only flagship must not read as a real measurement
     assert out["suspect"] is True
-    assert "unreachable" in out["error"]
+    assert "device number" in out["error"]
+
+
+def test_finalize_cpu_fallback_marks_suspect_and_error():
+    models = _model(platform="cpu", smoke=True)
+    out = bench.finalize(
+        models, {"data_pipeline": {"decode_clips_per_sec": 5}},
+        user_smoke=False)
+    assert out["suspect"] is True
+    assert "device number" in out["error"]
     assert out["data_pipeline"]["decode_clips_per_sec"] == 5
+
+
+def test_finalize_user_smoke_is_not_an_error():
+    out = bench.finalize(_model(platform="cpu", smoke=True), {},
+                         user_smoke=True)
+    assert "error" not in out
+    assert "smoke" in out["metric"]
 
 
 def test_finalize_extras_passthrough():
     out = bench.finalize(
-        _model(), {"trainer_vs_rawstep": 0.934, "error": "watchdog: 10s"},
-        ARGS, tpu_unreachable=False)
+        _model(),
+        {"trainer_vs_rawstep": 0.934, "error": "watchdog: 10s",
+         "probe_attempts": [{"ts": "t", "ok": True}]},
+        user_smoke=False)
     assert out["trainer_vs_rawstep"] == 0.934
     assert out["error"].startswith("watchdog")
+    assert out["probe_attempts"][0]["ok"] is True
 
 
 def test_finalize_json_serializable():
     import json
 
-    out = bench.finalize(_model(), {}, ARGS, tpu_unreachable=False)
+    out = bench.finalize(_model(), {}, user_smoke=False)
     line = json.dumps(out)
     assert "\n" not in line
     assert json.loads(line)["value"] == 100.0

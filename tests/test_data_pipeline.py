@@ -193,3 +193,19 @@ def test_loader_mid_epoch_resume():
     # epoch rolls over after exhaustion
     assert loader2.state.epoch == 1 and loader2.state.position == 0
     loader.close(); loader2.close()
+
+def test_loader_eval_from_start_after_early_break():
+    """Eval contract (VERDICT r3 weak #6): an early-broken pass (e.g.
+    limit_val_batches) leaves a mid-epoch position; the next eval pass over
+    the SAME epoch number must start from batch 0, not silently resume."""
+    loader = _loader(n_videos=32, bs=8)
+    it = loader.epoch(0)
+    next(it)  # early break after one of four batches
+    del it
+    assert loader.state.position == 1
+    full = list(loader.epoch(0, from_start=True))
+    assert len(full) == 4  # all batches, not the remaining 3
+    # and the non-from_start call keeps its resume semantics
+    loader.state = LoaderState(epoch=0, position=1)
+    assert len(list(loader.epoch(0))) == 3
+    loader.close()
