@@ -82,7 +82,7 @@ class ModelConfig:
     """Model selection + finetuning controls (reference `run.py:105-118`)."""
 
     name: str = "slow_r50"  # models.available_models(): slow_r50|slowfast_r50|
-    # slowfast_r101|x3d_xs|x3d_s|x3d_m|mvit_b|videomae_b|videomae_b_pretrain
+    # slowfast_r101|x3d_xs|x3d_s|x3d_m|x3d_l|mvit_b|videomae_b|videomae_b_pretrain
     num_classes: int = 0  # 0 = infer from dataset labels (replaces run.py:185)
     pretrained: bool = False
     pretrained_path: str = ""  # converted torch-hub weights (models/convert.py)
@@ -93,6 +93,11 @@ class ModelConfig:
     attention: str = "dense"  # dense (XLA-fused) | pallas (ops/pallas_attention)
     # | ring | ulysses (context-parallel, parallel/ring_attention.py + ulysses.py)
     mask_ratio: float = 0.9  # VideoMAE pretrain tube-mask ratio
+    # depthwise-conv lowering for X3D / MViT pooling (ops/depthwise.py):
+    # "conv" = XLA grouped convolution; "shift" = tap decomposition into
+    # fused VPU multiply-adds. Same param tree either way; A/B on device
+    # with scripts/perf_sweep.py
+    depthwise_impl: str = "conv"
     # per-block jax.checkpoint (rematerialization): only block-boundary
     # activations (plus one block's interior at a time) stay resident,
     # trading one extra forward of recompute for the activation HBM that

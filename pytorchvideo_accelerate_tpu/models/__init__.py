@@ -77,20 +77,20 @@ def _slowfast_r101(cfg: ModelConfig, dtype, mesh=None):
 @register_model("x3d_xs")
 def _x3d_xs(cfg: ModelConfig, dtype, mesh=None):
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-               dtype=dtype)
+               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
 
 
 @register_model("x3d_s")
 def _x3d_s(cfg: ModelConfig, dtype, mesh=None):
     # XS and S share the trunk; they differ in sampling (13f@160px for S)
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-               dtype=dtype)
+               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
 
 
 @register_model("x3d_m")
 def _x3d_m(cfg: ModelConfig, dtype, mesh=None):
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-               dtype=dtype)
+               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
 
 
 @register_model("x3d_l")
@@ -98,7 +98,8 @@ def _x3d_l(cfg: ModelConfig, dtype, mesh=None):
     # depth-factor 5.0 trunk (pytorchvideo create_x3d stage depths
     # (1,2,5,3) x 5.0 -> (5,10,25,15)); sampled 16f@312px in the paper
     return X3D(num_classes=cfg.num_classes, depths=(5, 10, 25, 15),
-               dropout_rate=cfg.dropout_rate, dtype=dtype)
+               dropout_rate=cfg.dropout_rate,
+               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
 
 
 @register_model("mvit_b")
@@ -112,6 +113,7 @@ def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
         dropout_rate=cfg.dropout_rate,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        depthwise_impl=cfg.depthwise_impl,
         remat=cfg.remat,
         dtype=dtype,
     )

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
+from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
 
 Dtype = Any
 
@@ -57,6 +58,7 @@ class PoolHeads(nn.Module):
     channels: int
     stride: Tuple[int, int, int]
     head_dim: int = 0  # 0 = single group (heads*head_dim normed jointly)
+    depthwise_impl: str = "conv"
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -66,13 +68,11 @@ class PoolHeads(nn.Module):
         # fixed 3x3x3 pooling kernel at any stride — pytorchvideo's
         # `pool_kvq_kernel` constant; also keeps the depthwise conv cheap and
         # makes pretrained pool weights layout-convertible (models/convert.py)
-        x = nn.Conv(
+        x = DepthwiseConv3D(
             self.channels,
             kernel_size=(3, 3, 3),
-            strides=self.stride,
-            padding=[(1, 1)] * 3,
-            feature_group_count=self.channels,
-            use_bias=False,
+            stride=self.stride,
+            impl=self.depthwise_impl,
             dtype=self.dtype,
             name="pool",
         )(x)
@@ -93,6 +93,7 @@ class MultiScaleAttention(nn.Module):
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
     context_mesh: Optional[Any] = None
+    depthwise_impl: str = "conv"
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -102,12 +103,12 @@ class MultiScaleAttention(nn.Module):
         q, k, v = jnp.split(qkv, 3, axis=-1)
 
         head_dim = self.dim_out // self.num_heads
-        q = PoolHeads(self.dim_out, self.q_stride, head_dim, self.dtype,
-                      name="pool_q")(q)
-        k = PoolHeads(self.dim_out, self.kv_stride, head_dim, self.dtype,
-                      name="pool_k")(k)
-        v = PoolHeads(self.dim_out, self.kv_stride, head_dim, self.dtype,
-                      name="pool_v")(v)
+        q = PoolHeads(self.dim_out, self.q_stride, head_dim,
+                      self.depthwise_impl, self.dtype, name="pool_q")(q)
+        k = PoolHeads(self.dim_out, self.kv_stride, head_dim,
+                      self.depthwise_impl, self.dtype, name="pool_k")(k)
+        v = PoolHeads(self.dim_out, self.kv_stride, head_dim,
+                      self.depthwise_impl, self.dtype, name="pool_v")(v)
 
         tq, hq, wq = q.shape[1:4]
 
@@ -142,6 +143,7 @@ class MViTBlock(nn.Module):
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
     context_mesh: Optional[Any] = None
+    depthwise_impl: str = "conv"
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -154,6 +156,7 @@ class MViTBlock(nn.Module):
             q_stride=self.q_stride, kv_stride=self.kv_stride,
             attention_backend=self.attention_backend,
             context_axis=self.context_axis, context_mesh=self.context_mesh,
+            depthwise_impl=self.depthwise_impl,
             dtype=self.dtype, name="attn",
         )(y)
         # skip path: pool to the attention's q-pooled grid. pytorchvideo's
@@ -201,6 +204,7 @@ class MViT(nn.Module):
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
     context_mesh: Optional[Any] = None
+    depthwise_impl: str = "conv"  # conv | shift (ops/depthwise.py)
     remat: bool = False  # per-block jax.checkpoint: boundary activations only
     dtype: Any = jnp.float32
 
@@ -247,6 +251,7 @@ class MViT(nn.Module):
                 kv_stride=tuple(kv_stride), mlp_ratio=self.mlp_ratio,
                 drop_path=dpr[i], attention_backend=self.attention_backend,
                 context_axis=self.context_axis, context_mesh=self.context_mesh,
+                depthwise_impl=self.depthwise_impl,
                 dtype=self.dtype, name=f"block{i}",
             )(x, train)
             dim = dim_out

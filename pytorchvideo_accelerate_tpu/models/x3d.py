@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.models.common import ConvBNAct, Dtype
+from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
 
 
 def _round_width(width: int, multiplier: float, min_depth: int = 8, divisor: int = 8) -> int:
@@ -62,6 +63,7 @@ class X3DBlock(nn.Module):
     features_inner: int
     spatial_stride: int = 1
     use_se: bool = False
+    depthwise_impl: str = "conv"
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -69,12 +71,11 @@ class X3DBlock(nn.Module):
         residual = x
         y = ConvBNAct(self.features_inner, kernel=(1, 1, 1),
                       dtype=self.dtype, name="conv_a")(x, train)
-        # depthwise spatiotemporal conv
-        y = nn.Conv(self.features_inner, kernel_size=(3, 3, 3),
-                    strides=(1, self.spatial_stride, self.spatial_stride),
-                    padding=[(1, 1)] * 3,
-                    feature_group_count=self.features_inner,
-                    use_bias=False, dtype=self.dtype, name="conv_b")(y)
+        # depthwise spatiotemporal conv (selectable lowering, ops/depthwise)
+        y = DepthwiseConv3D(self.features_inner, kernel_size=(3, 3, 3),
+                            stride=(1, self.spatial_stride, self.spatial_stride),
+                            impl=self.depthwise_impl, dtype=self.dtype,
+                            name="conv_b")(y)
         y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype, name="norm_b")(y)
         if self.use_se:
@@ -97,6 +98,7 @@ class X3D(nn.Module):
     expansion: float = 2.25
     head_features: int = 2048
     dropout_rate: float = 0.5
+    depthwise_impl: str = "conv"  # conv | shift (ops/depthwise.py)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -106,10 +108,9 @@ class X3D(nn.Module):
         x = nn.Conv(self.stem_features, (1, 3, 3), strides=(1, 2, 2),
                     padding=[(0, 0), (1, 1), (1, 1)], use_bias=False,
                     dtype=self.dtype, name="stem_xy")(x)
-        x = nn.Conv(self.stem_features, (5, 1, 1), strides=(1, 1, 1),
-                    padding=[(2, 2), (0, 0), (0, 0)],
-                    feature_group_count=self.stem_features, use_bias=False,
-                    dtype=self.dtype, name="stem_t")(x)
+        x = DepthwiseConv3D(self.stem_features, (5, 1, 1),
+                            impl=self.depthwise_impl, dtype=self.dtype,
+                            name="stem_t")(x)
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype, name="stem_norm")(x)
         x = nn.relu(x)
@@ -123,6 +124,7 @@ class X3D(nn.Module):
                     features_inner=f_inner,
                     spatial_stride=2 if i == 0 else 1,
                     use_se=(i % 2 == 0),  # SE every other block (paper §3)
+                    depthwise_impl=self.depthwise_impl,
                     dtype=self.dtype,
                     name=f"res{stage_idx + 2}_block{i}",
                 )(x, train)

@@ -1,0 +1,241 @@
+#!/usr/bin/env python
+"""Profile-driven conv/attention perf sweep (SURVEY §7 hard-part 2).
+
+A/Bs deployment knobs that can't be decided without device timing:
+depthwise-conv lowering (XLA grouped conv vs shift tap-decomposition,
+ops/depthwise.py), rematerialization, and per-chip batch size — each
+variant timed as a compiled train step in a disposable child subprocess
+(same wedge-isolation as bench.py: a stuck compile loses one variant, not
+the sweep). Writes SWEEP.json and prints one JSON line per variant.
+
+Run on the TPU host:    python scripts/perf_sweep.py
+Harness check (CPU):    python scripts/perf_sweep.py --smoke
+"""
+
+import argparse
+import json
+import os
+import signal
+import statistics
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+
+def log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+# (model, overrides, workload) — workload mirrors bench.py's BASELINE shapes
+VARIANTS = [
+    ("x3d_s", {"depthwise_impl": "conv"}, dict(frames=13, crop=160, batch=8)),
+    ("x3d_s", {"depthwise_impl": "shift"}, dict(frames=13, crop=160, batch=8)),
+    ("x3d_s", {"depthwise_impl": "conv"}, dict(frames=13, crop=160, batch=16)),
+    ("x3d_s", {"depthwise_impl": "shift"}, dict(frames=13, crop=160, batch=16)),
+    ("mvit_b", {"depthwise_impl": "conv"}, dict(frames=16, crop=224, batch=8)),
+    ("mvit_b", {"depthwise_impl": "shift"}, dict(frames=16, crop=224, batch=8)),
+    ("mvit_b", {"remat": True}, dict(frames=16, crop=224, batch=8)),
+    ("mvit_b", {"remat": True}, dict(frames=16, crop=224, batch=16)),
+    ("slowfast_r50", {}, dict(frames=32, crop=256, batch=4)),
+    ("slowfast_r50", {}, dict(frames=32, crop=256, batch=8)),
+    ("slowfast_r50", {}, dict(frames=32, crop=256, batch=16)),
+]
+
+
+def time_variant(model_name: str, overrides: dict, wl: dict, smoke: bool,
+                 steps: int, warmup: int) -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.config import ModelConfig, OptimConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.config import MeshConfig
+    from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+    from pytorchvideo_accelerate_tpu.trainer import (
+        TrainState, build_optimizer, make_train_step,
+    )
+    from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
+
+    frames, crop, bsz = wl["frames"], wl["crop"], wl["batch"]
+    if smoke:
+        frames, crop, bsz = max(frames // 4, 4), 64, 2
+    cfg = ModelConfig(name=model_name, num_classes=700, **overrides)
+    model = create_model(cfg, "bf16")
+    devices = jax.devices()
+    mesh = make_mesh(MeshConfig(), devices=devices)
+    B = bsz * len(devices)
+
+    def make_batch(seed):
+        rr = np.random.default_rng(seed)
+        if model_name.startswith("slowfast"):
+            b = {"slow": rr.standard_normal((B, frames // 4, crop, crop, 3),
+                                            dtype=np.float32),
+                 "fast": rr.standard_normal((B, frames, crop, crop, 3),
+                                            dtype=np.float32)}
+        else:
+            b = {"video": rr.standard_normal((B, frames, crop, crop, 3),
+                                             dtype=np.float32)}
+        b["label"] = rr.integers(0, 700, B).astype(np.int32)
+        return b
+
+    batch = make_batch(0)
+    sample = ((jnp.zeros((1, *batch["slow"].shape[1:])),
+               jnp.zeros((1, *batch["fast"].shape[1:])))
+              if model_name.startswith("slowfast")
+              else jnp.zeros((1, *batch["video"].shape[1:])))
+    variables = model.init(jax.random.key(0), sample)
+    tx = build_optimizer(OptimConfig(), total_steps=steps + warmup)
+    state = TrainState.create(variables["params"],
+                              variables.get("batch_stats", {}), tx)
+    step = make_train_step(model, tx, mesh)
+    gbs = [shard_batch(mesh, batch), shard_batch(mesh, make_batch(1))]
+
+    t0 = time.perf_counter()
+    compiled = step.lower(state, gbs[0], jax.random.key(0)).compile()
+    compile_s = time.perf_counter() - t0
+    flops = None
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        flops = float(ca.get("flops", 0.0)) or None
+    except Exception:
+        pass
+    for i in range(max(warmup, 1)):
+        state, metrics = compiled(state, gbs[i % 2], jax.random.key(i))
+    jax.block_until_ready(metrics["loss"])
+    blocked = []
+    for i in range(steps):
+        t0 = time.perf_counter()
+        state, metrics = compiled(state, gbs[i % 2], jax.random.key(9 + i))
+        jax.block_until_ready(metrics["loss"])
+        blocked.append(time.perf_counter() - t0)
+    ms = statistics.median(blocked) * 1e3
+    out = {
+        "model": model_name, "overrides": overrides,
+        "batch_per_chip": bsz, "frames": frames, "crop": crop,
+        "step_ms": round(ms, 2),
+        "clips_per_sec_per_chip": round(B / (ms / 1e3) / len(devices), 2),
+        "compile_s": round(compile_s, 1),
+        "platform": devices[0].platform,
+        "smoke": smoke,
+    }
+    if flops:
+        tf = flops / (ms / 1e3) / 1e12 / len(devices)
+        out["tflops_per_sec_per_chip"] = round(tf, 2)
+        peak = peak_tflops(devices[0])
+        if peak:
+            out["mfu"] = round(tf / peak, 4)
+    return out
+
+
+def child_main(args):
+    import jax
+
+    if args.smoke:
+        jax.config.update("jax_platforms", "cpu")
+    try:
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(ROOT, ".jax_cache"))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass
+    spec = json.loads(args.child)
+    res = time_variant(spec["model"], spec["overrides"], spec["workload"],
+                       args.smoke, args.steps, args.warmup)
+    print("\n" + json.dumps(res))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--smoke", action="store_true")
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--warmup", type=int, default=2)
+    ap.add_argument("--timeout", type=int, default=900)
+    ap.add_argument("--models", default="",
+                    help="comma filter on model names (default: all variants)")
+    ap.add_argument("--child", default="", help=argparse.SUPPRESS)
+    args = ap.parse_args()
+    if args.child:
+        child_main(args)
+        return
+
+    import jax  # parent stays off the device (bench.py wedge discipline)
+
+    jax.config.update("jax_platforms", "cpu")
+
+    if not args.smoke:
+        code = ("import jax; d = jax.devices()[0]; "
+                "assert d.platform != 'cpu', d.platform")
+        try:
+            subprocess.run([sys.executable, "-c", code], timeout=240,
+                           check=True, capture_output=True)
+        except Exception as e:
+            log(f"device unreachable ({type(e).__name__}); rerun with --smoke "
+                "for a harness check — sweep needs real timing to mean anything")
+            sys.exit(3)
+
+    variants = VARIANTS
+    if args.models:
+        keep = set(args.models.split(","))
+        variants = [v for v in VARIANTS if v[0] in keep]
+    if args.smoke:
+        # smoke collapses workloads to tiny shared shapes, so variants that
+        # differ only in workload become byte-identical — dedup on
+        # (model, overrides) instead of slicing by position
+        seen, dedup = set(), []
+        for m, o, w in variants:
+            key = (m, tuple(sorted(o.items())))
+            if key not in seen:
+                seen.add(key)
+                dedup.append((m, o, w))
+        variants = dedup
+
+    results = []
+    for model_name, overrides, wl in variants:
+        spec = json.dumps({"model": model_name, "overrides": overrides,
+                           "workload": wl})
+        cmd = [sys.executable, os.path.abspath(__file__), "--child", spec,
+               "--steps", str(args.steps), "--warmup", str(args.warmup)]
+        if args.smoke:
+            cmd.append("--smoke")
+        label = f"{model_name} {overrides} b{wl['batch']}"
+        p = subprocess.Popen(cmd, stdout=subprocess.PIPE, stderr=sys.stderr,
+                             text=True, start_new_session=True)
+        res = None
+        try:
+            out, _ = p.communicate(timeout=args.timeout)
+            for line in reversed((out or "").strip().splitlines()):
+                try:
+                    res = json.loads(line)
+                    break
+                except ValueError:
+                    continue
+            res = res or {"model": model_name, "overrides": overrides,
+                          "error": f"child exited {p.returncode}"}
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            p.wait()
+            res = {"model": model_name, "overrides": overrides,
+                   "error": f"timeout {args.timeout}s"}
+            log(f"[{label}] TIMEOUT")
+        # every path prints and flushes: a wedged last variant must still
+        # leave its record in SWEEP.json (the bench.py partial-results rule)
+        results.append(res)
+        print(json.dumps(res), flush=True)
+        with open(os.path.join(ROOT, "SWEEP.json"), "w") as f:
+            json.dump(results, f, indent=1)
+    log(f"sweep done: {len(results)} variants -> SWEEP.json")
+
+
+if __name__ == "__main__":
+    main()
