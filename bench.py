@@ -92,16 +92,11 @@ def _setup_jax(smoke: bool):
     return jax
 
 
-def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
+def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from pytorchvideo_accelerate_tpu.config import ModelConfig, OptimConfig
-    from pytorchvideo_accelerate_tpu.models import create_model
-    from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
-    from pytorchvideo_accelerate_tpu.trainer import (
-        TrainState, build_optimizer, make_pretrain_step, make_train_step,
+    from pytorchvideo_accelerate_tpu.utils.bench_setup import (
+        build_step_setup, xla_flops,
     )
 
     frames, crop, bsz = wl["num_frames"], wl["crop"], wl["batch_size"]
@@ -110,62 +105,23 @@ def bench_model(name: str, wl: dict, args, mesh, n_chips: int) -> dict:
         if name == "videomae_b_pretrain":
             crop = 64  # tubelet 16 divides
     num_classes = 700  # Kinetics-700 (BASELINE.json metric)
-    model_cfg = ModelConfig(name=name, num_classes=num_classes,
-                            slowfast_alpha=args.alpha)
-    model = create_model(model_cfg, "bf16")
-
-    B = bsz * n_chips  # global batch: bench batch is per chip
-
-    def make_batch(seed):
-        r = np.random.default_rng(seed)
-        if name.startswith("slowfast"):
-            b = {
-                "slow": r.standard_normal(
-                    (B, frames // args.alpha, crop, crop, 3), dtype=np.float32),
-                "fast": r.standard_normal(
-                    (B, frames, crop, crop, 3), dtype=np.float32),
-            }
-        else:
-            b = {"video": r.standard_normal(
-                (B, frames, crop, crop, 3), dtype=np.float32)}
-        if not wl["pretrain"]:
-            b["label"] = r.integers(0, num_classes, B).astype(np.int32)
-        return b
-
-    batch = make_batch(0)
-    if name.startswith("slowfast"):
-        sample = (jnp.zeros((1, *batch["slow"].shape[1:])),
-                  jnp.zeros((1, *batch["fast"].shape[1:])))
-    else:
-        sample = jnp.zeros((1, *batch["video"].shape[1:]))
+    setup = build_step_setup(
+        name, frames=frames, crop=crop, batch_per_chip=bsz,
+        num_classes=num_classes, alpha=args.alpha, pretrain=wl["pretrain"],
+        total_steps=args.steps + args.warmup,
+    )
+    B, state = setup.global_batch, setup.state
 
     log(f"[{name}] global batch {B} ({bsz}/chip), {frames} frames @ {crop}^2")
 
-    variables = model.init(jax.random.key(0), sample)
-    tx = build_optimizer(OptimConfig(), total_steps=args.steps + args.warmup)
-    state = TrainState.create(variables["params"],
-                              variables.get("batch_stats", {}), tx)
-    if wl["pretrain"]:
-        step = make_pretrain_step(model, tx, mesh)
-    else:
-        step = make_train_step(model, tx, mesh)
-
     # two distinct device batches, rotated through the timing loop
-    gbs = [shard_batch(mesh, batch), shard_batch(mesh, make_batch(1))]
+    gbs = [setup.device_batch(0), setup.device_batch(1)]
 
     # --- compile + XLA's own FLOPs estimate -------------------------------
     t0 = time.perf_counter()
-    lowered = step.lower(state, gbs[0], jax.random.key(0))
-    compiled = lowered.compile()
+    compiled = setup.step.lower(state, gbs[0], jax.random.key(0)).compile()
     compile_s = time.perf_counter() - t0
-    flops_per_step = None
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops_per_step = float(ca.get("flops", 0.0)) or None
-    except Exception as e:  # cost_analysis availability varies by backend
-        log(f"[{name}] cost_analysis unavailable: {e}")
+    flops_per_step = xla_flops(compiled)
     log(f"[{name}] compile: {compile_s:.1f}s, "
         f"flops/step: {flops_per_step and f'{flops_per_step / 1e12:.2f}T'}")
 
@@ -498,9 +454,6 @@ def child_main(args) -> None:
     if args.smoke:
         args.steps, args.warmup = min(args.steps, 3), 1
 
-    from pytorchvideo_accelerate_tpu.config import MeshConfig
-    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
-
     if args.child == "__trainer__":
         res = bench_trainer(args)
     else:
@@ -510,9 +463,7 @@ def child_main(args) -> None:
         log(f"devices: {n_chips} x {devices[0].device_kind} "
             f"({devices[0].platform}), bf16 peak "
             f"{f'{peak:.0f} TFLOP/s/chip' if peak else 'unknown'}")
-        mesh = make_mesh(MeshConfig(), devices=devices)
-        res = bench_model(args.child, WORKLOADS[args.child], args, mesh,
-                          n_chips)
+        res = bench_model(args.child, WORKLOADS[args.child], args, n_chips)
         res["n_chips"] = n_chips
     print("\n" + json.dumps(res))
     sys.stdout.flush()

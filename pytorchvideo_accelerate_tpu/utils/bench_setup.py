@@ -1,0 +1,127 @@
+"""Shared train-step scaffolding for the measurement tools.
+
+bench.py (throughput), scripts/perf_sweep.py (variant A/B), and
+utils/memfit.py (compile-time batch fitting) all need the same setup:
+model from the registry, synthetic host batch of the right family shape
+(slowfast dual-pathway vs single clip; label unless pretraining; optional
+micro-batch axis), init, optimizer state, compiled step. One builder keeps
+the three tools measuring the same thing — family/batch-layout changes
+land here once.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Optional
+
+
+def is_pretrain_model(model_name: str) -> bool:
+    return model_name.endswith("_pretrain")
+
+
+@dataclass
+class StepSetup:
+    model: Any
+    mesh: Any
+    state: Any
+    step: Callable  # jitted (state, batch, rng) -> (state, metrics)
+    n_chips: int
+    global_batch: int
+    host_batch: Callable[[int], dict]  # seed -> host numpy batch
+    device_batch: Callable[[int], Any]  # seed -> mesh-sharded batch
+    pretrain: bool
+
+
+def build_step_setup(
+    model_name: str,
+    *,
+    frames: int,
+    crop: int,
+    batch_per_chip: int,
+    num_classes: int = 700,
+    alpha: int = 4,
+    accum: int = 1,
+    pretrain: Optional[bool] = None,  # None = infer from the name
+    overrides: Optional[dict] = None,
+    devices=None,
+    total_steps: int = 30,
+) -> StepSetup:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.config import (
+        MeshConfig, ModelConfig, OptimConfig,
+    )
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+    from pytorchvideo_accelerate_tpu.trainer import (
+        TrainState, build_optimizer, make_pretrain_step, make_train_step,
+    )
+
+    if pretrain is None:
+        pretrain = is_pretrain_model(model_name)
+    cfg = ModelConfig(name=model_name, num_classes=num_classes,
+                      slowfast_alpha=alpha, **(overrides or {}))
+    model = create_model(cfg, "bf16")
+    if devices is None:
+        devices = jax.devices()
+    n_chips = len(devices)
+    mesh = make_mesh(MeshConfig(), devices=devices)
+    B = batch_per_chip * n_chips
+
+    def host_batch(seed: int) -> dict:
+        r = np.random.default_rng(seed)
+        if model_name.startswith("slowfast"):
+            b = {
+                "slow": r.standard_normal(
+                    (B, frames // alpha, crop, crop, 3), dtype=np.float32),
+                "fast": r.standard_normal(
+                    (B, frames, crop, crop, 3), dtype=np.float32),
+            }
+        else:
+            b = {"video": r.standard_normal(
+                (B, frames, crop, crop, 3), dtype=np.float32)}
+        if not pretrain:
+            b["label"] = r.integers(0, num_classes, B).astype(np.int32)
+        if accum > 1:
+            b = {k: v.reshape(accum, B // accum, *v.shape[1:])
+                 for k, v in b.items()}
+        return b
+
+    def device_batch(seed: int):
+        return shard_batch(mesh, host_batch(seed), micro_dim=accum > 1)
+
+    probe = host_batch(0)
+    micro = probe["slow" if model_name.startswith("slowfast") else "video"]
+    clip_shape = micro.shape[2:] if accum > 1 else micro.shape[1:]
+    if model_name.startswith("slowfast"):
+        fast = probe["fast"]
+        fast_shape = fast.shape[2:] if accum > 1 else fast.shape[1:]
+        sample = (jnp.zeros((1, *clip_shape)), jnp.zeros((1, *fast_shape)))
+    else:
+        sample = jnp.zeros((1, *clip_shape))
+    variables = model.init(jax.random.key(0), sample)
+    tx = build_optimizer(OptimConfig(), total_steps=total_steps)
+    state = TrainState.create(variables["params"],
+                              variables.get("batch_stats", {}), tx)
+    if pretrain:
+        step = make_pretrain_step(model, tx, mesh)
+    else:
+        step = make_train_step(model, tx, mesh, accum_steps=accum)
+    return StepSetup(model=model, mesh=mesh, state=state, step=step,
+                     n_chips=n_chips, global_batch=B, host_batch=host_batch,
+                     device_batch=device_batch, pretrain=pretrain)
+
+
+def xla_flops(compiled) -> Optional[float]:
+    """Per-step FLOPs from XLA's cost model; None when unavailable (varies
+    by backend)."""
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        return float(ca.get("flops", 0.0)) or None
+    except Exception:
+        return None

@@ -49,64 +49,26 @@ VARIANTS = [
 def time_variant(model_name: str, overrides: dict, wl: dict, smoke: bool,
                  steps: int, warmup: int) -> dict:
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
-    from pytorchvideo_accelerate_tpu.config import ModelConfig, OptimConfig
-    from pytorchvideo_accelerate_tpu.models import create_model
-    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
-    from pytorchvideo_accelerate_tpu.config import MeshConfig
-    from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
-    from pytorchvideo_accelerate_tpu.trainer import (
-        TrainState, build_optimizer, make_train_step,
+    from pytorchvideo_accelerate_tpu.utils.bench_setup import (
+        build_step_setup, xla_flops,
     )
     from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
 
     frames, crop, bsz = wl["frames"], wl["crop"], wl["batch"]
     if smoke:
         frames, crop, bsz = max(frames // 4, 4), 64, 2
-    cfg = ModelConfig(name=model_name, num_classes=700, **overrides)
-    model = create_model(cfg, "bf16")
-    devices = jax.devices()
-    mesh = make_mesh(MeshConfig(), devices=devices)
-    B = bsz * len(devices)
-
-    def make_batch(seed):
-        rr = np.random.default_rng(seed)
-        if model_name.startswith("slowfast"):
-            b = {"slow": rr.standard_normal((B, frames // 4, crop, crop, 3),
-                                            dtype=np.float32),
-                 "fast": rr.standard_normal((B, frames, crop, crop, 3),
-                                            dtype=np.float32)}
-        else:
-            b = {"video": rr.standard_normal((B, frames, crop, crop, 3),
-                                             dtype=np.float32)}
-        b["label"] = rr.integers(0, 700, B).astype(np.int32)
-        return b
-
-    batch = make_batch(0)
-    sample = ((jnp.zeros((1, *batch["slow"].shape[1:])),
-               jnp.zeros((1, *batch["fast"].shape[1:])))
-              if model_name.startswith("slowfast")
-              else jnp.zeros((1, *batch["video"].shape[1:])))
-    variables = model.init(jax.random.key(0), sample)
-    tx = build_optimizer(OptimConfig(), total_steps=steps + warmup)
-    state = TrainState.create(variables["params"],
-                              variables.get("batch_stats", {}), tx)
-    step = make_train_step(model, tx, mesh)
-    gbs = [shard_batch(mesh, batch), shard_batch(mesh, make_batch(1))]
+    setup = build_step_setup(
+        model_name, frames=frames, crop=crop, batch_per_chip=bsz,
+        overrides=overrides, total_steps=steps + warmup,
+    )
+    state = setup.state
+    gbs = [setup.device_batch(0), setup.device_batch(1)]
 
     t0 = time.perf_counter()
-    compiled = step.lower(state, gbs[0], jax.random.key(0)).compile()
+    compiled = setup.step.lower(state, gbs[0], jax.random.key(0)).compile()
     compile_s = time.perf_counter() - t0
-    flops = None
-    try:
-        ca = compiled.cost_analysis()
-        if isinstance(ca, (list, tuple)):
-            ca = ca[0] if ca else {}
-        flops = float(ca.get("flops", 0.0)) or None
-    except Exception:
-        pass
+    flops = xla_flops(compiled)
     for i in range(max(warmup, 1)):
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(i))
     jax.block_until_ready(metrics["loss"])
@@ -117,17 +79,19 @@ def time_variant(model_name: str, overrides: dict, wl: dict, smoke: bool,
         jax.block_until_ready(metrics["loss"])
         blocked.append(time.perf_counter() - t0)
     ms = statistics.median(blocked) * 1e3
+    devices = jax.devices()
     out = {
         "model": model_name, "overrides": overrides,
         "batch_per_chip": bsz, "frames": frames, "crop": crop,
         "step_ms": round(ms, 2),
-        "clips_per_sec_per_chip": round(B / (ms / 1e3) / len(devices), 2),
+        "clips_per_sec_per_chip": round(
+            setup.global_batch / (ms / 1e3) / setup.n_chips, 2),
         "compile_s": round(compile_s, 1),
         "platform": devices[0].platform,
         "smoke": smoke,
     }
     if flops:
-        tf = flops / (ms / 1e3) / 1e12 / len(devices)
+        tf = flops / (ms / 1e3) / 1e12 / setup.n_chips
         out["tflops_per_sec_per_chip"] = round(tf, 2)
         peak = peak_tflops(devices[0])
         if peak:

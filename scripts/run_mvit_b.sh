@@ -1,0 +1,22 @@
+#!/usr/bin/env bash
+# MViT-B 16x4 on Kinetics (BASELINE config 4). 16 frames, stride 4, 224^2.
+# Long-clip variants: add --mesh.context 2 --model.attention ring (or
+# ulysses) to shard the token axis over ICI, and --model.remat to trade
+# recompute for activation HBM (then re-fit the batch:
+# python -m pytorchvideo_accelerate_tpu.utils.memfit --model mvit_b ...).
+set -euo pipefail
+
+python -m pytorchvideo_accelerate_tpu.run \
+  --data_dir "${DATA_DIR:-/data/kinetics}" \
+  --output_dir outputs_mvit_b \
+  --model.name mvit_b \
+  --num_frames 16 \
+  --sampling_rate 4 \
+  --data.crop_size 224 \
+  --data.min_short_side_scale 256 \
+  --data.max_short_side_scale 320 \
+  --batch_size 8 \
+  --num_workers 8 \
+  --checkpointing_steps epoch \
+  --with_tracking \
+  "$@"

@@ -1,0 +1,38 @@
+#!/usr/bin/env bash
+# VideoMAE-B (BASELINE config 5): self-supervised pretrain, then fine-tune
+# from the exported encoder. The reference stack has no SSL path at all
+# (run.py is supervised-only); this is the TPU-native extension of its
+# pretrained-backbone workflow (run.py:105-118 semantics).
+set -euo pipefail
+
+DATA="${DATA_DIR:-/data/ssv2}"
+OUT="${OUT_DIR:-outputs_videomae_b}"
+
+# 1) MAE pretraining (no labels used; tube masking ratio 0.9)
+python -m pytorchvideo_accelerate_tpu.run \
+  --data_dir "$DATA" \
+  --output_dir "$OUT/pretrain" \
+  --model.name videomae_b_pretrain \
+  --num_frames 16 --sampling_rate 4 \
+  --data.crop_size 224 \
+  --batch_size 8 --num_workers 8 \
+  --checkpointing_steps epoch \
+  --with_tracking \
+  "$@"
+
+# 2) export encoder weights from the last pretrain checkpoint
+python -m pytorchvideo_accelerate_tpu.models.convert \
+  "$OUT/pretrain/checkpoints" "$OUT/videomae_b_encoder.npz"
+
+# 3) supervised fine-tune from the exported encoder
+python -m pytorchvideo_accelerate_tpu.run \
+  --data_dir "$DATA" \
+  --output_dir "$OUT/finetune" \
+  --model.name videomae_b \
+  --model.pretrained --model.pretrained_path "$OUT/videomae_b_encoder.npz" \
+  --num_frames 16 --sampling_rate 4 \
+  --data.crop_size 224 \
+  --batch_size 8 --num_workers 8 \
+  --checkpointing_steps epoch \
+  --with_tracking \
+  "$@"

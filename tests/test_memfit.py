@@ -1,0 +1,73 @@
+"""Compile-time batch fitting (utils/memfit.py): XLA memory accounting is
+monotone in batch, the bisection finds the boundary with O(log n) compiles,
+and the CLI emits a parseable recommendation."""
+
+import json
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.utils.memfit import (
+    find_max_batch,
+    step_memory_bytes,
+)
+
+
+def test_memory_grows_with_batch():
+    a = step_memory_bytes("slow_r50", 1, frames=4, crop=32, num_classes=4,
+                          overrides=None)
+    b = step_memory_bytes("slow_r50", 4, frames=4, crop=32, num_classes=4,
+                          overrides=None)
+    assert b["estimate_bytes"] > a["estimate_bytes"]
+    for k in ("argument_bytes", "temp_bytes", "estimate_bytes"):
+        assert a[k] > 0
+
+
+def test_bisection_finds_boundary():
+    calls = []
+
+    def fake_measure(b):  # 100 MB fixed + 10 MB/batch
+        calls.append(b)
+        return 100_000_000 + 10_000_000 * b
+
+    best, probes = find_max_batch(fake_measure, budget_bytes=400_000_000,
+                                  max_batch=1024)
+    assert best == 30  # 100 + 10*30 = 400 <= 400; 31 overflows
+    assert len(calls) <= 14  # doubling + bisection, not a linear scan
+    assert probes[-1][0] in (30, 31)
+
+
+def test_bisection_edge_cases():
+    best, _ = find_max_batch(lambda b: 10**12, budget_bytes=1, max_batch=64)
+    assert best == 0  # nothing fits
+    best, _ = find_max_batch(lambda b: b, budget_bytes=10**9, max_batch=16)
+    assert best == 16  # everything fits up to the cap
+
+
+def test_non_power_of_two_cap_is_reached():
+    """The doubling loop must not stop at the last power of two below a
+    non-power-of-two cap when everything fits."""
+    best, _ = find_max_batch(lambda b: b, budget_bytes=10**9, max_batch=100)
+    assert best == 100
+    # cap overflows: bisect inside (64, 100]
+    best, _ = find_max_batch(lambda b: b, budget_bytes=70, max_batch=100)
+    assert best == 70
+
+
+def test_cli_emits_recommendation(capsys):
+    from pytorchvideo_accelerate_tpu.utils import memfit
+
+    memfit.main([
+        "--model", "slow_r50", "--frames", "4", "--crop", "32",
+        "--num_classes", "4", "--cpu",
+        # tiny budget so the search stays cheap: a few compiles at most
+        "--hbm_gib", "0.75", "--margin", "1.0", "--max_batch", "8",
+    ])
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    rec = json.loads(line)
+    assert rec["max_batch_per_chip"] >= 0
+    assert rec["probes"]
+    assert rec["backend"] == "cpu"
+    # monotone estimates across the probes it made
+    by_batch = sorted((p["batch"], p["bytes"]) for p in rec["probes"])
+    sizes = [s for _, s in by_batch]
+    assert sizes == sorted(sizes)
