@@ -28,6 +28,7 @@ CLI:
 from __future__ import annotations
 
 import json
+import logging
 import os
 from concurrent.futures import ThreadPoolExecutor
 from typing import Callable, Dict, List, Optional
@@ -37,6 +38,8 @@ import numpy as np
 from pytorchvideo_accelerate_tpu.data import decode as decode_mod
 from pytorchvideo_accelerate_tpu.data.manifest import Manifest, scan_directory
 from pytorchvideo_accelerate_tpu.data.samplers import random_clip
+
+logger = logging.getLogger(__name__)
 
 INDEX_NAME = "index.json"
 DATA_NAME = "data.bin"
@@ -102,12 +105,21 @@ def build_cache(data_dir: str, out_dir: str, fps: float = 30.0,
         with open(os.path.join(out_dir, DATA_NAME), "wb") as f:
             while pending:
                 entry, fut = pending.popleft()
-                frames = fut.result()
+                try:
+                    frames = fut.result()
+                except (IOError, OSError, ValueError, RuntimeError) as e:
+                    # corrupt source video: skip (real Kinetics trees always
+                    # have some) — it simply doesn't appear in the index
+                    logger.warning("cache build: skipping unreadable %s "
+                                   "(%s: %s)", entry.path, type(e).__name__, e)
+                    frames = None
                 if consumed < len(manifest.entries):
                     nxt = manifest.entries[consumed]
                     pending.append((nxt, pool.submit(_decode_video, nxt.path,
                                                      fps, short_side)))
                     consumed += 1
+                if frames is None:
+                    continue
                 f.write(frames.tobytes())
                 videos.append({
                     "path": entry.path,

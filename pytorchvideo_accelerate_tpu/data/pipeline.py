@@ -26,6 +26,7 @@ independent deterministic sample.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
@@ -38,6 +39,8 @@ import numpy as np
 from pytorchvideo_accelerate_tpu.data import decode as decode_mod
 from pytorchvideo_accelerate_tpu.data.manifest import Manifest
 from pytorchvideo_accelerate_tpu.data.samplers import random_clip, uniform_clips
+
+logger = logging.getLogger(__name__)
 
 
 class ClipSource:
@@ -80,6 +83,15 @@ class VideoClipSource(ClipSource):
     `training=True` samples a random span with an RNG derived from
     (seed, epoch, index) — reproducible across restarts, distinct across
     epochs (what the reference's shared-iterator design failed to provide).
+
+    Unreadable/corrupt videos (real Kinetics trees always have some) are
+    substituted, not fatal: up to `_MAX_CONSECUTIVE_FAILURES` replacement
+    indices are drawn from the SAME (seed, epoch, index) RNG — so the
+    substitution is reproducible across restarts — with failed paths
+    remembered and a warning logged once per file. Mirrors pytorchvideo
+    LabeledVideoDataset's retry semantics (the reference's decode-failure
+    behavior, run.py:151-168 [external]); the label always comes from the
+    video actually decoded.
     """
 
     def __init__(
@@ -103,6 +115,9 @@ class VideoClipSource(ClipSource):
         self.num_classes = manifest.num_classes
         self._meta_cache: Dict[str, decode_mod.VideoMeta] = {}
         self._meta_lock = threading.Lock()
+        self._failed: set = set()
+
+    _MAX_CONSECUTIVE_FAILURES = 10  # pytorchvideo LabeledVideoDataset parity
 
     def __len__(self) -> int:
         return len(self.manifest)
@@ -117,16 +132,43 @@ class VideoClipSource(ClipSource):
         return meta
 
     def get(self, index: int, epoch: int) -> Dict[str, np.ndarray]:
-        entry = self.manifest.entries[index]
-        meta = self._meta(entry.path)
-        rng = np.random.default_rng((self.seed, epoch, index))
-        out = sample_views(
-            lambda a, b: decode_mod.decode_span(entry.path, a, b),
-            self.transform, meta.duration, self.clip_duration,
-            self.training, rng, self.num_clips,
-        )
-        out["label"] = np.int32(entry.label)
-        return out
+        idx = index
+        for attempt in range(self._MAX_CONSECUTIVE_FAILURES):
+            # each attempt gets its OWN rng stream: reproducibility across
+            # restarts must not depend on how many draws a previous attempt
+            # consumed before failing, nor on whether a known-bad path was
+            # skipped without any decode attempt (self._failed is run-local
+            # history; attempt-keyed streams make it invisible to sampling)
+            rng = (np.random.default_rng((self.seed, epoch, index))
+                   if attempt == 0
+                   else np.random.default_rng(
+                       (self.seed, epoch, index, attempt)))
+            entry = self.manifest.entries[idx]
+            with self._meta_lock:
+                known_bad = entry.path in self._failed
+            if not known_bad:
+                try:
+                    meta = self._meta(entry.path)
+                    out = sample_views(
+                        lambda a, b: decode_mod.decode_span(entry.path, a, b),
+                        self.transform, meta.duration, self.clip_duration,
+                        self.training, rng, self.num_clips,
+                    )
+                    out["label"] = np.int32(entry.label)
+                    return out
+                except (IOError, OSError, ValueError, RuntimeError) as e:
+                    with self._meta_lock:
+                        self._failed.add(entry.path)
+                    logger.warning(
+                        "skipping unreadable video %s (%s: %s); substituting",
+                        entry.path, type(e).__name__, e)
+            # deterministic replacement, also attempt-keyed
+            idx = int(np.random.default_rng(
+                (self.seed, 0xBAD, epoch, index, attempt)
+            ).integers(0, len(self.manifest)))
+        raise IOError(
+            f"{self._MAX_CONSECUTIVE_FAILURES} consecutive unreadable videos "
+            f"starting at index {index} (see warnings for paths)")
 
 
 class SyntheticClipSource(ClipSource):

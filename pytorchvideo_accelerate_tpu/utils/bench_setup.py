@@ -11,8 +11,11 @@ land here once.
 
 from __future__ import annotations
 
+import logging
 from dataclasses import dataclass
 from typing import Any, Callable, Optional
+
+logger = logging.getLogger(__name__)
 
 
 def is_pretrain_model(model_name: str) -> bool:
@@ -45,6 +48,8 @@ def build_step_setup(
     overrides: Optional[dict] = None,
     devices=None,
     total_steps: int = 30,
+    fill: str = "random",  # random | zeros (compile-only callers: zeros
+    #                        pages are calloc'd, no RNG cost at big batches)
 ) -> StepSetup:
     import jax
     import jax.numpy as jnp
@@ -71,18 +76,26 @@ def build_step_setup(
     mesh = make_mesh(MeshConfig(), devices=devices)
     B = batch_per_chip * n_chips
 
+    if accum > 1 and B % accum:
+        raise ValueError(
+            f"global batch {B} ({batch_per_chip}/chip x {n_chips}) must be "
+            f"divisible by accum={accum}")
+
     def host_batch(seed: int) -> dict:
         r = np.random.default_rng(seed)
+
+        def clips(shape):
+            if fill == "zeros":
+                return np.zeros(shape, np.float32)
+            return r.standard_normal(shape, dtype=np.float32)
+
         if model_name.startswith("slowfast"):
             b = {
-                "slow": r.standard_normal(
-                    (B, frames // alpha, crop, crop, 3), dtype=np.float32),
-                "fast": r.standard_normal(
-                    (B, frames, crop, crop, 3), dtype=np.float32),
+                "slow": clips((B, frames // alpha, crop, crop, 3)),
+                "fast": clips((B, frames, crop, crop, 3)),
             }
         else:
-            b = {"video": r.standard_normal(
-                (B, frames, crop, crop, 3), dtype=np.float32)}
+            b = {"video": clips((B, frames, crop, crop, 3))}
         if not pretrain:
             b["label"] = r.integers(0, num_classes, B).astype(np.int32)
         if accum > 1:
@@ -93,21 +106,19 @@ def build_step_setup(
     def device_batch(seed: int):
         return shard_batch(mesh, host_batch(seed), micro_dim=accum > 1)
 
-    probe = host_batch(0)
-    micro = probe["slow" if model_name.startswith("slowfast") else "video"]
-    clip_shape = micro.shape[2:] if accum > 1 else micro.shape[1:]
+    # model init sample: shapes are arithmetic — no need to materialize a
+    # full batch just to read them
     if model_name.startswith("slowfast"):
-        fast = probe["fast"]
-        fast_shape = fast.shape[2:] if accum > 1 else fast.shape[1:]
-        sample = (jnp.zeros((1, *clip_shape)), jnp.zeros((1, *fast_shape)))
+        sample = (jnp.zeros((1, frames // alpha, crop, crop, 3)),
+                  jnp.zeros((1, frames, crop, crop, 3)))
     else:
-        sample = jnp.zeros((1, *clip_shape))
+        sample = jnp.zeros((1, frames, crop, crop, 3))
     variables = model.init(jax.random.key(0), sample)
     tx = build_optimizer(OptimConfig(), total_steps=total_steps)
     state = TrainState.create(variables["params"],
                               variables.get("batch_stats", {}), tx)
     if pretrain:
-        step = make_pretrain_step(model, tx, mesh)
+        step = make_pretrain_step(model, tx, mesh, accum_steps=accum)
     else:
         step = make_train_step(model, tx, mesh, accum_steps=accum)
     return StepSetup(model=model, mesh=mesh, state=state, step=step,
@@ -117,11 +128,13 @@ def build_step_setup(
 
 def xla_flops(compiled) -> Optional[float]:
     """Per-step FLOPs from XLA's cost model; None when unavailable (varies
-    by backend)."""
+    by backend — the reason is logged, not swallowed)."""
     try:
         ca = compiled.cost_analysis()
         if isinstance(ca, (list, tuple)):
             ca = ca[0] if ca else {}
         return float(ca.get("flops", 0.0)) or None
-    except Exception:
+    except Exception as e:
+        logger.warning("cost_analysis unavailable: %s: %s",
+                       type(e).__name__, e)
         return None

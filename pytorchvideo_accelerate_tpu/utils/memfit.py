@@ -39,7 +39,7 @@ def step_memory_bytes(model_name: str, batch: int, frames: int, crop: int,
     setup = build_step_setup(
         model_name, frames=frames, crop=crop, batch_per_chip=batch,
         num_classes=num_classes, accum=accum, overrides=overrides,
-        devices=jax.devices()[:1],
+        devices=jax.devices()[:1], fill="zeros",  # compile-only: no RNG cost
     )
     compiled = setup.step.lower(
         setup.state, setup.device_batch(0), jax.random.key(0)).compile()
@@ -127,19 +127,23 @@ def main(argv=None):
 
     budget = int(args.hbm_gib * args.margin * (1 << 30))
 
-    def measure(b):
-        r = step_memory_bytes(args.model, b, args.frames, args.crop,
-                              args.num_classes, args.accum)
+    # with grad accumulation the effective batch must divide into accum
+    # micro-steps: bisect over the MICRO batch k, measure k*accum
+    def measure(k):
+        r = step_memory_bytes(args.model, k * args.accum, args.frames,
+                              args.crop, args.num_classes, args.accum)
         print(json.dumps(r), file=sys.stderr, flush=True)
         return r["estimate_bytes"]
 
-    best, probes = find_max_batch(measure, budget, args.max_batch)
+    best_micro, probes = find_max_batch(
+        measure, budget, max(args.max_batch // args.accum, 1))
     print(json.dumps({
         "model": args.model, "frames": args.frames, "crop": args.crop,
         "accum": args.accum, "hbm_gib": args.hbm_gib, "margin": args.margin,
         "budget_bytes": budget,
-        "max_batch_per_chip": best,
-        "probes": [{"batch": b, "bytes": n} for b, n in probes],
+        "max_batch_per_chip": best_micro * args.accum,
+        "micro_batch_per_chip": best_micro,
+        "probes": [{"batch": k * args.accum, "bytes": n} for k, n in probes],
         "backend": jax.devices()[0].platform,
     }))
 

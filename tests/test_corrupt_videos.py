@@ -1,0 +1,123 @@
+"""Corrupt-video resilience: real Kinetics trees always contain unreadable
+files, and a 64-host run must not die on one. VideoClipSource substitutes
+deterministically (pytorchvideo LabeledVideoDataset retry parity, capped at
+10); build_cache skips with a warning."""
+
+import logging
+import os
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+
+from pytorchvideo_accelerate_tpu.data.cache import (  # noqa: E402
+    CachedClipSource,
+    build_cache,
+)
+from pytorchvideo_accelerate_tpu.data.manifest import scan_directory  # noqa: E402
+from pytorchvideo_accelerate_tpu.data.pipeline import (  # noqa: E402
+    ClipLoader,
+    VideoClipSource,
+)
+from pytorchvideo_accelerate_tpu.data.transforms import make_transform  # noqa: E402
+
+FPS = 10.0
+SIZE = (64, 48)
+
+
+def _write_video(path, n_frames=20):
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), FPS, SIZE)
+    if not w.isOpened():
+        pytest.skip("mp4v codec unavailable")
+    for i in range(n_frames):
+        w.write(np.full((SIZE[1], SIZE[0], 3), 40 + i, np.uint8))
+    w.release()
+
+
+@pytest.fixture()
+def tree_with_corruption(tmp_path):
+    """8 videos, 2 classes; one file is garbage bytes, one is zero-length."""
+    root = tmp_path / "train"
+    for c in range(2):
+        d = root / f"class{c}"
+        d.mkdir(parents=True)
+        for v in range(4):
+            _write_video(str(d / f"v{v}.mp4"))
+    (root / "class0" / "v1.mp4").write_bytes(b"not a video at all" * 100)
+    (root / "class1" / "v2.mp4").write_bytes(b"")
+    return str(root)
+
+
+def _source(root, **kw):
+    tf = make_transform(num_frames=4, training=True, crop_size=32,
+                        min_short_side_scale=40, max_short_side_scale=48)
+    return VideoClipSource(scan_directory(root), tf, clip_duration=0.4,
+                           training=True, **kw)
+
+
+def test_corrupt_video_is_substituted_not_fatal(tree_with_corruption, caplog):
+    src = _source(tree_with_corruption)
+    corrupt_idx = next(i for i, e in enumerate(src.manifest.entries)
+                       if e.path.endswith("class0/v1.mp4"))
+    with caplog.at_level(logging.WARNING):
+        out = src.get(corrupt_idx, epoch=0)
+    assert out["video"].shape == (4, 32, 32, 3)
+    # the label belongs to whichever video was actually decoded
+    sub_paths = [e.path for e in src.manifest.entries
+                 if e.label == int(out["label"])]
+    assert sub_paths
+    assert any("substituting" in r.message for r in caplog.records)
+
+
+def test_substitution_is_deterministic(tree_with_corruption):
+    src1 = _source(tree_with_corruption, seed=7)
+    src2 = _source(tree_with_corruption, seed=7)
+    corrupt_idx = next(i for i, e in enumerate(src1.manifest.entries)
+                       if e.path.endswith("class1/v2.mp4"))
+    a = src1.get(corrupt_idx, epoch=3)
+    b = src2.get(corrupt_idx, epoch=3)
+    np.testing.assert_array_equal(a["video"], b["video"])
+    assert a["label"] == b["label"]
+    # and independent of run-local failure history: the second call skips
+    # the decode attempt (path cached in _failed) yet must produce the SAME
+    # sample a fresh process (restart) would — attempt-keyed rng streams
+    c = src1.get(corrupt_idx, epoch=3)
+    np.testing.assert_array_equal(a["video"], c["video"])
+
+
+def test_full_epoch_trains_through_corruption(tree_with_corruption):
+    src = _source(tree_with_corruption)
+    loader = ClipLoader(src, global_batch_size=4, shuffle=True, num_workers=2)
+    try:
+        batches = list(loader.epoch(0))
+        assert len(batches) == 2  # 8 entries / batch 4
+        for b in batches:
+            assert b["video"].shape == (4, 4, 32, 32, 3)
+    finally:
+        loader.close()
+
+
+def test_all_corrupt_raises_clear_error(tmp_path):
+    root = tmp_path / "train"
+    d = root / "class0"
+    d.mkdir(parents=True)
+    for v in range(3):
+        (d / f"v{v}.mp4").write_bytes(b"garbage" * 50)
+    src = _source(str(root))
+    with pytest.raises(IOError, match="consecutive unreadable"):
+        src.get(0, epoch=0)
+
+
+def test_build_cache_skips_corrupt(tree_with_corruption, tmp_path, caplog):
+    cache_dir = str(tmp_path / "cache")
+    with caplog.at_level(logging.WARNING):
+        build_cache(tree_with_corruption, cache_dir, fps=FPS, short_side=48,
+                    num_workers=2)
+    tf = make_transform(num_frames=4, training=True, crop_size=32,
+                        min_short_side_scale=40, max_short_side_scale=48)
+    src = CachedClipSource(cache_dir, tf, clip_duration=0.4, training=True)
+    assert len(src) == 6  # 8 minus the 2 unreadable
+    assert any("skipping unreadable" in r.message for r in caplog.records)
+    out = src.get(0, epoch=0)
+    assert out["video"].shape == (4, 32, 32, 3)
