@@ -107,7 +107,7 @@ def build_cache(data_dir: str, out_dir: str, fps: float = 30.0,
                 entry, fut = pending.popleft()
                 try:
                     frames = fut.result()
-                except (IOError, OSError, ValueError, RuntimeError) as e:
+                except decode_mod.DECODE_ERRORS as e:
                     # corrupt source video: skip (real Kinetics trees always
                     # have some) — it simply doesn't appear in the index
                     logger.warning("cache build: skipping unreadable %s "

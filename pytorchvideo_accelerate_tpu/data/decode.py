@@ -19,6 +19,14 @@ try:
 except Exception:  # pragma: no cover
     cv2 = None
 
+# What "this video is unreadable" looks like from the decode layer — the
+# single source of truth for every caller that degrades gracefully
+# (pipeline substitution, cache-build skip, the verify doctor). cv2.error
+# subclasses Exception only, so it is listed explicitly when available.
+DECODE_ERRORS = ((IOError, OSError, ValueError, RuntimeError, cv2.error)
+                 if cv2 is not None and hasattr(cv2, "error")
+                 else (IOError, OSError, ValueError, RuntimeError))
+
 
 @dataclass
 class VideoMeta:

@@ -43,6 +43,11 @@ from pytorchvideo_accelerate_tpu.data.samplers import random_clip, uniform_clips
 logger = logging.getLogger(__name__)
 
 
+class _DecodeFailure(Exception):
+    """Tag for decode-layer failures crossing the transform boundary —
+    keeps VideoClipSource's substitution from swallowing transform bugs."""
+
+
 class ClipSource:
     """A deterministic map (epoch, index) -> sample dict of numpy arrays."""
 
@@ -86,12 +91,16 @@ class VideoClipSource(ClipSource):
 
     Unreadable/corrupt videos (real Kinetics trees always have some) are
     substituted, not fatal: up to `_MAX_CONSECUTIVE_FAILURES` replacement
-    indices are drawn from the SAME (seed, epoch, index) RNG — so the
-    substitution is reproducible across restarts — with failed paths
-    remembered and a warning logged once per file. Mirrors pytorchvideo
-    LabeledVideoDataset's retry semantics (the reference's decode-failure
-    behavior, run.py:151-168 [external]); the label always comes from the
-    video actually decoded.
+    indices, each drawn from its own attempt-keyed RNG stream
+    ((seed, 0xBAD, epoch, index, attempt)) so the substitution is
+    reproducible across restarts regardless of how many draws a failed
+    decode consumed or whether a known-bad path was skipped outright;
+    failed paths are remembered and a warning logged once per file.
+    Mirrors pytorchvideo LabeledVideoDataset's retry semantics (the
+    reference's decode-failure behavior, run.py:151-168 [external]); the
+    label always comes from the video actually decoded. Only DECODE
+    failures substitute — transform errors propagate (a transform bug must
+    not silently skew the data distribution).
     """
 
     def __init__(
@@ -147,21 +156,39 @@ class VideoClipSource(ClipSource):
             with self._meta_lock:
                 known_bad = entry.path in self._failed
             if not known_bad:
-                try:
-                    meta = self._meta(entry.path)
-                    out = sample_views(
-                        lambda a, b: decode_mod.decode_span(entry.path, a, b),
-                        self.transform, meta.duration, self.clip_duration,
-                        self.training, rng, self.num_clips,
-                    )
-                    out["label"] = np.int32(entry.label)
-                    return out
-                except (IOError, OSError, ValueError, RuntimeError) as e:
+                # only DECODE failures are substitutable; the read_span
+                # wrapper tags them so a transform bug raising ValueError
+                # inside sample_views can't be mistaken for a corrupt file
+                # (which would silently blacklist readable videos)
+                def read_span(a, b, _path=entry.path):
+                    try:
+                        return decode_mod.decode_span(_path, a, b)
+                    except decode_mod.DECODE_ERRORS as e:
+                        raise _DecodeFailure(str(e)) from e
+
+                def mark_failed(e):
                     with self._meta_lock:
                         self._failed.add(entry.path)
                     logger.warning(
                         "skipping unreadable video %s (%s: %s); substituting",
                         entry.path, type(e).__name__, e)
+
+                try:
+                    meta = self._meta(entry.path)
+                except decode_mod.DECODE_ERRORS as e:
+                    mark_failed(e)
+                else:
+                    try:
+                        out = sample_views(
+                            read_span, self.transform, meta.duration,
+                            self.clip_duration, self.training, rng,
+                            self.num_clips,
+                        )
+                    except _DecodeFailure as e:
+                        mark_failed(e)
+                    else:
+                        out["label"] = np.int32(entry.label)
+                        return out
             # deterministic replacement, also attempt-keyed
             idx = int(np.random.default_rng(
                 (self.seed, 0xBAD, epoch, index, attempt)

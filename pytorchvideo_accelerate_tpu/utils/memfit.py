@@ -124,6 +124,9 @@ def main(argv=None):
 
     if args.cpu:
         jax.config.update("jax_platforms", "cpu")
+    if args.accum > args.max_batch:
+        ap.error(f"--accum {args.accum} exceeds --max_batch {args.max_batch}: "
+                 "even one sample per micro-step would overshoot the cap")
 
     budget = int(args.hbm_gib * args.margin * (1 << 30))
 

@@ -3,6 +3,7 @@ files, and a 64-host run must not die on one. VideoClipSource substitutes
 deterministically (pytorchvideo LabeledVideoDataset retry parity, capped at
 10); build_cache skips with a warning."""
 
+import json
 import logging
 import os
 
@@ -121,3 +122,58 @@ def test_build_cache_skips_corrupt(tree_with_corruption, tmp_path, caplog):
     assert any("skipping unreadable" in r.message for r in caplog.records)
     out = src.get(0, epoch=0)
     assert out["video"].shape == (4, 32, 32, 3)
+
+
+class TestVerifyTree:
+    def test_reports_unreadable_and_stats(self, tree_with_corruption):
+        from pytorchvideo_accelerate_tpu.data.verify import verify_tree
+
+        rep = verify_tree(tree_with_corruption, clip_duration=0.4,
+                          num_workers=2)
+        assert rep["num_videos"] == 8
+        assert rep["readable"] == 6
+        assert rep["unreadable"] == 2
+        paths = {f["path"] for f in rep["unreadable_files"]}
+        assert any(p.endswith("class0/v1.mp4") for p in paths)
+        assert any(p.endswith("class1/v2.mp4") for p in paths)
+        assert rep["empty_classes"] == []
+        assert rep["duration_s"]["min"] > 0
+
+    def test_deep_mode_and_clean_tree(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.data.verify import verify_tree
+
+        root = tmp_path / "train"
+        d = root / "solo"
+        d.mkdir(parents=True)
+        _write_video(str(d / "a.mp4"))
+        rep = verify_tree(str(root), num_workers=1, deep=True)
+        assert rep["unreadable"] == 0 and rep["readable"] == 1
+
+    def test_cli_exit_codes(self, tree_with_corruption, tmp_path, capsys):
+        from pytorchvideo_accelerate_tpu.data.verify import main
+
+        assert main([tree_with_corruption]) == 1  # unreadable files
+        json.loads(capsys.readouterr().out)  # parseable report
+
+        root = tmp_path / "clean" / "train"
+        d = root / "only"
+        d.mkdir(parents=True)
+        _write_video(str(d / "a.mp4"))
+        assert main([str(root)]) == 0
+
+
+def test_transform_errors_propagate_not_substituted(tree_with_corruption):
+    """A transform bug must raise, not blacklist readable videos — only
+    decode-layer failures are substitutable."""
+    from pytorchvideo_accelerate_tpu.data.pipeline import VideoClipSource
+
+    def broken_transform(frames, rng):
+        raise ValueError("transform bug, not a corrupt file")
+
+    src = VideoClipSource(scan_directory(tree_with_corruption),
+                          broken_transform, clip_duration=0.4, training=True)
+    good_idx = next(i for i, e in enumerate(src.manifest.entries)
+                    if e.path.endswith("class0/v0.mp4"))
+    with pytest.raises(ValueError, match="transform bug"):
+        src.get(good_idx, epoch=0)
+    assert not src._failed  # the readable video was NOT blacklisted
