@@ -811,11 +811,15 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
     replaced (cast to the target dtype); mismatches — most commonly the
     classification head when `num_classes` differs from the pretrain
     dataset (reference head-swap semantics, run.py:109,117) — keep the
-    fresh initialization. Accepts a converted `.npz`, a raw torch
+    fresh initialization. A learned (1, T, H, W, C) `pos_embed` whose grid
+    differs (fine-tuning at another clip length/resolution) is
+    trilinear-interpolated to the target geometry rather than discarded.
+    Accepts a converted `.npz`, a raw torch
     `.pt/.pth/.bin` (converted on the fly via torch), or an HF
     `.safetensors` file (no torch needed).
     Returns (merged_variables, report) where report lists loaded/kept paths.
     """
+    import jax
     import jax.numpy as jnp
 
     if path.endswith((".pt", ".pth", ".bin", ".safetensors")):
@@ -825,10 +829,11 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
         source = load_converted(path)
 
     # "kept": path absent from the artifact (fresh head, new params);
+    # "interpolated": pos-embed grid resized to the target geometry;
     # "mismatched": present but wrong shape — expected ONLY for the swapped
     # classification head; anything else usually means a stale artifact
     # (e.g. converted with an older layout) and is worth a loud warning.
-    report = {"loaded": [], "kept": [], "mismatched": []}
+    report = {"loaded": [], "kept": [], "mismatched": [], "interpolated": []}
 
     def merge(target: dict, src: dict, prefix: Path) -> dict:
         out = {}
@@ -847,6 +852,22 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
                     and tuple(np.shape(src[k])) == tuple(v.shape):
                 out[k] = jnp.asarray(src[k], dtype=v.dtype)
                 report["loaded"].append("/".join(p))
+            elif (k == "pos_embed" and k in src
+                  and not isinstance(src[k], dict)
+                  and np.ndim(src[k]) == 5 and v.ndim == 5
+                  and np.shape(src[k])[-1] == v.shape[-1]):
+                # learned (1, T, H, W, C) position table, different clip
+                # length / resolution than the checkpoint was trained at:
+                # trilinear-resize the grid (the ViT-family fine-tuning
+                # convention) instead of discarding pretrained positions
+                out[k] = jax.image.resize(
+                    jnp.asarray(src[k], jnp.float32), v.shape, "trilinear",
+                    antialias=False,  # torch F.interpolate convention — the
+                    # recipe ViT-family fine-tunes were validated with
+                ).astype(v.dtype)
+                report["interpolated"].append(
+                    "/".join(p) + f" {tuple(np.shape(src[k])[1:4])}"
+                    f"->{tuple(v.shape[1:4])}")
             else:
                 out[k] = v
                 # wrong shape OR a subtree where a leaf is expected ->

@@ -291,6 +291,10 @@ class Trainer:
                 f"pretrained: loaded {len(report['loaded'])} tensors, "
                 f"kept {len(report['kept'])} fresh"
             )
+            if report.get("interpolated"):
+                main_print("pretrained: pos-embed grid interpolated to this "
+                           "run's geometry: "
+                           + ", ".join(report["interpolated"]))
             mism = report.get("mismatched", [])
             # head paths by model family: .../head/... (resnet/slowfast,
             # mvit, videomae) or X3D's top-level params/proj — exact

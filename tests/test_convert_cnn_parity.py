@@ -600,3 +600,59 @@ def test_mvit_pool_tiling_is_per_head():
         np.testing.assert_array_equal(
             k_flax[..., 0, h * 8:(h + 1) * 8],
             np.transpose(k_torch, (2, 3, 4, 1, 0))[..., 0, :])
+
+
+def test_mvit_pos_embed_interpolates_across_geometry(tmp_path):
+    """Fine-tuning at a different clip length/resolution than the
+    checkpoint: the (1,T,H,W,C) pos-embed is trilinear-resized on load, not
+    discarded; every other weight loads exactly (shapes are geometry-free)."""
+    from pytorchvideo_accelerate_tpu.models.convert import (
+        load_pretrained, save_converted,
+    )
+
+    tm = TorchMViTTiny().eval()
+    _randomize(tm, 5)
+    with torch.no_grad():  # constant pos table: interpolation preserves it
+        tm.cls_positional_encoding.pos_embed_spatial.fill_(0.25)
+        tm.cls_positional_encoding.pos_embed_temporal.fill_(0.5)
+    sd = {k: v.numpy() for k, v in tm.state_dict().items()}
+    tree = convert_state_dict(sd, "mvit_b")
+    npz = str(tmp_path / "mvit.npz")
+    save_converted(tree, npz)
+
+    # checkpoint grid (2,4,4); target model sees 8 frames @ 32^2 -> (4,8,8)
+    fm = MViT(num_classes=5, depth=3, embed_dim=8, num_heads=1,
+              stage_starts=(1,), initial_kv_stride=(1, 2, 2),
+              drop_path_rate=0.0, dropout_rate=0.0)
+    x = jnp.zeros((1, 8, 32, 32, 3), jnp.float32)
+    variables = fm.init(jax.random.key(0), x)
+    merged, report = load_pretrained(npz, variables)
+    assert any(p.startswith("params/pos_embed") for p in report["interpolated"]), report
+    assert "params/pos_embed" not in report["mismatched"]
+    assert report["kept"] == [], report["kept"]
+    pe = np.asarray(merged["params"]["pos_embed"])
+    assert pe.shape == (1, 4, 8, 8, 8)
+    # constant table resizes to the same constant (0.25 + 0.5)
+    np.testing.assert_allclose(pe, 0.75, rtol=1e-5)
+    # and the merged model runs at the new geometry
+    out = fm.apply({"params": merged["params"]}, x)
+    assert out.shape == (1, 5)
+
+
+def test_pos_embed_downscale_matches_torch_interpolate():
+    """Downscaling must match torch's trilinear F.interpolate (align_corners
+    False, NO antialiasing) — the convention ViT-family fine-tune recipes
+    were validated with."""
+    from pytorchvideo_accelerate_tpu.models.convert import load_pretrained
+
+    rng = np.random.default_rng(9)
+    src = rng.standard_normal((1, 4, 8, 8, 8)).astype(np.float32)
+    with torch.no_grad():
+        ref = F.interpolate(
+            torch.from_numpy(src).permute(0, 4, 1, 2, 3), size=(2, 4, 4),
+            mode="trilinear", align_corners=False,
+        ).permute(0, 2, 3, 4, 1).numpy()
+
+    got = np.asarray(jax.image.resize(
+        jnp.asarray(src), (1, 2, 4, 4, 8), "trilinear", antialias=False))
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
