@@ -1,0 +1,133 @@
+"""bench.py parent orchestration: probe-gated device benching, smoke
+fallback, mid-round and late tunnel recovery, trainer-mode selection —
+locked with fake probes/children (no jax, no subprocesses)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture()
+def bench(monkeypatch, tmp_path):
+    spec = importlib.util.spec_from_file_location(
+        "bench_orch", os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["bench_orch"] = mod
+    spec.loader.exec_module(mod)
+    # keep artifacts out of the repo root and the probe log quiet
+    monkeypatch.setattr(mod, "HERE", str(tmp_path))
+    # main() hard-exits after the JSON line; tests need to keep running
+    monkeypatch.setattr(mod.os, "_exit", lambda code: None)
+    monkeypatch.setattr(mod, "_setup_jax", lambda smoke: None)
+    return mod
+
+
+def _fake_child(calls, device_results=None):
+    """run_child stub: records (target, smoke) and returns a canned result."""
+    device_results = device_results or {}
+
+    def run_child(target, args, smoke, timeout):
+        calls.append((target, bool(smoke)))
+        if target == "__trainer__":
+            return {"trainer_cps_chip": 10.0, "smoke": bool(smoke)}
+        if smoke:
+            return {"clips_per_sec_per_chip": 1.0, "platform": "cpu",
+                    "smoke": True, "frames": 8, "crop": 64}
+        return device_results.get(target) or {
+            "clips_per_sec_per_chip": 50.0, "platform": "tpu",
+            "smoke": False, "frames": 32, "crop": 256}
+
+    return run_child
+
+
+def _run_main(bench, monkeypatch, argv, probe_script, calls,
+              device_results=None):
+    """Drive bench.main() with scripted probe outcomes; returns final JSON."""
+    seq = list(probe_script)
+
+    def probe(attempts, timeout=0):
+        ok = seq.pop(0) if seq else seq_last[0]
+        seq_last[0] = ok
+        attempts.append({"ts": "t", "ok": ok, "timeout_s": timeout})
+        return ok
+
+    seq_last = [probe_script[-1] if probe_script else False]
+    monkeypatch.setattr(bench, "probe_device", probe)
+    monkeypatch.setattr(bench, "run_child",
+                        _fake_child(calls, device_results))
+    monkeypatch.setattr(sys, "argv", ["bench.py", "--no-data"] + argv)
+    import io
+    import contextlib
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        bench.main()
+    return json.loads(buf.getvalue().strip().splitlines()[-1])
+
+
+def test_healthy_device_runs_everything_on_device(bench, monkeypatch):
+    calls = []
+    out = _run_main(bench, monkeypatch,
+                    ["--models", "slowfast_r50,x3d_s"], [True], calls)
+    assert out["value"] == 50.0
+    assert "error" not in out
+    assert ("slowfast_r50", False) in calls and ("x3d_s", False) in calls
+    # trainer compared same-mode (device)
+    assert ("__trainer__", False) in calls
+
+
+def test_dead_tunnel_all_round_is_flagged_with_probe_trail(bench, monkeypatch):
+    calls = []
+    out = _run_main(bench, monkeypatch,
+                    ["--models", "slowfast_r50,x3d_s"],
+                    [False, False, False], calls)
+    assert out["suspect"] is True
+    assert "device number" in out["error"]
+    assert all(smoke for _, smoke in calls if _ != "__trainer__")
+    assert len(out["probe_attempts"]) >= 2  # initial + re-probe(s)
+    assert not any(a["ok"] for a in out["probe_attempts"])
+
+
+def test_late_recovery_retries_smoke_models_on_device(bench, monkeypatch):
+    calls = []
+    # dead at start and between models; alive at the late-recovery probe
+    out = _run_main(bench, monkeypatch,
+                    ["--models", "slowfast_r50,x3d_s"],
+                    [False, False, True], calls)
+    assert out["value"] == 50.0  # flagship retried on the recovered device
+    assert "error" not in out
+    assert ("slowfast_r50", True) in calls     # first pass: smoke
+    assert ("slowfast_r50", False) in calls    # retry: device
+    assert "slowfast_r50__smoke_fallback" in out["models"]
+    assert out["models"]["slowfast_r50"]["platform"] == "tpu"
+
+
+def test_mid_round_device_failure_falls_back_and_flags(bench, monkeypatch):
+    calls = []
+    # device probes OK, but the flagship's device child errors out; the
+    # follow-up probes fail -> rest of the round runs smoke, flagged
+    out = _run_main(
+        bench, monkeypatch, ["--models", "slowfast_r50,x3d_s"],
+        [True, False, False], calls,
+        device_results={"slowfast_r50": {"error": "child timeout after 900s",
+                                         "smoke": False}})
+    assert ("slowfast_r50", False) in calls  # attempted on device
+    assert ("slowfast_r50", True) in calls   # smoke fallback recorded
+    assert "slowfast_r50__device_error" in out["models"]
+    assert out["suspect"] is True  # flagship number is a smoke number
+    assert out["models"]["slowfast_r50"]["platform"] == "cpu"
+
+
+def test_trainer_skipped_model_list_still_uses_device(bench, monkeypatch):
+    calls = []
+    out = _run_main(bench, monkeypatch, ["--models", "x3d_s"], [True], calls)
+    # no slowfast result exists; trainer must still run on the healthy
+    # device, not silently in smoke mode
+    assert ("__trainer__", False) in calls
+    assert "trainer_cps_chip" in out
+    assert "trainer_vs_rawstep" not in out  # no same-mode flagship to compare
