@@ -21,10 +21,19 @@ def bench(monkeypatch, tmp_path):
     spec.loader.exec_module(mod)
     # keep artifacts out of the repo root and the probe log quiet
     monkeypatch.setattr(mod, "HERE", str(tmp_path))
-    # main() hard-exits after the JSON line; tests need to keep running
-    monkeypatch.setattr(mod.os, "_exit", lambda code: None)
+    # main() hard-exits after the JSON line. Patch _exit to RAISE (confined
+    # to _run_main's catch) rather than no-op: a no-op would disable
+    # os._exit process-wide for anything else running during the test and
+    # couldn't detect main() dropping the call.
+    monkeypatch.setattr(mod.os, "_exit",
+                        lambda code: (_ for _ in ()).throw(_ExitCalled(code)))
     monkeypatch.setattr(mod, "_setup_jax", lambda smoke: None)
     return mod
+
+
+class _ExitCalled(BaseException):
+    def __init__(self, code):
+        self.code = code
 
 
 def _fake_child(calls, device_results=None):
@@ -66,7 +75,11 @@ def _run_main(bench, monkeypatch, argv, probe_script, calls,
 
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
-        bench.main()
+        try:
+            bench.main()
+            raise AssertionError("main() returned without calling os._exit")
+        except _ExitCalled as e:
+            assert e.code == 0
     return json.loads(buf.getvalue().strip().splitlines()[-1])
 
 
