@@ -73,6 +73,9 @@ class DataConfig:
     # multi-view val: views/video with view-averaged logits (the reference's
     # uniform clip-tiling eval, run.py:163); 1 = single center clip
     eval_num_clips: int = 1
+    # spatial crops per temporal view (uniform_crop along the longer side);
+    # the SlowFast/X3D papers' 30-view protocol = 10 clips x 3 crops
+    eval_num_spatial_crops: int = 1
     limit_train_batches: int = -1  # run.py:385
     limit_val_batches: int = -1
 
@@ -227,6 +230,7 @@ _REFERENCE_ALIASES = {
     "synthetic": "data.synthetic",
     "cache_dir": "data.cache_dir",
     "eval_num_clips": "data.eval_num_clips",
+    "eval_num_spatial_crops": "data.eval_num_spatial_crops",
     "trackers": "tracking.trackers",
 }
 

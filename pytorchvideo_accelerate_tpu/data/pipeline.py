@@ -65,18 +65,32 @@ def sample_views(read_span: Callable, transform: Callable, duration: float,
                  rng: np.random.Generator, num_clips: int) -> Dict[str, np.ndarray]:
     """Shared span-selection + multi-view stacking for every clip source.
 
-    Train: ONE random span. Eval: `num_clips` evenly-spaced spans, each
-    transformed and stacked on a leading view axis (the eval step
+    Train: ONE random span. Eval: `num_clips` evenly-spaced spans — times
+    the transform's `num_spatial_crops` when it declares one (the papers'
+    30-view protocol: 10 temporal x 3 spatial) — each transformed and
+    stacked on ONE leading view axis, temporal-major (the eval step
     view-averages the logits; reference uniform tiling, run.py:163).
     `read_span(start_sec, end_sec) -> (T, H, W, 3) uint8`.
     """
+    # training transforms can't carry spatial crops (make_transform forbids
+    # it), so the attribute alone decides — this also serves sources that
+    # use train-style random spans with an eval transform (SyntheticClipSource
+    # at num_clips=1)
+    n_spatial = max(getattr(transform, "num_spatial_crops", 1), 1)
     if training:
         spans = [random_clip(duration, clip_duration, rng)]
-        single = True
+        single = n_spatial == 1
     else:
         spans = uniform_clips(duration, clip_duration, num_clips)
-        single = num_clips == 1
-    views = [transform(read_span(s.start, s.end), rng) for s in spans]
+        single = num_clips == 1 and n_spatial == 1
+    if n_spatial > 1:
+        # decode AND pre-crop once per span; spatial_views applies the
+        # n_spatial crops to the shared scaled frames
+        views = []
+        for s in spans:
+            views.extend(transform.spatial_views(read_span(s.start, s.end)))
+    else:
+        views = [transform(read_span(s.start, s.end), rng) for s in spans]
     if single:
         return views[0]
     return {k: np.stack([v[k] for v in views]) for k in views[0]}

@@ -85,6 +85,24 @@ def center_crop(frames: np.ndarray, size: int) -> np.ndarray:
     return frames[:, top : top + size, left : left + size]
 
 
+def uniform_crop(frames: np.ndarray, size: int, spatial_idx: int,
+                 num_crops: int = 3) -> np.ndarray:
+    """Crop `size`^2 at position `spatial_idx` of `num_crops` evenly-spaced
+    positions along the LONGER spatial side (short side centered) —
+    pytorchvideo `uniform_crop` semantics, the spatial half of the
+    SlowFast/X3D papers' 30-view eval protocol (10 temporal x 3 spatial)."""
+    h, w = frames.shape[1:3]
+    if num_crops == 1:
+        return center_crop(frames, size)
+    if h <= w:  # landscape: slide along width
+        top = (h - size) // 2
+        left = int(round((w - size) * spatial_idx / (num_crops - 1)))
+    else:  # portrait: slide along height
+        top = int(round((h - size) * spatial_idx / (num_crops - 1)))
+        left = (w - size) // 2
+    return frames[:, top : top + size, left : left + size]
+
+
 def random_crop(frames: np.ndarray, size: int, rng: np.random.Generator) -> np.ndarray:
     h, w = frames.shape[1:3]
     top = int(rng.integers(0, h - size + 1))
@@ -118,11 +136,19 @@ def make_transform(
     std: Sequence[float] = (0.225, 0.225, 0.225),
     horizontal_flip_p: float = 0.5,
     output_dtype: str = "float32",
+    num_spatial_crops: int = 1,
 ) -> Callable[[np.ndarray, Optional[np.random.Generator]], Dict[str, np.ndarray]]:
     """Build the full clip transform (reference make_transform, run.py:68-102).
 
     Returns `fn(frames_uint8_THWC, rng) -> {"video": ...}` or
     `{"slow": ..., "fast": ...}` (contiguous).
+
+    `num_spatial_crops > 1` (eval only): the transform takes an extra
+    `spatial_idx` argument selecting one of the evenly-spaced crops along
+    the longer side (`uniform_crop`); `sample_views` multiplies temporal
+    views by these spatial views — the papers' 30-view protocol is
+    `eval_num_clips=10` x `eval_num_spatial_crops=3`. The callable's view
+    count is exposed as `fn.num_spatial_crops`.
 
     `output_dtype="bfloat16"` casts the final clip on the host: the model
     casts inputs to its compute dtype anyway (models/common.py), so the cast
@@ -137,21 +163,18 @@ def make_transform(
 
         out_dtype = np.dtype(getattr(ml_dtypes, output_dtype))
 
-    def transform(frames: np.ndarray, rng: Optional[np.random.Generator] = None):
-        if training and rng is None:
-            raise ValueError("training transform requires an rng")
+    if num_spatial_crops < 1:
+        raise ValueError(f"num_spatial_crops must be >= 1, got {num_spatial_crops}")
+    if training and num_spatial_crops != 1:
+        raise ValueError("num_spatial_crops is an eval-only option")
+
+    def _precrop_eval(frames: np.ndarray) -> np.ndarray:
         x = uniform_temporal_subsample(frames, num_frames)
         x = div255(x)
         x = normalize(x, mean, std)
-        if training:
-            x = random_short_side_scale(
-                x, min_short_side_scale, max_short_side_scale, rng
-            )
-            x = random_crop(x, crop_size, rng)
-            x = horizontal_flip(x, horizontal_flip_p, rng)
-        else:
-            x = short_side_scale(x, min_short_side_scale)
-            x = center_crop(x, crop_size)
+        return short_side_scale(x, min_short_side_scale)
+
+    def _finalize(x: np.ndarray) -> Dict[str, np.ndarray]:
         # astype on a sliced view already allocates contiguous output, so
         # cast first: one copy total in both modes
         if is_slowfast:
@@ -160,4 +183,39 @@ def make_transform(
                     for k, v in out.items()}
         return {"video": np.ascontiguousarray(x.astype(out_dtype, copy=False))}
 
+    def transform(frames: np.ndarray,
+                  rng: Optional[np.random.Generator] = None,
+                  spatial_idx: Optional[int] = None):
+        if training and rng is None:
+            raise ValueError("training transform requires an rng")
+        if training:
+            x = uniform_temporal_subsample(frames, num_frames)
+            x = div255(x)
+            x = normalize(x, mean, std)
+            x = random_short_side_scale(
+                x, min_short_side_scale, max_short_side_scale, rng
+            )
+            x = random_crop(x, crop_size, rng)
+            x = horizontal_flip(x, horizontal_flip_p, rng)
+        else:
+            x = _precrop_eval(frames)
+            if num_spatial_crops > 1:
+                x = uniform_crop(x, crop_size,
+                                 0 if spatial_idx is None else spatial_idx,
+                                 num_spatial_crops)
+            else:
+                x = center_crop(x, crop_size)
+        return _finalize(x)
+
+    if num_spatial_crops > 1:
+        def spatial_views(frames: np.ndarray):
+            """All spatial crops of one span, sharing ONE pre-crop pass
+            (subsample/normalize/scale dominate eval host cost — running
+            them per crop would triple the hot path)."""
+            x = _precrop_eval(frames)
+            return [_finalize(uniform_crop(x, crop_size, j, num_spatial_crops))
+                    for j in range(num_spatial_crops)]
+
+        transform.spatial_views = spatial_views
+    transform.num_spatial_crops = num_spatial_crops
     return transform

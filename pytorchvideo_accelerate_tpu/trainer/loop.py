@@ -170,13 +170,17 @@ class Trainer:
             output_dtype="bfloat16" if bf16 else "float32",
         )
         train_tf = make_transform(training=True, **common)
-        val_tf = make_transform(training=False, **common)
 
         # multi-view eval is supervised-only: the pretrain eval step scores
         # reconstructions clip-by-clip, so a view axis would just crash it
         eval_clips = 1 if self.is_pretraining else d.eval_num_clips
-        if self.is_pretraining and d.eval_num_clips > 1:
-            main_print("eval_num_clips ignored for self-supervised pretraining")
+        eval_spatial = 1 if self.is_pretraining else d.eval_num_spatial_crops
+        if self.is_pretraining and (d.eval_num_clips > 1
+                                    or d.eval_num_spatial_crops > 1):
+            main_print("multi-view eval options ignored for self-supervised "
+                       "pretraining")
+        val_tf = make_transform(training=False,
+                                num_spatial_crops=eval_spatial, **common)
 
         if d.synthetic:
             num_classes = cfg.model.num_classes or 4

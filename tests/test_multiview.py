@@ -106,3 +106,80 @@ class TestViewAveragedEval:
         res = tr.fit()
         assert np.isfinite(res["train_loss"])
         assert 0.0 <= res["val_accuracy"] <= 1.0
+
+
+class TestSpatialCrops:
+    """3-crop spatial views (uniform_crop): the spatial half of the papers'
+    30-view protocol, multiplying the temporal views."""
+
+    def test_uniform_crop_positions_landscape(self):
+        from pytorchvideo_accelerate_tpu.data.transforms import (
+            center_crop, uniform_crop,
+        )
+
+        frames = np.arange(2 * 8 * 20 * 1, dtype=np.float32).reshape(2, 8, 20, 1)
+        left = uniform_crop(frames, 8, 0)
+        mid = uniform_crop(frames, 8, 1)
+        right = uniform_crop(frames, 8, 2)
+        np.testing.assert_array_equal(left, frames[:, :, 0:8])
+        np.testing.assert_array_equal(mid, frames[:, :, 6:14])
+        np.testing.assert_array_equal(right, frames[:, :, 12:20])
+        np.testing.assert_array_equal(mid, center_crop(frames, 8))
+
+    def test_uniform_crop_positions_portrait(self):
+        from pytorchvideo_accelerate_tpu.data.transforms import uniform_crop
+
+        frames = np.zeros((2, 20, 8, 1), np.float32)
+        frames[:, 15:, :, :] = 1.0
+        bottom = uniform_crop(frames, 8, 2)
+        assert bottom.shape == (2, 8, 8, 1)
+        assert bottom[:, -8:].mean() > 0.5  # slid to the bottom band
+
+    def test_source_stacks_temporal_x_spatial(self):
+        tf = _tf(num_spatial_crops=3)
+        src = SyntheticClipSource(tf, num_videos=4, num_classes=2, num_clips=2)
+        s = src.get(0, 0)
+        assert s["video"].shape == (6, 4, 32, 32, 3)  # 2 temporal x 3 spatial
+        # spatial-only multi-view still gets a view axis
+        src1 = SyntheticClipSource(tf, num_videos=4, num_classes=2)
+        assert src1.get(0, 0)["video"].shape == (3, 4, 32, 32, 3)
+
+    def test_training_rejects_spatial_crops(self):
+        import pytest
+
+        with pytest.raises(ValueError, match="eval-only"):
+            make_transform(training=True, num_spatial_crops=3)
+
+    def test_eval_step_averages_six_views(self, devices8):
+        mesh = make_mesh(MeshConfig(data=8), devices=devices8)
+        model = SlowR50(num_classes=4, depths=(1, 1, 1, 1), stem_features=8,
+                        dropout_rate=0.0)
+        variables = model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+        tx = build_optimizer(OptimConfig(), total_steps=2)
+        state = TrainState.create(variables["params"],
+                                  variables["batch_stats"], tx)
+        step = make_eval_step(model, mesh)
+        rng = np.random.default_rng(0)
+        batch = {
+            "video": rng.standard_normal((8, 6, 4, 32, 32, 3)).astype(np.float32),
+            "label": rng.integers(0, 4, 8).astype(np.int32),
+        }
+        out = step(state, shard_batch(mesh, batch))
+        assert float(out["count"]) == 8.0  # videos, not views
+
+    def test_invalid_spatial_crop_count_rejected(self):
+        import pytest
+
+        with pytest.raises(ValueError, match=">= 1"):
+            make_transform(training=False, num_spatial_crops=0)
+
+    def test_spatial_views_shares_precrop_with_per_index_calls(self):
+        """transform.spatial_views(frames) == [transform(frames, idx=j)]:
+        the shared-precrop fast path must not change the crops."""
+        tf = _tf(num_spatial_crops=3)
+        rng = np.random.default_rng(0)
+        frames = rng.integers(0, 255, (8, 40, 60, 3), dtype=np.uint8)
+        fast = tf.spatial_views(frames)
+        for j, v in enumerate(fast):
+            slow = tf(frames, None, j)
+            np.testing.assert_array_equal(v["video"], slow["video"])
