@@ -79,10 +79,8 @@ def sample_views(read_span: Callable, transform: Callable, duration: float,
     n_spatial = max(getattr(transform, "num_spatial_crops", 1), 1)
     if training:
         spans = [random_clip(duration, clip_duration, rng)]
-        single = n_spatial == 1
     else:
         spans = uniform_clips(duration, clip_duration, num_clips)
-        single = num_clips == 1 and n_spatial == 1
     if n_spatial > 1:
         # decode AND pre-crop once per span; spatial_views applies the
         # n_spatial crops to the shared scaled frames
@@ -91,7 +89,7 @@ def sample_views(read_span: Callable, transform: Callable, duration: float,
             views.extend(transform.spatial_views(read_span(s.start, s.end)))
     else:
         views = [transform(read_span(s.start, s.end), rng) for s in spans]
-    if single:
+    if len(views) == 1:  # no view axis for the single-view case
         return views[0]
     return {k: np.stack([v[k] for v in views]) for k in views[0]}
 

@@ -125,6 +125,17 @@ class TestSpatialCrops:
         np.testing.assert_array_equal(mid, frames[:, :, 6:14])
         np.testing.assert_array_equal(right, frames[:, :, 12:20])
         np.testing.assert_array_equal(mid, center_crop(frames, 8))
+        # odd delta: center offset is ceil (pytorchvideo uniform_crop), one
+        # px right of center_crop's floor
+        odd = np.arange(2 * 8 * 17 * 1, dtype=np.float32).reshape(2, 8, 17, 1)
+        np.testing.assert_array_equal(uniform_crop(odd, 8, 1),
+                                      odd[:, :, 5:13])  # ceil(9/2) = 5
+        # and no index on a multi-crop transform means CENTER, not left
+        tf3 = _tf(num_spatial_crops=3)
+        rng = np.random.default_rng(1)
+        raw = rng.integers(0, 255, (8, 40, 60, 3), dtype=np.uint8)
+        np.testing.assert_array_equal(tf3(raw)["video"],
+                                      tf3(raw, None, 1)["video"])
 
     def test_uniform_crop_positions_portrait(self):
         from pytorchvideo_accelerate_tpu.data.transforms import uniform_crop

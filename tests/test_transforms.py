@@ -144,3 +144,17 @@ def test_bf16_output_matches_fp32_cast():
         assert b[k].dtype == np.dtype(ml_dtypes.bfloat16)
         np.testing.assert_array_equal(
             a[k].astype(ml_dtypes.bfloat16), b[k])
+
+
+def test_normalize_u8_matches_unfused_pair():
+    """The fused hot path must equal normalize(div255(x)) within float
+    rounding for uint8 input — it's the same math refactored."""
+    from pytorchvideo_accelerate_tpu.data.transforms import normalize_u8
+
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 256, (4, 24, 32, 3), dtype=np.uint8)
+    mean, std = (0.45, 0.43, 0.41), (0.225, 0.24, 0.26)
+    a = normalize(div255(frames), mean, std)
+    b = normalize_u8(frames, mean, std)
+    assert b.dtype == np.float32
+    np.testing.assert_allclose(b, a, atol=2e-6)
