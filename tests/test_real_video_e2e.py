@@ -101,7 +101,9 @@ def test_slowfast_overfits_real_videos_and_multiview_evaluates(
 
 def test_evaluate_scores_real_videos_multiview(video_tree, tmp_path):
     """--eval_only on the real tree: checkpoint from a short fit, then
-    multi-view evaluate() must reproduce the fit-time accuracy."""
+    multi-view evaluate() — 3 temporal x 3 spatial = 9 views per video,
+    both view axes through real decoded bytes — must reproduce the
+    fit-time accuracy."""
     common = [
         "--data_dir", video_tree,
         "--is_slowfast", "--model.slowfast_alpha", "4",
@@ -110,6 +112,7 @@ def test_evaluate_scores_real_videos_multiview(video_tree, tmp_path):
         "--data.min_short_side_scale", "36", "--data.max_short_side_scale", "44",
         "--data.batch_size", "1", "--data.num_workers", "2",
         "--data.eval_num_clips", "3",
+        "--data.eval_num_spatial_crops", "3",
         "--model.num_classes", "0", "--model.dropout_rate", "0",
         "--optim.lr", "0.02", "--optim.weight_decay", "0",
         "--checkpoint.output_dir", str(tmp_path),
