@@ -222,6 +222,10 @@ def torch_key_for(collection: str, path: Path, model: str) -> Optional[str]:
 # norm_b is `Sequential(BN, SqueezeExcitation(fc1, fc2))` on SE blocks
 # (keys norm_b.0.* / norm_b.1.fc{1,2}.*) and a plain BN otherwise; blocks.5
 # head = ProjectedPool(pre_conv/pre_norm/post_conv) + proj linear.
+# create_x3d_res_block quirk: branch1_conv exists on stride OR channel
+# change but branch1_norm ONLY on channel change — stage-1 block 0 of the
+# hub checkpoints (24->24, stride 2) is a bare shortcut conv (models/x3d.py
+# mirrors this; full-depth key coverage in tests/hub_manifests.py).
 
 _X3D_STEM = {"conv.conv_t": ("stem_xy", "kernel"),
              "conv.conv_xy": ("stem_t", "kernel")}
@@ -347,7 +351,11 @@ def x3d_torch_key_for(collection: str, path: Path) -> Optional[str]:
 # pos embeds (pos_embed_spatial (1,HW,C) + pos_embed_temporal (1,T,C) +
 # pos_embed_class); blocks.i = MultiScaleBlock(norm1, attn(qkv, pool_q/
 # norm_q, pool_k/norm_k, pool_v/norm_v, proj), norm2, mlp.fc1/fc2, proj on
-# dim-change blocks); final norm; head.proj.
+# dim-change blocks); final norm; head.proj. pool_q exists only at
+# stage-start (q-stride) blocks, but pool_k/pool_v exist at EVERY block —
+# the 3^3 pool_kvq_kernel applies globally once adaptive kv striding is
+# configured, stride-1 last-stage blocks included (mvit.py kv_pool_always;
+# full-depth key coverage in tests/hub_manifests.py).
 #
 # Documented deviations of the flax MViT (mvit.py module docstring) and how
 # conversion handles them:

@@ -58,12 +58,18 @@ class PoolHeads(nn.Module):
     channels: int
     stride: Tuple[int, int, int]
     head_dim: int = 0  # 0 = single group (heads*head_dim normed jointly)
+    always: bool = False  # pool even at unit stride (pytorchvideo K/V pools)
     depthwise_impl: str = "conv"
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        if self.stride == (1, 1, 1):
+        # pytorchvideo passes the 3^3 pool_kvq_kernel to EVERY block once
+        # adaptive kv pooling is configured, so the hub checkpoints carry
+        # stride-1 pool_k/pool_v convs in the last stage (blocks 14-15 of
+        # MViT-B) — `always` keeps those blocks faithful and convertible.
+        # Q pooling has no such kernel on non-stage-start blocks: absent.
+        if self.stride == (1, 1, 1) and not self.always:
             return x
         # fixed 3x3x3 pooling kernel at any stride — pytorchvideo's
         # `pool_kvq_kernel` constant; also keeps the depthwise conv cheap and
@@ -90,6 +96,7 @@ class MultiScaleAttention(nn.Module):
     num_heads: int
     q_stride: Tuple[int, int, int] = (1, 1, 1)
     kv_stride: Tuple[int, int, int] = (1, 1, 1)
+    kv_pool_always: bool = True  # pytorchvideo adaptive-kv: pool all blocks
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
     context_mesh: Optional[Any] = None
@@ -104,11 +111,16 @@ class MultiScaleAttention(nn.Module):
 
         head_dim = self.dim_out // self.num_heads
         q = PoolHeads(self.dim_out, self.q_stride, head_dim,
-                      self.depthwise_impl, self.dtype, name="pool_q")(q)
+                      depthwise_impl=self.depthwise_impl, dtype=self.dtype,
+                      name="pool_q")(q)
         k = PoolHeads(self.dim_out, self.kv_stride, head_dim,
-                      self.depthwise_impl, self.dtype, name="pool_k")(k)
+                      always=self.kv_pool_always,
+                      depthwise_impl=self.depthwise_impl, dtype=self.dtype,
+                      name="pool_k")(k)
         v = PoolHeads(self.dim_out, self.kv_stride, head_dim,
-                      self.depthwise_impl, self.dtype, name="pool_v")(v)
+                      always=self.kv_pool_always,
+                      depthwise_impl=self.depthwise_impl, dtype=self.dtype,
+                      name="pool_v")(v)
 
         tq, hq, wq = q.shape[1:4]
 

@@ -84,9 +84,15 @@ class X3DBlock(nn.Module):
         y = ConvBNAct(self.features_out, kernel=(1, 1, 1), act=None,
                       dtype=self.dtype, name="conv_c")(y, train)
         if residual.shape[-1] != self.features_out or self.spatial_stride != 1:
+            # pytorchvideo x3d.py quirk (create_x3d_res_block): the shortcut
+            # conv appears for stride OR channel change, but its BN only for
+            # channel change — stage-1 block 0 (24->24, stride 2) in the hub
+            # X3D checkpoints has branch1_conv with NO branch1_norm
             residual = ConvBNAct(self.features_out, kernel=(1, 1, 1),
                                  stride=(1, self.spatial_stride, self.spatial_stride),
-                                 act=None, dtype=self.dtype, name="branch1")(residual, train)
+                                 act=None, dtype=self.dtype,
+                                 use_bn=residual.shape[-1] != self.features_out,
+                                 name="branch1")(residual, train)
         return nn.relu(residual + y)
 
 

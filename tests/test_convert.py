@@ -361,6 +361,15 @@ class TestMViTConvert:
             "blocks.1.attn.pool_q.weight": randn(8, 1, 3, 3, 3),
             "blocks.1.attn.norm_q.weight": randn(8),
             "blocks.1.attn.norm_q.bias": randn(8),
+            # kv stride is (1,1,1) here but pytorchvideo still pools K/V
+            # (the 3^3 pool_kvq_kernel applies to every block once adaptive
+            # kv striding is configured) — real checkpoints carry these
+            "blocks.1.attn.pool_k.weight": randn(8, 1, 3, 3, 3),
+            "blocks.1.attn.norm_k.weight": randn(8),
+            "blocks.1.attn.norm_k.bias": randn(8),
+            "blocks.1.attn.pool_v.weight": randn(8, 1, 3, 3, 3),
+            "blocks.1.attn.norm_v.weight": randn(8),
+            "blocks.1.attn.norm_v.bias": randn(8),
             "blocks.1.attn.proj.weight": randn(32, 32),
             "blocks.1.attn.proj.bias": randn(32),
             "blocks.1.norm2.weight": randn(32), "blocks.1.norm2.bias": randn(32),

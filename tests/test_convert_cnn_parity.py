@@ -306,7 +306,11 @@ class TX3DBlock(nn.Module):
         if cin != cout or stride != 1:
             self.branch1_conv = nn.Conv3d(cin, cout, 1,
                                           stride=(1, stride, stride), bias=False)
-            self.branch1_norm = nn.BatchNorm3d(cout)
+            # pytorchvideo create_x3d_res_block: branch1_norm only on
+            # CHANNEL change — the stride-only shortcut (stage-1 block 0 of
+            # the hub checkpoints) is a bare conv
+            if cin != cout:
+                self.branch1_norm = nn.BatchNorm3d(cout)
         self.branch2 = nn.Module()
         self.branch2.conv_a = nn.Conv3d(cin, inner, 1, bias=False)
         self.branch2.norm_a = nn.BatchNorm3d(inner)
@@ -321,7 +325,9 @@ class TX3DBlock(nn.Module):
     def forward(self, x):
         res = x
         if hasattr(self, "branch1_conv"):
-            res = self.branch1_norm(self.branch1_conv(x))
+            res = self.branch1_conv(x)
+            if hasattr(self, "branch1_norm"):
+                res = self.branch1_norm(res)
         b = self.branch2
         y = F.relu(b.norm_a(b.conv_a(x)))
         y = b.norm_b(b.conv_b(y))
@@ -437,13 +443,15 @@ class TMViTAttn(nn.Module):
             self.pool_q = nn.Conv3d(self.hd, self.hd, 3, stride=q_stride,
                                     padding=1, groups=self.hd, bias=False)
             self.norm_q = nn.LayerNorm(self.hd, eps=1e-6)
-        if kv_stride != (1, 1, 1):
-            self.pool_k = nn.Conv3d(self.hd, self.hd, 3, stride=kv_stride,
-                                    padding=1, groups=self.hd, bias=False)
-            self.norm_k = nn.LayerNorm(self.hd, eps=1e-6)
-            self.pool_v = nn.Conv3d(self.hd, self.hd, 3, stride=kv_stride,
-                                    padding=1, groups=self.hd, bias=False)
-            self.norm_v = nn.LayerNorm(self.hd, eps=1e-6)
+        # pytorchvideo hands the 3^3 pool_kvq_kernel to every block once
+        # adaptive kv pooling is configured: K/V pool convs exist at ALL
+        # blocks of the hub MViT-B, stride-1 last-stage blocks included
+        self.pool_k = nn.Conv3d(self.hd, self.hd, 3, stride=kv_stride,
+                                padding=1, groups=self.hd, bias=False)
+        self.norm_k = nn.LayerNorm(self.hd, eps=1e-6)
+        self.pool_v = nn.Conv3d(self.hd, self.hd, 3, stride=kv_stride,
+                                padding=1, groups=self.hd, bias=False)
+        self.norm_v = nn.LayerNorm(self.hd, eps=1e-6)
 
     def _pool(self, t, conv, norm, thw):
         # (B, h, L, hd) -> fold heads into batch -> conv on the grid -> LN
