@@ -1,0 +1,131 @@
+"""The matched-top-1 protocol, end-to-end in miniature (VERDICT r4 item 4).
+
+The reference's purpose is matched top-1 after fine-tuning from hub weights
+(run.py:105-118 loads the backbone, run.py:287-304 reports accuracy). The
+protocol for reproducing a torch checkpoint's accuracy here is two
+commands (documented in MIGRATING.md §9):
+
+    python -m pytorchvideo_accelerate_tpu.models.convert CKPT.pyth W.npz \
+        --model slowfast_r50
+    python -m pytorchvideo_accelerate_tpu.run --eval_only \
+        --data_dir DATA --is_slowfast ... \
+        --model.pretrained true --model.pretrained_path W.npz
+
+This test runs EXACTLY that pipeline on a tiny torch checkpoint (saved with
+torch.save, converted by the CLI) and a tiny real-video tree: the moment
+real Kinetics + real hub weights exist, the same two commands produce the
+real number. Asserts the eval is deterministic and that the converted
+weights are actually what got scored (fresh-init weights score differently).
+"""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+cv2 = pytest.importorskip("cv2")
+torch = pytest.importorskip("torch")
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+from test_convert_cnn_parity import TorchSlowFastTiny, _randomize  # noqa: E402
+
+from pytorchvideo_accelerate_tpu import run as run_mod  # noqa: E402
+from pytorchvideo_accelerate_tpu.models import convert  # noqa: E402
+
+FPS = 10.0
+SIZE = (64, 48)
+
+
+def _write_video(path, level, n_frames=16):
+    w = cv2.VideoWriter(path, cv2.VideoWriter_fourcc(*"mp4v"), FPS, SIZE)
+    if not w.isOpened():
+        pytest.skip("mp4v codec unavailable")
+    rng = np.random.default_rng(level)
+    for _ in range(n_frames):
+        frame = np.clip(level + rng.integers(-12, 12, (SIZE[1], SIZE[0], 3)),
+                        0, 255).astype(np.uint8)
+        w.write(frame)
+    w.release()
+
+
+@pytest.fixture(scope="module")
+def video_tree(tmp_path_factory):
+    root = tmp_path_factory.mktemp("k_top1")
+    for split, n in (("train", 2), ("val", 2)):
+        for cls, level in (("dark", 40), ("bright", 215)):
+            d = root / split / cls
+            d.mkdir(parents=True)
+            for v in range(n):
+                _write_video(str(d / f"v{v}.mp4"), level + v)
+    return str(root)
+
+
+@pytest.fixture()
+def tiny_registry(monkeypatch):
+    """The full-size hub architectures under test elsewhere; here the same
+    REGISTERED name resolves to the tiny variant matching the tiny torch
+    checkpoint, so the documented command line works verbatim."""
+    from pytorchvideo_accelerate_tpu import models
+    from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
+
+    def tiny(cfg, dtype):
+        return SlowFast(num_classes=cfg.num_classes, depths=(1, 1), alpha=2,
+                        beta_inv=4, stem_features=8,
+                        slow_temporal_kernels=(1, 3),
+                        dropout_rate=cfg.dropout_rate, dtype=dtype)
+
+    monkeypatch.setitem(models._REGISTRY, "slowfast_r50", tiny)
+
+
+def _eval_cmd(video_tree, tmp_path, npz=None):
+    argv = [
+        "--eval_only",
+        "--data_dir", video_tree,
+        "--is_slowfast", "--model.slowfast_alpha", "2",
+        "--data.num_frames", "8", "--data.sampling_rate", "1",
+        "--data.crop_size", "32",
+        "--data.min_short_side_scale", "36",
+        "--data.max_short_side_scale", "44",
+        "--data.batch_size", "1", "--data.num_workers", "2",
+        "--data.eval_num_clips", "2",  # multi-view protocol, in miniature
+        "--model.num_classes", "0",  # discovered from the tree (2)
+        "--model.dropout_rate", "0",
+        "--checkpoint.output_dir", str(tmp_path / "out"),
+    ]
+    if npz:
+        argv += ["--model.pretrained", "true",
+                 "--model.pretrained_path", npz]
+    return argv
+
+
+def test_convert_then_eval_only_scores_the_checkpoint(
+        video_tree, tmp_path, tiny_registry):
+    # 1. a "hub checkpoint": tiny torch SlowFast with a 2-class head, saved
+    # the way hub checkpoints arrive (torch.save of a state_dict)
+    tm = TorchSlowFastTiny(n_classes=2).eval()
+    _randomize(tm, 7)
+    pt = str(tmp_path / "hub.pth")
+    torch.save(tm.state_dict(), pt)
+
+    # 2. documented command 1: offline conversion CLI
+    npz = str(tmp_path / "w.npz")
+    convert.main([pt, npz, "--model", "slowfast_r50"])
+    assert os.path.exists(npz)
+
+    # 3. documented command 2: --eval_only scoring of the converted weights
+    res = run_mod.main(_eval_cmd(video_tree, tmp_path, npz))
+    assert set(res) >= {"val_accuracy", "val_accuracy_top5", "val_loss"}
+    assert 0.0 <= res["val_accuracy"] <= res["val_accuracy_top5"] <= 1.0
+    assert np.isfinite(res["val_loss"])
+
+    # the protocol is deterministic: same checkpoint -> same number
+    res2 = run_mod.main(_eval_cmd(video_tree, tmp_path, npz))
+    assert res2["val_loss"] == pytest.approx(res["val_loss"], rel=1e-5)
+    assert res2["val_accuracy"] == res["val_accuracy"]
+
+    # and the converted weights are what got scored: fresh-init weights
+    # (same seed, same data) produce a different loss
+    fresh = run_mod.main(_eval_cmd(video_tree, tmp_path))
+    assert fresh["val_loss"] != pytest.approx(res["val_loss"], rel=1e-3)
