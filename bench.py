@@ -1,12 +1,14 @@
 #!/usr/bin/env python
 """Benchmark harness: clips/sec/chip on the reference training workloads.
 
-Prints exactly ONE JSON line to stdout:
+Prints exactly ONE compact JSON line (<=1.5 KB — the driver captures only a
+~2 KB stdout tail) to stdout:
     {"metric": "...", "value": N, "unit": "clips/sec/chip", "vs_baseline": N,
-     "mfu": ..., "tflops_per_sec": ..., "step_ms_blocked": ...,
-     "models": {...}, "probe_attempts": [...]}
-(everything else goes to stderr). Runs on the attached TPU by default; pass
---smoke for a CPU-sized sanity run.
+     "mfu": ..., "tflops_per_sec": ..., "step_ms_blocked": ..., "suspect": B,
+     "models": {name: clips_per_sec}, "probes": {run,round,ok,last}}
+Full per-model dicts, probe timestamps, and the host data-pipeline blocks go
+to bench_partial.json (flushed throughout the run); logs go to stderr. Runs
+on the attached TPU by default; pass --smoke for a CPU-sized sanity run.
 
 Headline workload matches the reference launch recipe
 (run_slowfast_r50.sh:3-12, SURVEY §6): SlowFast-R50, 32 frames, 256^2 crops,
@@ -43,6 +45,7 @@ Self-audit (so impossible numbers can't pass unremarked):
 import argparse
 import datetime
 import json
+import math
 import os
 import signal
 import statistics
@@ -632,9 +635,14 @@ def main():
             dp["workers_to_feed_one_chip"] = round(chip_cps / per_worker, 1)
             dp["chip_demand_clips_per_sec"] = chip_cps
             dp["chip_demand_is_smoke"] = bool(flag.get("smoke"))
+        if loader_cps and dp.get("num_workers"):
+            dp["feed_projection"] = feed_projection(dp)
         flush_partial()
 
-    print(json.dumps(finalize(results, extras, user_smoke)))
+    headline = finalize(results, extras, user_smoke)
+    extras["headline"] = headline  # full record keeps the compact line too
+    flush_partial()
+    print(json.dumps(headline))
     sys.stdout.flush()
     sys.stderr.flush()
     # hard exit: stuck host-bench threads or lingering forked loader workers
@@ -642,8 +650,67 @@ def main():
     os._exit(0)
 
 
+def feed_projection(dp: dict) -> dict:
+    """The design consequence of the measured host-feed rates (VERDICT r4
+    weak 3): at plausible DEVICE training rates, how many decode workers /
+    host cores must feed ONE chip, on the live-decode path vs the
+    pre-decoded cache path?
+
+    Projected from this host's measured per-core loader throughput, not a
+    guess. Workers and cores are different resources: the per-worker rate
+    reflects GIL/core sharing at the measured worker:core ratio, while the
+    per-core rate assumes each core saturated — cores are the buyable
+    unit. The conclusion: live cv2 decode at reference geometry costs
+    multiple host cores per chip (scaling linearly with device rate)
+    where the cache read path costs well under one, so the pre-decoded
+    frame cache (data/cache.py) is MANDATORY at scale, not an
+    optimization. The cache-path number carries its own caveat: measured
+    on a page-cache-resident fixture, so it bounds CPU cost only, not
+    cold-storage bandwidth."""
+    cores = os.cpu_count() or 1
+    loader_cps = dp["loader_thread_clips_per_sec"]
+    cores_used = min(dp["num_workers"], cores)  # thread workers share cores
+    loader_cps_per_core = loader_cps / cores_used
+    cache_cps = dp.get("cache_clips_per_sec")
+    # cache bench runs 2 reader threads (cache.bench_decode_vs_cache)
+    cache_cps_per_core = cache_cps / min(2, cores) if cache_cps else None
+    per_worker = loader_cps / dp["num_workers"]
+    rows = []
+    for rate in (100, 200, 400):
+        row = {"device_clips_per_sec": rate,
+               "decode_workers_per_chip": math.ceil(rate / per_worker),
+               "decode_cores_per_chip": round(rate / loader_cps_per_core, 1)}
+        if cache_cps_per_core:
+            row["cache_cores_per_chip"] = round(rate / cache_cps_per_core, 2)
+        rows.append(row)
+    out = {
+        "basis": {"loader_clips_per_sec_per_core":
+                  round(loader_cps_per_core, 2),
+                  "measured_on_cores": cores,
+                  "cache_is_page_cache_resident": True},
+        "rows": rows,
+        "conclusion": ("live decode costs multiple host cores per chip, "
+                       "linear in device rate; the cache path costs <0.1 — "
+                       "pre-decoded cache (data/cache.py build + ClipLoader "
+                       "cache path) is mandatory at scale"),
+    }
+    return out
+
+
+# The driver captures only the trailing ~2000 bytes of stdout; a headline
+# line longer than that arrives truncated mid-line and parses as null
+# (BENCH_r04 casualty). Hard budget with headroom; enforced in finalize()
+# and locked by tests/test_bench_contract.py.
+MAX_LINE_BYTES = 1500
+
+
 def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
-    """Assemble the single JSON line from per-model results + extras."""
+    """Assemble the single compact JSON line from per-model results + extras.
+
+    The line carries headline numbers only (metric/value/mfu/suspect/error,
+    one scalar per model, probe counts); everything else — full per-model
+    dicts, probe timestamps, data-pipeline and transport blocks — lives in
+    bench_partial.json, which main() flushes throughout the run."""
     flag_name = "slowfast_r50"
     flag = results.get(flag_name, {})
     if "clips_per_sec_per_chip" not in flag:  # flagship failed: next best
@@ -673,29 +740,62 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         "tflops_per_sec": flag.get("tflops_per_sec_per_chip"),
         "mfu": flag.get("mfu"),
         "suspect": flag.get("suspect"),
-        "models": results,
+        # one scalar per model: clips/s/chip, or its error head
+        "models": {
+            n: (r["clips_per_sec_per_chip"]
+                if "clips_per_sec_per_chip" in r
+                else "err: " + str(r.get("error", "?"))[:40])
+            for n, r in results.items() if not n.endswith("__device_error")
+            and not n.endswith("__smoke_fallback")
+        },
+        "detail": "bench_partial.json",
     }
-    for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
-                "trainer_error", "data_pipeline", "transport_crossover",
-                "probe_attempts", "error"):
+    for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu"):
         if key in extras:
             out[key] = extras[key]
-    # whole-round probe evidence: .probe_log.jsonl accumulates every probe
-    # made this round (manual + bench), not just this invocation's
+    # error strings can be whole tracebacks: truncate on entry, every one
+    if "trainer_error" in extras:
+        out["trainer_error"] = str(extras["trainer_error"])[:200]
+    if "error" in extras:
+        out["error"] = str(extras["error"])[:280]
+    # probe evidence arrives as counts; timestamps live in bench_partial.json
+    # and .probe_log.jsonl (the whole-round log, manual + bench probes)
+    probes = list(extras.get("probe_attempts", []))
     try:
         with open(os.path.join(HERE, ".probe_log.jsonl")) as f:
-            lines = f.read().strip().splitlines()
-        out["probe_log_tail"] = [json.loads(ln) for ln in lines[-20:]]
+            round_log = [json.loads(ln)
+                         for ln in f.read().strip().splitlines() if ln]
     except (OSError, ValueError):
-        pass
+        round_log = []
+    if probes or round_log:
+        src = round_log or probes
+        out["probes"] = {"run": len(probes),
+                         "round": len(round_log),
+                         "ok": sum(1 for p in src if p.get("ok")),
+                         "last": src[-1].get("ts")}
     # missing platform covers error-only and empty flagship results too:
     # the driver must never read a silent zero as a real measurement
     if flag.get("platform", "cpu") == "cpu" and not user_smoke:
         out["suspect"] = True
         out["error"] = ("no trustworthy device number for the flagship "
-                        "(unreachable tunnel or failed bench — see "
-                        "probe_attempts and models); CPU/smoke values are "
-                        "not device numbers")
+                        "(unreachable tunnel or failed bench; see "
+                        "bench_partial.json + .probe_log.jsonl); CPU/smoke "
+                        "values are not device numbers")
+    # hard size guarantee: shed optional detail before ever exceeding the
+    # driver's capture window, ending with an unconditional last resort
+    if len(json.dumps(out)) > MAX_LINE_BYTES:
+        out["models"] = {"dropped": "see bench_partial.json"}
+    if len(json.dumps(out)) > MAX_LINE_BYTES:
+        out["metric"] = out["metric"][:100]
+        for k in ("error", "trainer_error"):
+            if k in out:
+                out[k] = out[k][:120]
+    for k in ("probes", "trainer_error", "trainer_mfu", "trainer_cps_chip",
+              "trainer_vs_rawstep", "detail", "step_ms_blocked",
+              "tflops_per_sec", "models"):  # drop one by one until it fits
+        if len(json.dumps(out)) <= MAX_LINE_BYTES:
+            break
+        out.pop(k, None)
     return out
 
 

@@ -47,7 +47,9 @@ def test_finalize_flagship_fallback_on_error():
     out = bench.finalize(models, {}, user_smoke=False)
     assert out["value"] == 42.0
     assert "x3d_s" in out["metric"]
-    assert out["models"]["slowfast_r50"]["error"] == "Timeout"
+    # compact per-model summary: scalar or error head, never the full dict
+    assert out["models"]["slowfast_r50"] == "err: Timeout"
+    assert out["models"]["x3d_s"] == 42.0
 
 
 def test_finalize_all_failed_is_flagged_not_silent():
@@ -67,7 +69,8 @@ def test_finalize_cpu_fallback_marks_suspect_and_error():
         user_smoke=False)
     assert out["suspect"] is True
     assert "device number" in out["error"]
-    assert out["data_pipeline"]["decode_clips_per_sec"] == 5
+    # bulky host-bench blocks stay in bench_partial.json, not the line
+    assert "data_pipeline" not in out
 
 
 def test_finalize_user_smoke_is_not_an_error():
@@ -85,7 +88,9 @@ def test_finalize_extras_passthrough():
         user_smoke=False)
     assert out["trainer_vs_rawstep"] == 0.934
     assert out["error"].startswith("watchdog")
-    assert out["probe_attempts"][0]["ok"] is True
+    # probes are summarized as counts; timestamps live off-line
+    assert out["probes"]["run"] == 1
+    assert "probe_attempts" not in out
 
 
 def test_finalize_json_serializable():
@@ -95,3 +100,57 @@ def test_finalize_json_serializable():
     line = json.dumps(out)
     assert "\n" not in line
     assert json.loads(line)["value"] == 100.0
+
+
+def test_feed_projection_draws_the_consequence():
+    """r4's measured rates (4 thread workers, 1 core: 22.55 loader clips/s,
+    57k page-cache-resident cache clips/s) must project to tens of decode
+    workers per chip at plausible device rates — the table VERDICT r4 asked
+    for, computed not narrated."""
+    dp = {"loader_thread_clips_per_sec": 22.55, "num_workers": 4,
+          "cache_clips_per_sec": 57134.0}
+    proj = bench.feed_projection(dp)
+    rows = {r["device_clips_per_sec"]: r for r in proj["rows"]}
+    assert set(rows) == {100, 200, 400}
+    # per-worker 5.64 clips/s -> 200 clips/s/chip needs ceil(200/5.64)=36
+    assert rows[200]["decode_workers_per_chip"] == 36
+    assert rows[400]["decode_workers_per_chip"] == 71
+    # cache path: orders of magnitude cheaper in CPU terms
+    assert rows[400]["cache_cores_per_chip"] < 1.0
+    assert proj["basis"]["cache_is_page_cache_resident"] is True
+    assert "mandatory" in proj["conclusion"]
+
+
+def test_finalize_line_fits_driver_capture():
+    """BENCH_r04 arrived `parsed: null` because the one-line JSON outgrew
+    the driver's ~2000-byte stdout tail capture. Lock the budget with a
+    worst-case payload: every workload present twice (device-error +
+    smoke-fallback variants), long error strings, a large probe history."""
+    import json
+
+    models = {}
+    for name in bench.WORKLOADS:
+        models.update(_model(name))
+        models[name + "__device_error"] = {
+            "error": "child timeout after 900s " + "x" * 200, "smoke": False}
+        models[name + "__smoke_fallback"] = _model(name)[name]
+    extras = {
+        "trainer_vs_rawstep": 0.934, "trainer_mfu": 0.1234,
+        "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
+        "error": "watchdog fired: " + "y" * 3000,
+        "probe_attempts": [
+            {"ts": f"2026-07-31T{i:02d}:00:00Z", "ok": False,
+             "error": "timeout (backend init wedged)", "timeout_s": 240,
+             "elapsed_s": 240.1} for i in range(40)],
+        "data_pipeline": {"decode_clips_per_sec": 62.4, "k": "v" * 300},
+        "transport_crossover": {"thread_clips_per_sec": 7.0, "k": "v" * 300},
+    }
+    out = bench.finalize(models, extras, user_smoke=False)
+    line = json.dumps(out)
+    assert "\n" not in line
+    assert len(line.encode()) <= bench.MAX_LINE_BYTES, len(line.encode())
+    parsed = json.loads(line)
+    assert parsed["value"] == 100.0
+    assert parsed["suspect"] is False
+    # fallback/error variants are folded out of the compact models map
+    assert set(parsed["models"]) == set(bench.WORKLOADS)
