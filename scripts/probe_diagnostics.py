@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""Enriched TPU-tunnel probe: capture WHY the device is unreachable, not
+just that it is (VERDICT r4 item 7).
+
+The standard probe (bench.probe_device) answers reachable-or-not; two
+rounds of it proved the axon tunnel can stay wedged for ~10 h without ever
+saying what layer is stuck. This probe records, once per invocation:
+
+  1. the PJRT/axon plugin environment (env vars, plugin + libtpu file facts);
+  2. loopback relay liveness: every 127.0.0.1 LISTEN socket, and whether a
+     TCP connect to it succeeds — distinguishes "relay process dead"
+     (connect refused) from "relay up, TPU backend wedged behind it"
+     (connect ok, init still hangs);
+  3. a VERBOSE init attempt (TPU_STDERR_LOG_LEVEL=0, TPU_MIN_LOG_LEVEL=0,
+     JAX debug logging) in a disposable subprocess, with the stderr tail
+     captured even when it has to be killed — whatever the plugin says
+     before wedging is the first actual diagnostic content of this failure.
+
+Appends one {"probe": "diagnostics", ...} record to .probe_log.jsonl and
+prints it; safe to run with the tunnel in any state (never touches devices
+in this process).
+"""
+
+import datetime
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+ENV_PREFIXES = ("TPU", "PJRT", "JAX", "XLA", "AXON", "PALLAS", "LIBTPU")
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%FT%TZ")
+
+
+def env_snapshot() -> dict:
+    return {k: v for k, v in sorted(os.environ.items())
+            if any(k.upper().startswith(p) or f"_{p}" in k.upper()
+                   for p in ENV_PREFIXES)}
+
+
+def file_facts() -> dict:
+    out = {}
+    for label, path in (
+            ("pjrt_plugin", os.environ.get("PJRT_LIBRARY_PATH", "")),
+            ("libtpu", os.environ.get("TPU_LIBRARY_PATH", ""))):
+        if not path:
+            out[label] = "env var unset"
+        elif os.path.exists(path):
+            st = os.stat(path)
+            out[label] = {"path": path, "bytes": st.st_size,
+                          "mtime": datetime.datetime.fromtimestamp(
+                              st.st_mtime).strftime("%FT%T")}
+        else:
+            out[label] = {"path": path, "missing": True}
+    return out
+
+
+def loopback_listeners() -> list:
+    """Every loopback LISTEN socket + a connect attempt to each: the axon
+    relay (AXON_POOL_SVC_OVERRIDE=127.0.0.1) must be one of these for the
+    tunnel to have any chance."""
+    ports = set()
+    try:
+        for row in open("/proc/net/tcp").read().splitlines()[1:]:
+            f = row.split()
+            ip, port = f[1].split(":")
+            if f[3] == "0A" and ip == "0100007F":  # LISTEN on 127.0.0.1
+                ports.add(int(port, 16))
+    except OSError as e:
+        return [{"error": f"/proc/net/tcp unreadable: {e}"}]
+    out = []
+    for port in sorted(ports):
+        rec = {"port": port}
+        t0 = time.perf_counter()
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2.0):
+                rec["connect"] = "ok"
+        except OSError as e:
+            rec["connect"] = f"{type(e).__name__}: {e}"
+        rec["connect_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        out.append(rec)
+    return out
+
+
+def verbose_init_attempt(timeout_s: int = 120, tail_bytes: int = 4000) -> dict:
+    """jax.devices() under maximum plugin verbosity, killed on timeout with
+    the stderr tail preserved (Popen + pipe: communicate() would discard it
+    on TimeoutExpired for a killed process group)."""
+    env = dict(os.environ)
+    env.update(
+        TPU_STDERR_LOG_LEVEL="0",   # INFO and up to stderr
+        TPU_MIN_LOG_LEVEL="0",
+        TPU_VMODULE="*=1",
+        JAX_LOGGING_LEVEL="DEBUG",
+        PYTHONUNBUFFERED="1",
+    )
+    code = ("import jax\n"
+            "ds = jax.devices()\n"
+            "print('DEVICES:', [(d.platform, d.device_kind) for d in ds])\n")
+    err_path = os.path.join(HERE, ".probe_verbose_stderr.txt")
+    rec = {"timeout_s": timeout_s}
+    t0 = time.time()
+    with open(err_path, "wb") as errf:
+        p = subprocess.Popen([sys.executable, "-c", code], env=env,
+                             stdout=subprocess.PIPE, stderr=errf,
+                             text=True, start_new_session=True)
+        try:
+            out, _ = p.communicate(timeout=timeout_s)
+            rec.update(ok=p.returncode == 0, returncode=p.returncode,
+                       stdout=(out or "").strip()[-300:])
+        except subprocess.TimeoutExpired:
+            try:
+                os.killpg(p.pid, signal.SIGKILL)
+            except OSError:
+                pass
+            p.wait()
+            rec.update(ok=False, error="timeout (killed)")
+    rec["elapsed_s"] = round(time.time() - t0, 1)
+    try:
+        with open(err_path, "rb") as f:
+            data = f.read()
+        rec["stderr_bytes"] = len(data)
+        rec["stderr_tail"] = data[-tail_bytes:].decode("utf-8", "replace")
+    except OSError:
+        pass
+    return rec
+
+
+def main():
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--timeout", type=int, default=120,
+                    help="seconds for the verbose init attempt")
+    ap.add_argument("--skip-init", action="store_true",
+                    help="environment + relay checks only (no init attempt)")
+    args = ap.parse_args()
+
+    rec = {
+        "probe": "diagnostics",
+        "ts": _utcnow(),
+        "env": env_snapshot(),
+        "files": file_facts(),
+        "loopback_listeners": loopback_listeners(),
+    }
+    if not args.skip_init:
+        rec["verbose_init"] = verbose_init_attempt(args.timeout)
+        rec["ok"] = bool(rec["verbose_init"].get("ok"))
+    print(json.dumps(rec, indent=1))
+    with open(os.path.join(HERE, ".probe_log.jsonl"), "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+if __name__ == "__main__":
+    main()

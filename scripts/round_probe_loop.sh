@@ -1,0 +1,25 @@
+#!/bin/bash
+# Round-long TPU probe cadence (VERDICT r4 item 1b): probe every ~20 min,
+# appending to .probe_log.jsonl; on the FIRST successful probe, immediately
+# run the full device bench, the perf sweep, and memfit while the tunnel is
+# up, then keep probing (the tunnel demonstrably flaps).
+cd "$(dirname "$0")/.."
+RAN_BENCH=0
+while true; do
+  OK=$(python - <<'EOF'
+import bench
+probes = []
+print("yes" if bench.probe_device(probes, 240) else "no")
+EOF
+)
+  OK=$(echo "$OK" | tail -1)
+  if [ "$OK" = "yes" ] && [ "$RAN_BENCH" = "0" ]; then
+    echo "=== $(date -u +%FT%TZ) tunnel UP: running device bench ==="
+    timeout 5400 python bench.py >/tmp/bench_r5.out 2>/tmp/bench_r5.err
+    echo "bench exit: $? (out: /tmp/bench_r5.out)"
+    timeout 3600 python scripts/perf_sweep.py >/tmp/sweep_r5.out 2>/tmp/sweep_r5.err
+    echo "sweep exit: $?"
+    RAN_BENCH=1
+  fi
+  sleep 1200
+done
