@@ -116,12 +116,14 @@ def uniform_crop(frames: np.ndarray, size: int, spatial_idx: int,
         # 1px from center_crop's floor on odd deltas; parity wins)
         return int(np.ceil(delta * spatial_idx / (num_crops - 1)))
 
+    # fixed (short) axis: pytorchvideo ceil-centers it — 1px from
+    # center_crop's floor on odd deltas; parity wins
     if h <= w:  # landscape: slide along width
-        top = (h - size) // 2
+        top = int(np.ceil((h - size) / 2))
         left = pos(w - size)
     else:  # portrait: slide along height
         top = pos(h - size)
-        left = (w - size) // 2
+        left = int(np.ceil((w - size) / 2))
     return frames[:, top : top + size, left : left + size]
 
 

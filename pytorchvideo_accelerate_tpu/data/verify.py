@@ -35,6 +35,9 @@ def check_one(path: str, deep: bool) -> dict:
         if meta.frame_count <= 0:
             return {"path": path, "ok": False,
                     "error": f"empty stream (frames={meta.frame_count})"}
+        if meta.fps <= 0:  # corrupt header: frames exist but fps is 0/bogus
+            return {"path": path, "ok": False,  # (would div-by-zero below)
+                    "error": f"unreadable header (fps={meta.fps})"}
         if deep:
             # decode_span raises on truncated payloads the header-only
             # probe can't see; the except below reports it

@@ -149,6 +149,20 @@ class TestVerifyTree:
         rep = verify_tree(str(root), num_workers=1, deep=True)
         assert rep["unreadable"] == 0 and rep["readable"] == 1
 
+    def test_deep_mode_zero_fps_header_reported_not_crash(self, monkeypatch,
+                                                          tmp_path):
+        # a corrupt header claiming frames>0 but fps==0 must be reported as
+        # unreadable, not ZeroDivisionError the whole audit (ADVICE r4)
+        from pytorchvideo_accelerate_tpu.data import verify
+        from pytorchvideo_accelerate_tpu.data.decode import VideoMeta
+
+        monkeypatch.setattr(
+            verify.decode_mod, "probe",
+            lambda path: VideoMeta(fps=0.0, frame_count=30))
+        rep = verify.check_one("fake.mp4", deep=True)
+        assert rep["ok"] is False
+        assert "fps" in rep["error"]
+
     def test_cli_exit_codes(self, tree_with_corruption, tmp_path, capsys):
         from pytorchvideo_accelerate_tpu.data.verify import main
 

@@ -137,6 +137,18 @@ class TestSpatialCrops:
         np.testing.assert_array_equal(tf3(raw)["video"],
                                       tf3(raw, None, 1)["video"])
 
+    def test_uniform_crop_fixed_axis_is_ceil_centered(self):
+        # pytorchvideo ceil-centers the NON-sliding axis too: odd short-side
+        # delta must offset by ceil(delta/2), 1px past center_crop's floor
+        from pytorchvideo_accelerate_tpu.data.transforms import uniform_crop
+
+        land = np.arange(2 * 9 * 20 * 1, dtype=np.float32).reshape(2, 9, 20, 1)
+        np.testing.assert_array_equal(  # h delta 1: top = ceil(1/2) = 1
+            uniform_crop(land, 8, 0), land[:, 1:9, 0:8])
+        port = np.arange(2 * 20 * 11 * 1, dtype=np.float32).reshape(2, 20, 11, 1)
+        np.testing.assert_array_equal(  # w delta 3: left = ceil(3/2) = 2
+            uniform_crop(port, 8, 2), port[:, 12:20, 2:10])
+
     def test_uniform_crop_positions_portrait(self):
         from pytorchvideo_accelerate_tpu.data.transforms import uniform_crop
 
