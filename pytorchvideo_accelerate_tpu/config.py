@@ -52,9 +52,10 @@ class DataConfig:
     sampling_rate: int = 8
     frames_per_second: int = 30
     batch_size: int = 8  # per data-parallel shard, matching per-rank semantics
-    # auto | thread | process (native shm decode workers). auto = threads
-    # unless the host has >=16 cores and >=4 workers: cv2/numpy release the
-    # GIL, so threads win on small hosts (measured, bench transport_crossover)
+    # auto | thread | process (native shm decode workers). auto = threads:
+    # cv2/numpy release the GIL and threads won every measurement made
+    # (bench transport_crossover). process is an explicit opt-in for
+    # GIL-holding pure-Python transform stacks.
     transport: str = "auto"
     num_workers: int = 8
     crop_size: int = 256

@@ -27,7 +27,6 @@ independent deterministic sample.
 from __future__ import annotations
 
 import logging
-import os
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
@@ -314,22 +313,18 @@ class ClipLoader:
         self._pool = ThreadPoolExecutor(max_workers=self.num_workers)
         # "process": forked decode workers + native shm ring (SURVEY N8);
         # falls back to threads when the native lib can't build.
-        # "auto" picks threads unless the host has enough cores for forked
-        # workers to beat them: cv2 decode and numpy transforms release the
-        # GIL, so on few-core hosts threads win outright (measured — bench.py
-        # transport_crossover; r3 saw threads 7x ahead on 1 core), while the
-        # fork + shm-ring overhead only pays off when many workers of
-        # Python-heavy work would serialize on the GIL.
-        self.transport = transport
+        # "auto" = threads. Every measurement to date says so: cv2 decode
+        # and numpy transforms release the GIL, threads beat the forked
+        # shm-ring transport 7x on the production decode path and broke
+        # even (0.996x) even on a deliberately GIL-bound pure-Python
+        # augment stack (bench.py transport_crossover). An earlier >=16-core
+        # heuristic here was extrapolation from a 1-core host — a guess,
+        # not a measurement — so it is gone: the process transport is an
+        # EXPLICIT opt-in for workloads whose transforms hold the GIL
+        # (heavy pure-Python per-clip work), where the fork + shm-ring
+        # overhead can pay for itself.
+        self.transport = "thread" if transport == "auto" else transport
         self._shm_pool = None
-        if transport == "auto":
-            try:  # cores actually available (cgroup quota / affinity aware)
-                n_cores = len(os.sched_getaffinity(0))
-            except (AttributeError, OSError):
-                n_cores = os.cpu_count() or 1
-            self.transport = ("process"
-                              if n_cores >= 16 and self.num_workers >= 4
-                              else "thread")
         if self.transport == "process":
             import pytorchvideo_accelerate_tpu.native as native
 

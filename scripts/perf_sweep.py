@@ -162,6 +162,26 @@ def main():
         variants = dedup
 
     results = []
+
+    def flush(done=False):
+        # top-level envelope so a reader can't mistake a harness check for
+        # device evidence (VERDICT r4 weak 2): "smoke": true means CPU
+        # smoke shapes, staged-only
+        import datetime
+
+        with open(os.path.join(ROOT, "SWEEP.json"), "w") as f:
+            json.dump({
+                "smoke": bool(args.smoke),
+                "note": ("HARNESS CHECK ONLY: CPU smoke shapes — not "
+                         "device evidence; rerun without --smoke on a "
+                         "live chip" if args.smoke else
+                         "device sweep (see per-variant platform/suspect)"),
+                "generated": datetime.datetime.now(datetime.timezone.utc)
+                .strftime("%FT%TZ"),
+                "complete": done,
+                "variants": results,
+            }, f, indent=1)
+
     for model_name, overrides, wl in variants:
         spec = json.dumps({"model": model_name, "overrides": overrides,
                            "workload": wl})
@@ -196,8 +216,8 @@ def main():
         # leave its record in SWEEP.json (the bench.py partial-results rule)
         results.append(res)
         print(json.dumps(res), flush=True)
-        with open(os.path.join(ROOT, "SWEEP.json"), "w") as f:
-            json.dump(results, f, indent=1)
+        flush()
+    flush(done=True)
     log(f"sweep done: {len(results)} variants -> SWEEP.json")
 
 

@@ -89,22 +89,24 @@ def loopback_listeners() -> list:
     return out
 
 
-def verbose_init_attempt(timeout_s: int = 120, tail_bytes: int = 4000) -> dict:
-    """jax.devices() under maximum plugin verbosity, killed on timeout with
-    the stderr tail preserved (Popen + pipe: communicate() would discard it
-    on TimeoutExpired for a killed process group)."""
-    env = dict(os.environ)
-    env.update(
-        TPU_STDERR_LOG_LEVEL="0",   # INFO and up to stderr
-        TPU_MIN_LOG_LEVEL="0",
-        TPU_VMODULE="*=1",
-        JAX_LOGGING_LEVEL="DEBUG",
-        PYTHONUNBUFFERED="1",
-    )
-    code = ("import jax\n"
-            "ds = jax.devices()\n"
-            "print('DEVICES:', [(d.platform, d.device_kind) for d in ds])\n")
-    err_path = os.path.join(HERE, ".probe_verbose_stderr.txt")
+DEVICES_CODE = ("import jax\n"
+                "ds = jax.devices()\n"
+                "print('DEVICES:', [(d.platform, d.device_kind) "
+                "for d in ds])\n")
+CPU_CONFIG_CODE = ("import jax\n"
+                   "jax.config.update('jax_platforms', 'cpu')\n"
+                   "ds = jax.devices()\n"
+                   "print('DEVICES:', [(d.platform, d.device_kind) "
+                   "for d in ds])\n")
+
+
+def _attempt(code: str, env: dict, timeout_s: int, err_name: str,
+             tail_bytes: int = 4000) -> dict:
+    """Run `code` in a disposable subprocess with stderr redirected to a
+    FILE, so the tail survives even when the child must be killed
+    (Popen + stderr pipe would discard everything on TimeoutExpired —
+    exactly the hang cases these probes exist to diagnose)."""
+    err_path = os.path.join(HERE, err_name)
     rec = {"timeout_s": timeout_s}
     t0 = time.time()
     with open(err_path, "wb") as errf:
@@ -133,6 +135,48 @@ def verbose_init_attempt(timeout_s: int = 120, tail_bytes: int = 4000) -> dict:
     return rec
 
 
+def verbose_init_attempt(timeout_s: int = 120, tail_bytes: int = 4000) -> dict:
+    """jax.devices() under maximum plugin verbosity, stderr tail preserved
+    across a timeout kill."""
+    env = dict(os.environ)
+    env.update(
+        TPU_STDERR_LOG_LEVEL="0",   # INFO and up to stderr
+        TPU_MIN_LOG_LEVEL="0",
+        TPU_VMODULE="*=1",
+        JAX_LOGGING_LEVEL="DEBUG",
+        PYTHONUNBUFFERED="1",
+    )
+    return _attempt(DEVICES_CODE, env, timeout_s,
+                    ".probe_verbose_stderr.txt", tail_bytes)
+
+
+def init_variant(name: str, env_overrides: dict, timeout_s: int,
+                 code: str = DEVICES_CODE) -> dict:
+    """One `jax.devices()` attempt under an alternative init path, isolating
+    which layer the wedge lives in:
+
+    - `cpu_config` (explicit jax.config.update('jax_platforms','cpu')):
+      must succeed in seconds — the control for interpreter/jax health,
+      and the ONLY robust CPU-forcing path on this image (every repo tool
+      uses it).
+    - `cpu_env` (JAX_PLATFORMS=cpu env var only): on a healthy box this
+      equals cpu_config; observed on 2026-07-31 to HANG while cpu_config
+      succeeded in the same minute — the sitecustomize-time
+      `axon.register.register()` call interacts with platform selection in
+      a relay-state-dependent way (the same command succeeded ~80 min
+      earlier), so env-var-only CPU selection is not reliable here.
+    - `tpu_direct` (JAX_PLATFORMS=tpu): bypass the axon plugin and load
+      libtpu directly. A QUICK failure ("no TPU found") would prove the
+      wedge axon-specific; a hang implicates the shared layer underneath.
+    """
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in env_overrides.items()})
+    env["PYTHONUNBUFFERED"] = "1"
+    rec = _attempt(code, env, timeout_s, f".probe_variant_{name}_stderr.txt",
+                   tail_bytes=1000)
+    return {"variant": name, "env_overrides": env_overrides, **rec}
+
+
 def main():
     import argparse
 
@@ -141,6 +185,9 @@ def main():
                     help="seconds for the verbose init attempt")
     ap.add_argument("--skip-init", action="store_true",
                     help="environment + relay checks only (no init attempt)")
+    ap.add_argument("--variants", action="store_true",
+                    help="also try alternative init paths (tpu-direct, "
+                         "cpu control) to localize the wedge")
     args = ap.parse_args()
 
     rec = {
@@ -153,6 +200,13 @@ def main():
     if not args.skip_init:
         rec["verbose_init"] = verbose_init_attempt(args.timeout)
         rec["ok"] = bool(rec["verbose_init"].get("ok"))
+    if args.variants:
+        rec["init_variants"] = [
+            init_variant("cpu_config", {}, 120, code=CPU_CONFIG_CODE),
+            init_variant("cpu_env", {"JAX_PLATFORMS": "cpu"}, 120),
+            init_variant("tpu_direct", {"JAX_PLATFORMS": "tpu"},
+                         min(args.timeout, 120)),
+        ]
     print(json.dumps(rec, indent=1))
     with open(os.path.join(HERE, ".probe_log.jsonl"), "a") as f:
         f.write(json.dumps(rec) + "\n")

@@ -5,7 +5,14 @@
 # up, then keep probing (the tunnel demonstrably flaps).
 cd "$(dirname "$0")/.."
 RAN_BENCH=0
+N=0
 while true; do
+  N=$((N+1))
+  if [ $((N % 10)) -eq 5 ]; then
+    # periodic enriched probe: env + relay + verbose init + init-path
+    # variants (scripts/probe_diagnostics.py appends to .probe_log.jsonl)
+    timeout 900 python scripts/probe_diagnostics.py --variants >/dev/null 2>&1
+  fi
   OK=$(python - <<'EOF'
 import bench
 probes = []
