@@ -168,6 +168,11 @@ class TrainConfig:
     # reference's fp16 GradScaler path, SURVEY §2.3-N7); "fp32" = full fp32.
     mixed_precision: str = "bf16"
     cpu: bool = False  # force CPU backend (reference --cpu)
+    # >0: probe device init in a disposable subprocess with this deadline
+    # (seconds) BEFORE the job touches jax.devices(), and fail loudly if it
+    # can't complete — a wedged PJRT client-create otherwise hangs the job
+    # forever with no error (utils/device_doctor.py; SURVEY §5). 0 = off.
+    device_init_timeout: int = 0
     # persistent XLA compilation cache dir ("" = off): pays the 1-2 min
     # model compile once per config instead of once per restart
     compilation_cache_dir: str = ""

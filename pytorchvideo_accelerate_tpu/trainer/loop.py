@@ -80,6 +80,18 @@ class Trainer:
         )
         if cfg.cpu:
             jax.config.update("jax_platforms", "cpu")
+        if cfg.device_init_timeout > 0 and not cfg.cpu:
+            # fail loudly instead of wedging: device backend init can hang
+            # forever (observed: PJRT client-create never returning while
+            # the process shows no error — PROBES_r05.md). Probe in a
+            # disposable subprocess first; raises with the diagnosis
+            # recipe if init can't complete in time. (SURVEY §5 failure
+            # detection — the reference's torch/NCCL stack fails loudly
+            # on a bad device; jax would just sit there.)
+            from pytorchvideo_accelerate_tpu.utils import device_doctor
+
+            device_doctor.assert_device_reachable(
+                cfg.device_init_timeout, log=logger.info)
         if cfg.debug_nans:
             jax.config.update("jax_debug_nans", True)
         if cfg.compilation_cache_dir:

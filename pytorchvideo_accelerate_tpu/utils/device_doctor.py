@@ -1,0 +1,280 @@
+"""Device reachability doctor: probe, diagnose, and guard TPU backend init
+(SURVEY §5 failure detection — the device-attachment analogue of
+`data/verify.py`'s dataset doctor).
+
+Motivation (observed on the build image, PROBES_r05.md): a PJRT plugin's
+client-create can hang FOREVER rather than fail, and plugin registration
+machinery may force the device platform at jax-config level so even
+`JAX_PLATFORMS=cpu` jobs wedge. The reference stack (torch + NCCL) fails
+loudly on a bad device; a jax job just sits there. This module gives the
+framework the same loud-failure property:
+
+- `quick_probe(timeout)`: can a DISPOSABLE subprocess enumerate devices
+  and run one op within the deadline? (The parent never touches devices —
+  a wedged init in the main process is unrecoverable.)
+- `assert_device_reachable(timeout)`: Trainer guard (config
+  `device_init_timeout`) — raises RuntimeError with the diagnosis recipe
+  instead of letting the training job hang in backend init.
+- `diagnose(...)`: full evidence capture — plugin env/file facts, loopback
+  relay liveness, a verbose init attempt whose stderr tail survives the
+  kill, and alternative init paths (cpu-via-config control, cpu-via-env,
+  tpu-direct) that localize WHICH layer is stuck.
+- `main()`: the `pva-tpu-doctor` CLI.
+
+Every subprocess redirects stderr to a file first: a hung child gets
+SIGKILLed, and a pipe would discard exactly the init logs the diagnosis
+needs.
+"""
+
+from __future__ import annotations
+
+import datetime
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+ENV_PREFIXES = ("TPU", "PJRT", "JAX", "XLA", "AXON", "PALLAS", "LIBTPU")
+
+PROBE_CODE = ("import jax, numpy as np\n"
+              "d = jax.devices()[0]\n"
+              "x = jax.device_put(np.ones((128, 128), np.float32), d)\n"
+              "jax.jit(lambda a: a @ a)(x).block_until_ready()\n"
+              "print(d.platform, d.device_kind)\n")
+DEVICES_CODE = ("import jax\n"
+                "ds = jax.devices()\n"
+                "print('DEVICES:', [(d.platform, d.device_kind) "
+                "for d in ds])\n")
+CPU_CONFIG_CODE = ("import jax\n"
+                   "jax.config.update('jax_platforms', 'cpu')\n"
+                   "ds = jax.devices()\n"
+                   "print('DEVICES:', [(d.platform, d.device_kind) "
+                   "for d in ds])\n")
+
+
+def _utcnow() -> str:
+    return datetime.datetime.now(datetime.timezone.utc).strftime("%FT%TZ")
+
+
+def env_snapshot() -> Dict[str, str]:
+    return {k: v for k, v in sorted(os.environ.items())
+            if any(k.upper().startswith(p) or f"_{p}" in k.upper()
+                   for p in ENV_PREFIXES)}
+
+
+def file_facts() -> dict:
+    out: dict = {}
+    for label, path in (
+            ("pjrt_plugin", os.environ.get("PJRT_LIBRARY_PATH", "")),
+            ("libtpu", os.environ.get("TPU_LIBRARY_PATH", ""))):
+        if not path:
+            out[label] = "env var unset"
+        elif os.path.exists(path):
+            st = os.stat(path)
+            out[label] = {"path": path, "bytes": st.st_size,
+                          "mtime": datetime.datetime.fromtimestamp(
+                              st.st_mtime).strftime("%FT%T")}
+        else:
+            out[label] = {"path": path, "missing": True}
+    return out
+
+
+def loopback_listeners() -> list:
+    """Every loopback LISTEN socket + a connect attempt to each — a relay
+    that refuses is a different failure than a relay that accepts while
+    the handshake behind it never completes."""
+    ports = set()
+    try:
+        for row in open("/proc/net/tcp").read().splitlines()[1:]:
+            f = row.split()
+            ip, port = f[1].split(":")
+            if f[3] == "0A" and ip == "0100007F":  # LISTEN on 127.0.0.1
+                ports.add(int(port, 16))
+    except OSError as e:
+        return [{"error": f"/proc/net/tcp unreadable: {e}"}]
+    out = []
+    for port in sorted(ports):
+        rec: dict = {"port": port}
+        t0 = time.perf_counter()
+        try:
+            with socket.create_connection(("127.0.0.1", port), timeout=2.0):
+                rec["connect"] = "ok"
+        except OSError as e:
+            rec["connect"] = f"{type(e).__name__}: {e}"
+        rec["connect_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
+        out.append(rec)
+    return out
+
+
+def _attempt(code: str, env: dict, timeout_s: int,
+             err_path: Optional[str] = None,
+             tail_bytes: int = 4000) -> dict:
+    """Run `code` in a disposable subprocess (own process group, killed
+    wholesale on timeout) with stderr redirected to a FILE so the tail
+    survives the kill. Default: a fresh mkstemp file, removed after
+    reading — fixed shared names would collide across concurrent probes
+    (two launch ranks both running the init guard) and across users of a
+    shared /tmp."""
+    import tempfile
+
+    own_file = err_path is None
+    if own_file:
+        fd, err_path = tempfile.mkstemp(prefix="pva_doctor_", suffix=".txt")
+        os.close(fd)
+    rec: dict = {"timeout_s": timeout_s}
+    t0 = time.time()
+    try:
+        with open(err_path, "wb") as errf:
+            p = subprocess.Popen([sys.executable, "-c", code], env=env,
+                                 stdout=subprocess.PIPE, stderr=errf,
+                                 text=True, start_new_session=True)
+            try:
+                out, _ = p.communicate(timeout=timeout_s)
+                rec.update(ok=p.returncode == 0, returncode=p.returncode,
+                           stdout=(out or "").strip()[-300:])
+            except subprocess.TimeoutExpired:
+                try:
+                    os.killpg(p.pid, signal.SIGKILL)
+                except OSError:
+                    pass
+                p.wait()
+                rec.update(ok=False, error="timeout (killed)")
+        rec["elapsed_s"] = round(time.time() - t0, 1)
+        try:
+            with open(err_path, "rb") as f:
+                data = f.read()
+            rec["stderr_bytes"] = len(data)
+            rec["stderr_tail"] = data[-tail_bytes:].decode("utf-8", "replace")
+        except OSError:
+            pass
+    finally:
+        if own_file:
+            try:
+                os.unlink(err_path)
+            except OSError:
+                pass
+    return rec
+
+
+def quick_probe(timeout_s: int = 240) -> dict:
+    """Enumerate devices + run one op in a disposable subprocess, default
+    init path (whatever the job itself would get). Returns the attempt
+    record; `ok` means the main process can safely init its backend."""
+    env = dict(os.environ)
+    env["PYTHONUNBUFFERED"] = "1"
+    rec = _attempt(PROBE_CODE, env, timeout_s, tail_bytes=1000)
+    rec["ts"] = _utcnow()
+    return rec
+
+
+def assert_device_reachable(timeout_s: int, log=None) -> dict:
+    """Trainer guard: fail LOUDLY (RuntimeError) when backend init would
+    hang, instead of wedging the training job in jax.devices().
+
+    A probe child that lands on the CPU backend counts as reachable — the
+    job will get the same backend, and CPU init doesn't hang."""
+    log = log or (lambda msg: print(msg, file=sys.stderr))
+    log(f"[device_doctor] probing device init ({timeout_s}s cap) ...")
+    rec = quick_probe(timeout_s)
+    if rec.get("ok"):
+        log(f"[device_doctor] device ok in {rec['elapsed_s']}s: "
+            f"{rec.get('stdout', '')}")
+        return rec
+    raise RuntimeError(
+        "device backend init did not complete within "
+        f"{timeout_s}s (probe: {rec.get('error') or rec.get('stderr_tail', '')[-200:]}). "
+        "Refusing to start a training job that would hang in "
+        "jax.devices(). Diagnose with `pva-tpu-doctor --variants` "
+        "(plugin env, relay liveness, verbose init attempt, init-path "
+        "variants), run on CPU with --cpu, or raise/disable the guard "
+        "via --device_init_timeout (0 disables)."
+    )
+
+
+def init_variant(name: str, env_overrides: dict, timeout_s: int,
+                 code: str = DEVICES_CODE) -> dict:
+    """One `jax.devices()` attempt under an alternative init path:
+
+    - `cpu_config`: platform forced by jax.config.update in code — the
+      interpreter/jax health control, and the only override that beats a
+      registration-time config force.
+    - `cpu_env`: JAX_PLATFORMS=cpu env var only — diverges from
+      cpu_config exactly when plugin registration overrides the env.
+    - `tpu_direct`: JAX_PLATFORMS=tpu, bypassing any vendor plugin; a
+      quick "no TPU found" failure vs a hang localizes the stuck layer.
+    """
+    env = dict(os.environ)
+    env.update({k: str(v) for k, v in env_overrides.items()})
+    env["PYTHONUNBUFFERED"] = "1"
+    rec = _attempt(code, env, timeout_s, tail_bytes=1000)
+    return {"variant": name, "env_overrides": env_overrides, **rec}
+
+
+def verbose_init_attempt(timeout_s: int = 120,
+                         tail_bytes: int = 4000) -> dict:
+    """Default init path under maximum plugin verbosity — whatever the
+    plugin logs before wedging is the diagnosis."""
+    env = dict(os.environ)
+    env.update(
+        TPU_STDERR_LOG_LEVEL="0",   # INFO and up to stderr
+        TPU_MIN_LOG_LEVEL="0",
+        TPU_VMODULE="*=1",
+        JAX_LOGGING_LEVEL="DEBUG",
+        PYTHONUNBUFFERED="1",
+    )
+    return _attempt(DEVICES_CODE, env, timeout_s,
+                    tail_bytes=tail_bytes)
+
+
+def diagnose(timeout_s: int = 120, skip_init: bool = False,
+             variants: bool = False) -> dict:
+    rec = {
+        "probe": "diagnostics",
+        "ts": _utcnow(),
+        "env": env_snapshot(),
+        "files": file_facts(),
+        "loopback_listeners": loopback_listeners(),
+    }
+    if not skip_init:
+        rec["verbose_init"] = verbose_init_attempt(timeout_s)
+        rec["ok"] = bool(rec["verbose_init"].get("ok"))
+    if variants:
+        rec["init_variants"] = [
+            init_variant("cpu_config", {}, 120, code=CPU_CONFIG_CODE),
+            init_variant("cpu_env", {"JAX_PLATFORMS": "cpu"}, 120),
+            init_variant("tpu_direct", {"JAX_PLATFORMS": "tpu"},
+                         min(timeout_s, 120)),
+        ]
+    return rec
+
+
+def main(argv: Optional[list] = None) -> int:
+    import argparse
+
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--timeout", type=int, default=120,
+                    help="seconds for the verbose init attempt")
+    ap.add_argument("--skip-init", action="store_true",
+                    help="environment + relay checks only (no init attempt)")
+    ap.add_argument("--variants", action="store_true",
+                    help="also try alternative init paths (cpu-config "
+                         "control, cpu-env, tpu-direct) to localize a hang")
+    ap.add_argument("--log", default="",
+                    help="append the JSON record to this jsonl file")
+    args = ap.parse_args(argv)
+
+    rec = diagnose(args.timeout, args.skip_init, args.variants)
+    print(json.dumps(rec, indent=1))
+    if args.log:
+        with open(args.log, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    ok = rec.get("ok")
+    return 0 if (ok or args.skip_init) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

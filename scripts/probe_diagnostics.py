@@ -1,216 +1,23 @@
 #!/usr/bin/env python
-"""Enriched TPU-tunnel probe: capture WHY the device is unreachable, not
-just that it is (VERDICT r4 item 7).
+"""Round-tooling wrapper around the package device doctor
+(pytorchvideo_accelerate_tpu/utils/device_doctor.py): identical probes,
+but always appends the record to the repo-root `.probe_log.jsonl` the
+round's probe timeline (PROBES_r05.md) is built from.
 
-The standard probe (bench.probe_device) answers reachable-or-not; two
-rounds of it proved the axon tunnel can stay wedged for ~10 h without ever
-saying what layer is stuck. This probe records, once per invocation:
-
-  1. the PJRT/axon plugin environment (env vars, plugin + libtpu file facts);
-  2. loopback relay liveness: every 127.0.0.1 LISTEN socket, and whether a
-     TCP connect to it succeeds — distinguishes "relay process dead"
-     (connect refused) from "relay up, TPU backend wedged behind it"
-     (connect ok, init still hangs);
-  3. a VERBOSE init attempt (TPU_STDERR_LOG_LEVEL=0, TPU_MIN_LOG_LEVEL=0,
-     JAX debug logging) in a disposable subprocess, with the stderr tail
-     captured even when it has to be killed — whatever the plugin says
-     before wedging is the first actual diagnostic content of this failure.
-
-Appends one {"probe": "diagnostics", ...} record to .probe_log.jsonl and
-prints it; safe to run with the tunnel in any state (never touches devices
-in this process).
+Usage:  python scripts/probe_diagnostics.py [--timeout N] [--skip-init]
+        [--variants]
 """
 
-import datetime
-import json
 import os
-import signal
-import socket
-import subprocess
 import sys
-import time
 
 HERE = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, HERE)
 
-ENV_PREFIXES = ("TPU", "PJRT", "JAX", "XLA", "AXON", "PALLAS", "LIBTPU")
-
-
-def _utcnow() -> str:
-    return datetime.datetime.now(datetime.timezone.utc).strftime("%FT%TZ")
-
-
-def env_snapshot() -> dict:
-    return {k: v for k, v in sorted(os.environ.items())
-            if any(k.upper().startswith(p) or f"_{p}" in k.upper()
-                   for p in ENV_PREFIXES)}
-
-
-def file_facts() -> dict:
-    out = {}
-    for label, path in (
-            ("pjrt_plugin", os.environ.get("PJRT_LIBRARY_PATH", "")),
-            ("libtpu", os.environ.get("TPU_LIBRARY_PATH", ""))):
-        if not path:
-            out[label] = "env var unset"
-        elif os.path.exists(path):
-            st = os.stat(path)
-            out[label] = {"path": path, "bytes": st.st_size,
-                          "mtime": datetime.datetime.fromtimestamp(
-                              st.st_mtime).strftime("%FT%T")}
-        else:
-            out[label] = {"path": path, "missing": True}
-    return out
-
-
-def loopback_listeners() -> list:
-    """Every loopback LISTEN socket + a connect attempt to each: the axon
-    relay (AXON_POOL_SVC_OVERRIDE=127.0.0.1) must be one of these for the
-    tunnel to have any chance."""
-    ports = set()
-    try:
-        for row in open("/proc/net/tcp").read().splitlines()[1:]:
-            f = row.split()
-            ip, port = f[1].split(":")
-            if f[3] == "0A" and ip == "0100007F":  # LISTEN on 127.0.0.1
-                ports.add(int(port, 16))
-    except OSError as e:
-        return [{"error": f"/proc/net/tcp unreadable: {e}"}]
-    out = []
-    for port in sorted(ports):
-        rec = {"port": port}
-        t0 = time.perf_counter()
-        try:
-            with socket.create_connection(("127.0.0.1", port), timeout=2.0):
-                rec["connect"] = "ok"
-        except OSError as e:
-            rec["connect"] = f"{type(e).__name__}: {e}"
-        rec["connect_ms"] = round((time.perf_counter() - t0) * 1e3, 1)
-        out.append(rec)
-    return out
-
-
-DEVICES_CODE = ("import jax\n"
-                "ds = jax.devices()\n"
-                "print('DEVICES:', [(d.platform, d.device_kind) "
-                "for d in ds])\n")
-CPU_CONFIG_CODE = ("import jax\n"
-                   "jax.config.update('jax_platforms', 'cpu')\n"
-                   "ds = jax.devices()\n"
-                   "print('DEVICES:', [(d.platform, d.device_kind) "
-                   "for d in ds])\n")
-
-
-def _attempt(code: str, env: dict, timeout_s: int, err_name: str,
-             tail_bytes: int = 4000) -> dict:
-    """Run `code` in a disposable subprocess with stderr redirected to a
-    FILE, so the tail survives even when the child must be killed
-    (Popen + stderr pipe would discard everything on TimeoutExpired —
-    exactly the hang cases these probes exist to diagnose)."""
-    err_path = os.path.join(HERE, err_name)
-    rec = {"timeout_s": timeout_s}
-    t0 = time.time()
-    with open(err_path, "wb") as errf:
-        p = subprocess.Popen([sys.executable, "-c", code], env=env,
-                             stdout=subprocess.PIPE, stderr=errf,
-                             text=True, start_new_session=True)
-        try:
-            out, _ = p.communicate(timeout=timeout_s)
-            rec.update(ok=p.returncode == 0, returncode=p.returncode,
-                       stdout=(out or "").strip()[-300:])
-        except subprocess.TimeoutExpired:
-            try:
-                os.killpg(p.pid, signal.SIGKILL)
-            except OSError:
-                pass
-            p.wait()
-            rec.update(ok=False, error="timeout (killed)")
-    rec["elapsed_s"] = round(time.time() - t0, 1)
-    try:
-        with open(err_path, "rb") as f:
-            data = f.read()
-        rec["stderr_bytes"] = len(data)
-        rec["stderr_tail"] = data[-tail_bytes:].decode("utf-8", "replace")
-    except OSError:
-        pass
-    return rec
-
-
-def verbose_init_attempt(timeout_s: int = 120, tail_bytes: int = 4000) -> dict:
-    """jax.devices() under maximum plugin verbosity, stderr tail preserved
-    across a timeout kill."""
-    env = dict(os.environ)
-    env.update(
-        TPU_STDERR_LOG_LEVEL="0",   # INFO and up to stderr
-        TPU_MIN_LOG_LEVEL="0",
-        TPU_VMODULE="*=1",
-        JAX_LOGGING_LEVEL="DEBUG",
-        PYTHONUNBUFFERED="1",
-    )
-    return _attempt(DEVICES_CODE, env, timeout_s,
-                    ".probe_verbose_stderr.txt", tail_bytes)
-
-
-def init_variant(name: str, env_overrides: dict, timeout_s: int,
-                 code: str = DEVICES_CODE) -> dict:
-    """One `jax.devices()` attempt under an alternative init path, isolating
-    which layer the wedge lives in:
-
-    - `cpu_config` (explicit jax.config.update('jax_platforms','cpu')):
-      must succeed in seconds — the control for interpreter/jax health,
-      and the ONLY robust CPU-forcing path on this image (every repo tool
-      uses it).
-    - `cpu_env` (JAX_PLATFORMS=cpu env var only): on a healthy box this
-      equals cpu_config; observed on 2026-07-31 to HANG while cpu_config
-      succeeded in the same minute — the sitecustomize-time
-      `axon.register.register()` call interacts with platform selection in
-      a relay-state-dependent way (the same command succeeded ~80 min
-      earlier), so env-var-only CPU selection is not reliable here.
-    - `tpu_direct` (JAX_PLATFORMS=tpu): bypass the axon plugin and load
-      libtpu directly. A QUICK failure ("no TPU found") would prove the
-      wedge axon-specific; a hang implicates the shared layer underneath.
-    """
-    env = dict(os.environ)
-    env.update({k: str(v) for k, v in env_overrides.items()})
-    env["PYTHONUNBUFFERED"] = "1"
-    rec = _attempt(code, env, timeout_s, f".probe_variant_{name}_stderr.txt",
-                   tail_bytes=1000)
-    return {"variant": name, "env_overrides": env_overrides, **rec}
-
-
-def main():
-    import argparse
-
-    ap = argparse.ArgumentParser()
-    ap.add_argument("--timeout", type=int, default=120,
-                    help="seconds for the verbose init attempt")
-    ap.add_argument("--skip-init", action="store_true",
-                    help="environment + relay checks only (no init attempt)")
-    ap.add_argument("--variants", action="store_true",
-                    help="also try alternative init paths (tpu-direct, "
-                         "cpu control) to localize the wedge")
-    args = ap.parse_args()
-
-    rec = {
-        "probe": "diagnostics",
-        "ts": _utcnow(),
-        "env": env_snapshot(),
-        "files": file_facts(),
-        "loopback_listeners": loopback_listeners(),
-    }
-    if not args.skip_init:
-        rec["verbose_init"] = verbose_init_attempt(args.timeout)
-        rec["ok"] = bool(rec["verbose_init"].get("ok"))
-    if args.variants:
-        rec["init_variants"] = [
-            init_variant("cpu_config", {}, 120, code=CPU_CONFIG_CODE),
-            init_variant("cpu_env", {"JAX_PLATFORMS": "cpu"}, 120),
-            init_variant("tpu_direct", {"JAX_PLATFORMS": "tpu"},
-                         min(args.timeout, 120)),
-        ]
-    print(json.dumps(rec, indent=1))
-    with open(os.path.join(HERE, ".probe_log.jsonl"), "a") as f:
-        f.write(json.dumps(rec) + "\n")
-
+from pytorchvideo_accelerate_tpu.utils.device_doctor import main  # noqa: E402
 
 if __name__ == "__main__":
-    main()
+    # default PREPENDED so an explicit --log on the command line still wins
+    # (argparse last-occurrence semantics)
+    sys.exit(main(["--log", os.path.join(HERE, ".probe_log.jsonl")]
+                  + sys.argv[1:]))
