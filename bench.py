@@ -97,6 +97,8 @@ def _setup_jax(smoke: bool):
 
 def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
     import jax
+    import jax.numpy as jnp
+    import numpy as np
 
     from pytorchvideo_accelerate_tpu.utils.bench_setup import (
         build_step_setup, xla_flops,
@@ -128,42 +130,69 @@ def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
     log(f"[{name}] compile: {compile_s:.1f}s, "
         f"flops/step: {flops_per_step and f'{flops_per_step / 1e12:.2f}T'}")
 
+    # Sync discipline: `jax.block_until_ready` is ACKED EARLY by the axon
+    # forwarding backend (r3 + r5 evidence: 430%+ "MFU" with per-step
+    # block_until_ready in the loop — physically impossible, so the call
+    # returned before execution). The only sync a forwarder cannot fake is
+    # a device->host VALUE transfer: the caller holds the computed bytes.
+    # np.asarray on a *fresh* jax.Array forces exactly that (fetched values
+    # are cached per-array, hence fresh arrays throughout).
+    def _fetch(m) -> float:
+        return float(np.asarray(m["loss"]))
+
     for i in range(max(args.warmup, 1)):  # >=1: later loops read `metrics`
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(i))
-    jax.block_until_ready(metrics["loss"])
+    _fetch(metrics)
 
-    # --- blocked per-step latency (the honest number) ---------------------
+    # tunnel round-trip floor: tiny fresh result each probe, so the timing
+    # is dispatch + transfer with negligible compute
+    one = jnp.ones((1,), jnp.float32) + jnp.zeros((1,), jnp.float32)
+    rtts = []
+    for i in range(5):
+        y = one * float(i + 1)
+        t0 = time.perf_counter()
+        np.asarray(y)
+        rtts.append(time.perf_counter() - t0)
+    rtt_ms = statistics.median(rtts) * 1e3
+
+    # --- blocked per-step latency (upper bound; includes one RTT) ---------
     blocked = []
     for i in range(args.steps):
         t0 = time.perf_counter()
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(50 + i))
-        jax.block_until_ready(metrics["loss"])
+        _fetch(metrics)
         blocked.append(time.perf_counter() - t0)
     blocked_ms = statistics.median(blocked) * 1e3
 
-    # --- pipelined throughput (async dispatch, one sync at the end) -------
+    # --- pipelined throughput (async dispatch, one value-sync at the end;
+    # the queue is empty here because the blocked loop fetched every step) -
     t0 = time.perf_counter()
     for i in range(args.steps):
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(100 + i))
-    jax.block_until_ready(metrics["loss"])
+    _fetch(metrics)
     dt = time.perf_counter() - t0
     pipelined_ms = dt / args.steps * 1e3
 
     clips_per_sec = B * args.steps / dt
     per_chip = clips_per_sec / n_chips
-    suspect = pipelined_ms < 0.5 * blocked_ms
+    # RTT-corrected latency is the fair comparison for the pipelining ratio
+    suspect = pipelined_ms < 0.5 * max(blocked_ms - rtt_ms, 1e-6)
 
     dev = jax.devices()[0]
     peak = peak_tflops(dev)
     tflops = mfu = None
     if flops_per_step:
-        tflops = flops_per_step / (blocked_ms / 1e3) / 1e12 / n_chips
+        # throughput MFU from the pipelined rate — the deployment-relevant
+        # number (the async train loop runs pipelined), and the one with
+        # the RTT amortized across the whole window
+        tflops = flops_per_step / (pipelined_ms / 1e3) / 1e12 / n_chips
         if peak:
             mfu = tflops / peak
             if mfu > 1.0:  # >100% of bf16 peak is physically impossible:
                 suspect = True  # the platform isn't timing real execution
-                # (e.g. a forwarding backend acking block_until_ready early)
-    log(f"[{name}] {args.steps} steps: blocked {blocked_ms:.1f} ms/step, "
+                # (e.g. a forwarding backend acking the sync early)
+    log(f"[{name}] {args.steps} steps: blocked {blocked_ms:.1f} ms/step "
+        f"(rtt {rtt_ms:.1f}), "
         f"pipelined {pipelined_ms:.1f} ms/step -> {per_chip:.2f} clips/s/chip"
         f"{f', {tflops:.1f} TFLOP/s/chip' if tflops else ''}"
         f"{f', MFU {mfu:.1%}' if mfu else ''}"
@@ -174,6 +203,8 @@ def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
         "clips_per_sec_per_chip": round(per_chip, 3),
         "step_ms_blocked": round(blocked_ms, 3),
         "step_ms_pipelined": round(pipelined_ms, 3),
+        "tunnel_rtt_ms": round(rtt_ms, 3),
+        "sync": "value-fetch",  # block_until_ready acks early on axon
         "compile_s": round(compile_s, 1),
         "batch_per_chip": bsz,
         "frames": frames,

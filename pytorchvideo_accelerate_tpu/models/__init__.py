@@ -19,6 +19,8 @@ from pytorchvideo_accelerate_tpu.models.heads import ResBasicHead  # noqa: F401
 from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
 from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
 from pytorchvideo_accelerate_tpu.models.x3d import X3D
+from pytorchvideo_accelerate_tpu.models.r2plus1d import R2Plus1D
+from pytorchvideo_accelerate_tpu.models.csn import CSN
 from pytorchvideo_accelerate_tpu.models.mvit import MViT
 from pytorchvideo_accelerate_tpu.models.videomae import (  # noqa: F401
     VideoMAEClassifier,
@@ -100,6 +102,24 @@ def _x3d_l(cfg: ModelConfig, dtype, mesh=None):
     return X3D(num_classes=cfg.num_classes, depths=(5, 10, 25, 15),
                dropout_rate=cfg.dropout_rate,
                depthwise_impl=cfg.depthwise_impl, dtype=dtype)
+
+
+@register_model("csn_r101")
+def _csn_r101(cfg: ModelConfig, dtype, mesh=None):
+    """Hub `csn_r101` (ir-CSN-101, Kinetics-400 32x2); models/csn.py."""
+    return CSN(
+        num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+        depthwise_impl=cfg.depthwise_impl, dtype=dtype,
+    )
+
+
+@register_model("r2plus1d_r50")
+def _r2plus1d_r50(cfg: ModelConfig, dtype, mesh=None):
+    """Hub `r2plus1d_r50` (Kinetics-400 16x4); models/r2plus1d.py."""
+    return R2Plus1D(
+        num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+        dtype=dtype,
+    )
 
 
 @register_model("mvit_b")

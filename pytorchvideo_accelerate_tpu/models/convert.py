@@ -80,6 +80,8 @@ def map_torch_key(key: str, model: str) -> Optional[Tuple[str, Path]]:
         return None
     if model.startswith("x3d"):
         return map_x3d_key(key)
+    if model.startswith("r2plus1d"):
+        return map_r2plus1d_key(key)
     slowfast = model.startswith("slowfast")
 
     m = re.match(r"blocks\.(\d+)\.(.*)", key)
@@ -150,6 +152,8 @@ def torch_key_for(collection: str, path: Path, model: str) -> Optional[str]:
     an independent spec and by weight export)."""
     if model.startswith("x3d"):
         return x3d_torch_key_for(collection, path)
+    if model.startswith("r2plus1d"):
+        return r2plus1d_torch_key_for(collection, path)
     slowfast = model.startswith("slowfast")
     head_block = 6 if slowfast else 5
     if path[0] == "head":
@@ -209,6 +213,102 @@ def torch_key_for(collection: str, path: Path, model: str) -> Optional[str]:
         blk = path[1].replace("block", "")
         inner = member(path[2:], True)
         return inner and f"blocks.{stage}.res_blocks.{blk}.{inner}"
+    return None
+
+
+# --- R(2+1)D (pytorchvideo create_r2plus1d tree) ----------------------------
+#
+# Same blocks.0 stem / blocks.1-4 res_blocks / blocks.5 head skeleton as
+# slow_r50, except branch2.conv_b is a Conv2plus1d container with an inner
+# norm: conv_b.conv_t (the 1x3x3 SPATIAL factor — same swapped slot naming
+# as the X3D stem), conv_b.norm (+ inner ReLU, paramless), conv_b.conv_xy
+# (the 3x1x1 temporal factor). branch2.norm_b then normalizes the temporal
+# factor's output. Flax targets (models/r2plus1d.py Bottleneck2Plus1D):
+# conv_b_s <- {conv_b.conv_t, conv_b.norm}, conv_b_t <- {conv_b.conv_xy,
+# norm_b}. Full-depth key coverage in tests/hub_manifests.py.
+
+_R2P1D_CONVB = {
+    # torch member under branch2 -> (flax block member, is_norm)
+    "conv_b.conv_t": ("conv_b_s", False),
+    "conv_b.norm": ("conv_b_s", True),
+    "conv_b.conv_xy": ("conv_b_t", False),
+    "norm_b": ("conv_b_t", True),
+}
+
+
+def _map_r2p1d_block_member(rest: str) -> Optional[Tuple[str, Path]]:
+    """Map inside one r2plus1d res block: Conv2plus1d members first, the
+    shared stem/branch1/conv_a/conv_c skeleton via _map_block_member."""
+    for tkey, (member, is_norm) in _R2P1D_CONVB.items():
+        if rest.startswith(tkey + "."):
+            leaf = rest[len(tkey) + 1:]
+            if not is_norm:
+                if leaf == "weight":
+                    return "params", (member, "conv", "kernel")
+                return None
+            if leaf in _BN_PARAM:
+                return "params", (member, "norm", _BN_PARAM[leaf])
+            if leaf in _BN_STAT:
+                return "batch_stats", (member, "norm", _BN_STAT[leaf])
+            return None
+    return _map_block_member(rest)
+
+
+def map_r2plus1d_key(key: str) -> Optional[Tuple[str, Path]]:
+    m = re.match(r"blocks\.(\d+)\.(.*)", key)
+    if not m:
+        return None
+    idx, rest = int(m.group(1)), m.group(2)
+    pm = re.match(r"proj\.(weight|bias)", rest)
+    if pm:
+        return "params", ("head", "proj",
+                          "kernel" if pm.group(1) == "weight" else "bias")
+    if idx == 0:
+        mapped = _map_block_member(rest)
+        if mapped is None:
+            return None
+        coll, suffix = mapped
+        return coll, ("stem",) + suffix
+    m3 = re.match(r"res_blocks\.(\d+)\.(.*)", rest)
+    if m3:
+        mapped = _map_r2p1d_block_member(m3.group(2))
+        if mapped is None:
+            return None
+        coll, suffix = mapped
+        return coll, (f"res{idx + 1}_block{m3.group(1)}",) + suffix
+    return None
+
+
+def r2plus1d_torch_key_for(collection: str, path: Path) -> Optional[str]:
+    """Inverse of `map_r2plus1d_key` (independent spec for tests/export)."""
+    inv_bn = {v: k for k, v in (_BN_PARAM if collection == "params"
+                                else _BN_STAT).items()}
+    if path[0] == "head":
+        return "blocks.5.proj." + ("weight" if path[-1] == "kernel" else "bias")
+    if path[0] == "stem":
+        if path[1] == "conv":
+            return "blocks.0.conv.weight"
+        return f"blocks.0.norm.{inv_bn[path[2]]}"
+    m = re.match(r"res(\d)_block(\d+)", path[0])
+    if not m:
+        return None
+    prefix = f"blocks.{int(m.group(1)) - 1}.res_blocks.{m.group(2)}"
+    member = path[1]
+    if member == "branch1":
+        if path[2] == "conv":
+            return f"{prefix}.branch1_conv.weight"
+        return f"{prefix}.branch1_norm.{inv_bn[path[3]]}"
+    if member in ("conv_b_s", "conv_b_t"):
+        for tkey, (fmember, is_norm) in _R2P1D_CONVB.items():
+            if fmember == member and is_norm == (path[2] == "norm"):
+                leaf = "weight" if path[2] == "conv" else inv_bn[path[3]]
+                return f"{prefix}.branch2.{tkey}.{leaf}"
+        return None
+    if member in ("conv_a", "conv_c"):
+        letter = member[-1]
+        if path[2] == "conv":
+            return f"{prefix}.branch2.conv_{letter}.weight"
+        return f"{prefix}.branch2.norm_{letter}.{inv_bn[path[3]]}"
     return None
 
 
@@ -686,6 +786,15 @@ def detect_model(sd: Dict) -> str:
         return "videomae_b"
     if "blocks.0.conv.conv_t.weight" in sd:
         return "x3d_s"
+    if any(".conv_b.conv_t." in k for k in sd):
+        return "r2plus1d_r50"
+    # csn shares slow_r50's key names exactly; the depthwise conv_b shape
+    # (inner, 1, 3, 3, 3) is the family signature
+    k = "blocks.1.res_blocks.0.branch2.conv_b.weight"
+    if k in sd:
+        shape = np.shape(sd[k])
+        if len(shape) == 5 and shape[1] == 1:
+            return "csn_r101"
     return "slow_r50"
 
 

@@ -1,0 +1,169 @@
+"""ir-CSN-101: interaction-reduced Channel-Separated Network.
+
+Third hub family beyond the reference's two loads (run.py:107,115): hub
+`csn_r101` (Kinetics-400, 32x2 sampling). Architecture per Tran et al.
+2019 ("Video Classification with Channel-Separated Convolutional
+Networks", arXiv:1904.02811) with pytorchvideo's `create_csn`
+instantiation: the plain 3D-ResNet skeleton (stem 3x7x7 stride (1,2,2) +
+1x3x3 maxpool; bottleneck depths (3,4,23,3); head at blocks.5) where every
+bottleneck's spatiotemporal conv_b is DEPTHWISE 3x3x3 (channel interaction
+is confined to the 1x1x1 conv_a/conv_c — "interaction-reduced") and both
+temporal and spatial stride 2 ride the res3/res4/res5 entries: 32x224^2
+input -> 4x7x7 features. conv_a is 1x1x1 everywhere (no temporal taps).
+
+Parameter count under this structure is 22.1M + BN, matching the published
+hub figure (22.21M) — the arithmetic cross-check behind
+tests/hub_manifests.py:csn_r101_manifest. The torch module tree is
+byte-identical in names to slow_r50's (create_resnet skeleton), so the
+existing converter name map covers it; only shapes differ and the
+depthwise OIDHW->DHWIO transpose already produces the (kt,kh,kw,1,C)
+grouped-kernel layout.
+
+TPU note: CSN concentrates ~98% of its FLOPs in 1x1x1 convs — pure MXU
+matmuls — while the depthwise 3x3x3 is bandwidth-bound glue, exactly the
+split ops/depthwise.py's selectable lowering (XLA grouped conv vs shift
+tap-decomposition, `--model.depthwise_impl`) exists to serve; CSN is its
+second consumer after X3D.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorchvideo_accelerate_tpu.models.common import (
+    ConvBNAct,
+    Dtype,
+    max_pool_3d,
+)
+from pytorchvideo_accelerate_tpu.models.heads import ResBasicHead
+from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
+
+
+class _DepthwiseConvBN(nn.Module):
+    """Depthwise conv + BN + ReLU at the `<name>/{conv,norm}` param paths
+    ConvBNAct uses, so the generic converter map lands unchanged."""
+
+    features: int
+    stride: Tuple[int, int, int]
+    depthwise_impl: str
+    dtype: Dtype
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = DepthwiseConv3D(
+            self.features, kernel_size=(3, 3, 3), stride=self.stride,
+            impl=self.depthwise_impl, dtype=self.dtype, name="conv",
+        )(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype, name="norm")(x)
+        return nn.relu(x)
+
+
+class CSNBottleneck(nn.Module):
+    """1x1x1 conv_a -> depthwise 3x3x3 conv_b (strided) -> 1x1x1 conv_c,
+    projection shortcut on stage entries."""
+
+    features_inner: int
+    features_out: int
+    temporal_stride: int = 1
+    spatial_stride: int = 1
+    depthwise_impl: str = "conv"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        residual = x
+        stride = (self.temporal_stride, self.spatial_stride,
+                  self.spatial_stride)
+        y = ConvBNAct(self.features_inner, kernel=(1, 1, 1),
+                      dtype=self.dtype, name="conv_a")(x, train)
+        y = _DepthwiseConvBN(self.features_inner, stride=stride,
+                             depthwise_impl=self.depthwise_impl,
+                             dtype=self.dtype, name="conv_b")(y, train)
+        y = ConvBNAct(self.features_out, kernel=(1, 1, 1), act=None,
+                      dtype=self.dtype, name="conv_c")(y, train)
+        if (residual.shape[-1] != self.features_out
+                or self.spatial_stride != 1 or self.temporal_stride != 1):
+            residual = ConvBNAct(self.features_out, kernel=(1, 1, 1),
+                                 stride=stride, act=None, dtype=self.dtype,
+                                 name="branch1")(residual, train)
+        return nn.relu(residual + y)
+
+
+class CSNStage(nn.Module):
+    """Stack of CSN bottlenecks; block 0 carries both strides. Nested
+    `res{N}/block{i}` naming = slow_r50's ResStage structure, so the
+    generic converter map (map_torch_key's create_resnet branch) covers
+    the csn tree with no csn-specific mapping code."""
+
+    depth: int
+    features_inner: int
+    features_out: int
+    temporal_stride: int = 1
+    spatial_stride: int = 1
+    depthwise_impl: str = "conv"
+    dtype: Dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        for i in range(self.depth):
+            x = CSNBottleneck(
+                features_inner=self.features_inner,
+                features_out=self.features_out,
+                temporal_stride=self.temporal_stride if i == 0 else 1,
+                spatial_stride=self.spatial_stride if i == 0 else 1,
+                depthwise_impl=self.depthwise_impl,
+                dtype=self.dtype,
+                name=f"block{i}",
+            )(x, train)
+        return x
+
+
+class CSN(nn.Module):
+    num_classes: int
+    depths: Tuple[int, ...] = (3, 4, 23, 3)  # csn_r101
+    stem_features: int = 64
+    spatial_strides: Tuple[int, ...] = (1, 2, 2, 2)
+    temporal_strides: Tuple[int, ...] = (1, 2, 2, 2)
+    dropout_rate: float = 0.5
+    depthwise_impl: str = "conv"  # conv | shift (ops/depthwise.py)
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = ConvBNAct(self.stem_features, kernel=(3, 7, 7),
+                      stride=(1, 2, 2), dtype=self.dtype, name="stem")(x, train)
+        x = max_pool_3d(x, (1, 3, 3), (1, 2, 2))
+
+        features_inner = self.stem_features
+        features_out = self.stem_features * 4
+        for stage_idx, depth in enumerate(self.depths):
+            x = CSNStage(
+                depth=depth,
+                features_inner=features_inner,
+                features_out=features_out,
+                temporal_stride=self.temporal_strides[stage_idx],
+                spatial_stride=self.spatial_strides[stage_idx],
+                depthwise_impl=self.depthwise_impl,
+                dtype=self.dtype,
+                name=f"res{stage_idx + 2}",
+            )(x, train)
+            features_inner *= 2
+            features_out *= 2
+
+        return ResBasicHead(
+            num_classes=self.num_classes,
+            dropout_rate=self.dropout_rate,
+            dtype=self.dtype,
+            name="head",
+        )(x, train)
+
+    @staticmethod
+    def backbone_param_filter(path: Tuple[str, ...]) -> bool:
+        """True for backbone (non-head) params (freeze_backbone masking,
+        reference run.py:116 semantics)."""
+        return path[0] != "head"

@@ -569,7 +569,13 @@ class Trainer:
                     if 0 <= cfg.data.limit_train_batches <= step_in_epoch + 1:
                         break
                 if metrics is not None:
-                    jax.block_until_ready(metrics["loss"])
+                    # value-fetch sync, not block_until_ready: forwarding
+                    # backends (the axon tunnel) ack block_until_ready
+                    # before execution finishes, which would end the epoch
+                    # timer with work still queued; fetching the scalar's
+                    # bytes can't complete early, and the step-state chain
+                    # means the last loss implies all steps are done
+                    np.asarray(metrics["loss"])
                 epoch_train_times.append(time.time() - t_epoch)
 
                 # Evaluation (reference run.py:287-304, in-graph metric sums)

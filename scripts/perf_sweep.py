@@ -21,6 +21,8 @@ import subprocess
 import sys
 import time
 
+import numpy as np
+
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 sys.path.insert(0, ROOT)
@@ -71,12 +73,16 @@ def time_variant(model_name: str, overrides: dict, wl: dict, smoke: bool,
     flops = xla_flops(compiled)
     for i in range(max(warmup, 1)):
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(i))
-    jax.block_until_ready(metrics["loss"])
+    # value-fetch sync throughout: the axon forwarder acks
+    # block_until_ready early (bench.py r5 fix); fetching the scalar's
+    # bytes cannot return before the step (and, via the state chain,
+    # every prior step) has executed
+    float(np.asarray(metrics["loss"]))
     blocked = []
     for i in range(steps):
         t0 = time.perf_counter()
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(9 + i))
-        jax.block_until_ready(metrics["loss"])
+        float(np.asarray(metrics["loss"]))
         blocked.append(time.perf_counter() - t0)
     ms = statistics.median(blocked) * 1e3
     devices = jax.devices()

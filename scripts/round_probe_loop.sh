@@ -4,7 +4,10 @@
 # run the full device bench, the perf sweep, and memfit while the tunnel is
 # up, then keep probing (the tunnel demonstrably flaps).
 cd "$(dirname "$0")/.."
+# restart-safe: if a finished device bench already produced the one-line
+# JSON this round, don't re-run it on the next successful probe
 RAN_BENCH=0
+if [ -s /tmp/bench_r5.out ]; then RAN_BENCH=1; fi
 N=0
 while true; do
   N=$((N+1))

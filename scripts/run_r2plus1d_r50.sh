@@ -1,0 +1,19 @@
+#!/usr/bin/env bash
+# R(2+1)D-50 on Kinetics (hub r2plus1d_r50 family; Tran 2018
+# arXiv:1711.11248). Sampling per the hub card: 16 frames, stride 4,
+# 224^2 crops. The factorized (2+1)D convs are MXU-dense by construction —
+# no depthwise knob needed for this family.
+set -euo pipefail
+
+python -m pytorchvideo_accelerate_tpu.run \
+  --data_dir "${DATA_DIR:-/data/kinetics}" \
+  --output_dir outputs_r2plus1d_r50 \
+  --model.name r2plus1d_r50 \
+  --num_frames 16 \
+  --sampling_rate 4 \
+  --data.crop_size 224 \
+  --batch_size 8 \
+  --num_workers 8 \
+  --checkpointing_steps epoch \
+  --with_tracking \
+  "$@"

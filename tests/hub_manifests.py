@@ -230,9 +230,92 @@ def mvit_b_manifest() -> Dict[str, Shape]:
     return m
 
 
+def _bottleneck_csn(prefix: str, cin: int, inner: int, out: int,
+                    first: bool) -> Dict[str, Shape]:
+    """One create_csn bottleneck: conv_a 1x1x1 (no temporal taps), conv_b
+    DEPTHWISE 3x3x3 (torch grouped shape (inner, 1, 3, 3, 3)), conv_c
+    1x1x1; key names identical to the plain resnet blocks."""
+    m: Dict[str, Shape] = {}
+    if first:
+        m[f"{prefix}.branch1_conv.weight"] = (out, cin, 1, 1, 1)
+        m.update(_bn(f"{prefix}.branch1_norm", out))
+    m[f"{prefix}.branch2.conv_a.weight"] = (inner, cin, 1, 1, 1)
+    m.update(_bn(f"{prefix}.branch2.norm_a", inner))
+    m[f"{prefix}.branch2.conv_b.weight"] = (inner, 1, 3, 3, 3)
+    m.update(_bn(f"{prefix}.branch2.norm_b", inner))
+    m[f"{prefix}.branch2.conv_c.weight"] = (out, inner, 1, 1, 1)
+    m.update(_bn(f"{prefix}.branch2.norm_c", out))
+    return m
+
+
+def csn_r101_manifest() -> Dict[str, Shape]:
+    """create_csn(model_depth=101): (3,7,7) stem + depthwise bottlenecks
+    at depths (3,4,23,3). Total parameters 22.1M + BN = the published hub
+    figure (22.21M)."""
+    m: Dict[str, Shape] = {"blocks.0.conv.weight": (64, 3, 3, 7, 7)}
+    m.update(_bn("blocks.0.norm", 64))
+    depths = (3, 4, 23, 3)
+    ins, inners, outs = (64, 256, 512, 1024), (64, 128, 256, 512), (
+        256, 512, 1024, 2048)
+    for s in range(4):
+        for j in range(depths[s]):
+            m.update(_bottleneck_csn(
+                f"blocks.{s + 1}.res_blocks.{j}",
+                cin=ins[s] if j == 0 else outs[s], inner=inners[s],
+                out=outs[s], first=j == 0))
+    m["blocks.5.proj.weight"] = (KINETICS_CLASSES, 2048)
+    m["blocks.5.proj.bias"] = (KINETICS_CLASSES,)
+    return m
+
+
+def _bottleneck_2plus1d(prefix: str, cin: int, inner: int,
+                        out: int, first: bool) -> Dict[str, Shape]:
+    """One create_2plus1d_bottleneck_block: conv_a 1x1x1; conv_b is a
+    Conv2plus1d container (conv_t = 1x3x3 SPATIAL factor, inner norm,
+    conv_xy = 3x1x1 temporal factor — the same swapped slot naming as the
+    X3D stem); norm_b normalizes the temporal factor's output. dim_inner
+    is carried through both factors (no parameter-matching mid-width)."""
+    m: Dict[str, Shape] = {}
+    if first:
+        m[f"{prefix}.branch1_conv.weight"] = (out, cin, 1, 1, 1)
+        m.update(_bn(f"{prefix}.branch1_norm", out))
+    m[f"{prefix}.branch2.conv_a.weight"] = (inner, cin, 1, 1, 1)
+    m.update(_bn(f"{prefix}.branch2.norm_a", inner))
+    m[f"{prefix}.branch2.conv_b.conv_t.weight"] = (inner, inner, 1, 3, 3)
+    m.update(_bn(f"{prefix}.branch2.conv_b.norm", inner))
+    m[f"{prefix}.branch2.conv_b.conv_xy.weight"] = (inner, inner, 3, 1, 1)
+    m.update(_bn(f"{prefix}.branch2.norm_b", inner))
+    m[f"{prefix}.branch2.conv_c.weight"] = (out, inner, 1, 1, 1)
+    m.update(_bn(f"{prefix}.branch2.norm_c", out))
+    return m
+
+
+def r2plus1d_r50_manifest() -> Dict[str, Shape]:
+    """create_r2plus1d(model_depth=50): plain (1,7,7) stem (NO pool —
+    spatial downsampling is all in the stage strides), 4 stages of
+    (2+1)D bottlenecks, head at blocks.5. Total parameters 28.1M =
+    the published hub figure (28.11M)."""
+    m: Dict[str, Shape] = {"blocks.0.conv.weight": (64, 3, 1, 7, 7)}
+    m.update(_bn("blocks.0.norm", 64))
+    depths = (3, 4, 6, 3)
+    ins, inners, outs = (64, 256, 512, 1024), (64, 128, 256, 512), (
+        256, 512, 1024, 2048)
+    for s in range(4):
+        for j in range(depths[s]):
+            m.update(_bottleneck_2plus1d(
+                f"blocks.{s + 1}.res_blocks.{j}",
+                cin=ins[s] if j == 0 else outs[s], inner=inners[s],
+                out=outs[s], first=j == 0))
+    m["blocks.5.proj.weight"] = (KINETICS_CLASSES, 2048)
+    m["blocks.5.proj.bias"] = (KINETICS_CLASSES,)
+    return m
+
+
 MANIFESTS = {
     "slow_r50": slow_r50_manifest,
     "slowfast_r50": slowfast_r50_manifest,
     "x3d_s": x3d_s_manifest,
     "mvit_b": mvit_b_manifest,
+    "r2plus1d_r50": r2plus1d_r50_manifest,
+    "csn_r101": csn_r101_manifest,
 }

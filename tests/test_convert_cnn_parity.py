@@ -664,3 +664,214 @@ def test_pos_embed_downscale_matches_torch_interpolate():
     got = np.asarray(jax.image.resize(
         jnp.asarray(src), (1, 2, 4, 4, 8), "trilinear", antialias=False))
     np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+# --- R(2+1)D ----------------------------------------------------------------
+
+class TConv2plus1d(nn.Module):
+    """pytorchvideo Conv2plus1d container: conv_t = SPATIAL 1x3x3 factor
+    (the swapped slot naming, as in the X3D stem), inner norm + ReLU,
+    conv_xy = temporal 3x1x1 factor; spatial stride on the spatial factor,
+    temporal stride on the temporal factor."""
+
+    def __init__(self, ch, spatial_stride=1, temporal_stride=1):
+        super().__init__()
+        self.conv_t = nn.Conv3d(ch, ch, (1, 3, 3),
+                                stride=(1, spatial_stride, spatial_stride),
+                                padding=(0, 1, 1), bias=False)
+        self.norm = nn.BatchNorm3d(ch)
+        self.conv_xy = nn.Conv3d(ch, ch, (3, 1, 1),
+                                 stride=(temporal_stride, 1, 1),
+                                 padding=(1, 0, 0), bias=False)
+
+    def forward(self, x):
+        return self.conv_xy(F.relu(self.norm(self.conv_t(x))))
+
+
+class TR2Branch2(nn.Module):
+    """(2+1)D bottleneck branch2: conv_a 1x1x1 / conv_b Conv2plus1d /
+    conv_c 1x1x1 with norms named norm_a/b/c."""
+
+    def __init__(self, cin, inner, cout, ts, ss):
+        super().__init__()
+        self.conv_a = nn.Conv3d(cin, inner, 1, bias=False)
+        self.norm_a = nn.BatchNorm3d(inner)
+        self.conv_b = TConv2plus1d(inner, spatial_stride=ss, temporal_stride=ts)
+        self.norm_b = nn.BatchNorm3d(inner)
+        self.conv_c = nn.Conv3d(inner, cout, 1, bias=False)
+        self.norm_c = nn.BatchNorm3d(cout)
+
+    def forward(self, x):
+        x = F.relu(self.norm_a(self.conv_a(x)))
+        x = F.relu(self.norm_b(self.conv_b(x)))
+        return self.norm_c(self.conv_c(x))
+
+
+class TR2Block(nn.Module):
+    def __init__(self, cin, inner, cout, ts, ss):
+        super().__init__()
+        if cin != cout or ss != 1 or ts != 1:
+            self.branch1_conv = nn.Conv3d(cin, cout, 1, stride=(ts, ss, ss),
+                                          bias=False)
+            self.branch1_norm = nn.BatchNorm3d(cout)
+        self.branch2 = TR2Branch2(cin, inner, cout, ts, ss)
+
+    def forward(self, x):
+        res = x
+        if hasattr(self, "branch1_conv"):
+            res = self.branch1_norm(self.branch1_conv(x))
+        return F.relu(res + self.branch2(x))
+
+
+class TR2Stage(nn.Module):
+    def __init__(self, cin, inner, cout, ts, ss, depth):
+        super().__init__()
+        self.res_blocks = nn.ModuleList(
+            [TR2Block(cin if i == 0 else cout, inner, cout,
+                      ts if i == 0 else 1, ss if i == 0 else 1)
+             for i in range(depth)])
+
+    def forward(self, x):
+        for b in self.res_blocks:
+            x = b(x)
+        return x
+
+
+class TorchR2Plus1DTiny(nn.Module):
+    """2-stage R(2+1)D; state_dict names = pytorchvideo create_r2plus1d
+    (blocks.0 poolless stem, blocks.N stages, blocks.5 head proj). Stage 2
+    carries BOTH a temporal and a spatial stride, so the converted branch1
+    kernel rides a (2,2,2)-strided shortcut — the geometry the full model's
+    res4/res5 entries use."""
+
+    def __init__(self, n_classes=5):
+        super().__init__()
+        self.blocks = nn.ModuleDict({
+            "0": TConvBN(3, 8, (1, 7, 7), (1, 2, 2)),
+            "1": TR2Stage(8, 8, 32, 1, 2, depth=1),
+            "2": TR2Stage(32, 16, 64, 2, 2, depth=2),
+            "5": THead(64, n_classes),
+        })
+
+    def forward(self, x):
+        x = self.blocks["0"](x)  # no stem pool in r2plus1d
+        x = self.blocks["2"](self.blocks["1"](x))
+        x = x.mean(dim=(2, 3, 4))
+        return self.blocks["5"].proj(x)
+
+
+def test_r2plus1d_forward_parity():
+    from pytorchvideo_accelerate_tpu.models.r2plus1d import R2Plus1D
+
+    tm = TorchR2Plus1DTiny().eval()
+    _randomize(tm, 3)
+    x = np.random.default_rng(3).standard_normal(
+        (2, 4, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        theirs = tm(_nchw(x)).numpy()
+
+    fm = R2Plus1D(num_classes=5, depths=(1, 2), stem_features=8,
+                  spatial_strides=(2, 2), temporal_strides=(1, 2),
+                  dropout_rate=0.0)
+    variables = fm.init(jax.random.key(0), jnp.asarray(x))
+    tree = _convert_and_check_coverage(tm, "r2plus1d_r50", variables)
+    ours = fm.apply({"params": tree["params"],
+                     "batch_stats": tree["batch_stats"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-4, atol=1e-4)
+
+
+# --- ir-CSN -----------------------------------------------------------------
+
+class TCSNBranch2(nn.Module):
+    """CSN bottleneck branch2: 1x1x1 conv_a, DEPTHWISE 3x3x3 conv_b
+    (groups=inner — both strides ride it), 1x1x1 conv_c; same key names
+    as the plain resnet blocks."""
+
+    def __init__(self, cin, inner, cout, ts, ss):
+        super().__init__()
+        self.conv_a = nn.Conv3d(cin, inner, 1, bias=False)
+        self.norm_a = nn.BatchNorm3d(inner)
+        self.conv_b = nn.Conv3d(inner, inner, 3, stride=(ts, ss, ss),
+                                padding=1, groups=inner, bias=False)
+        self.norm_b = nn.BatchNorm3d(inner)
+        self.conv_c = nn.Conv3d(inner, cout, 1, bias=False)
+        self.norm_c = nn.BatchNorm3d(cout)
+
+    def forward(self, x):
+        x = F.relu(self.norm_a(self.conv_a(x)))
+        x = F.relu(self.norm_b(self.conv_b(x)))
+        return self.norm_c(self.conv_c(x))
+
+
+class TCSNBlock(nn.Module):
+    def __init__(self, cin, inner, cout, ts, ss):
+        super().__init__()
+        if cin != cout or ss != 1 or ts != 1:
+            self.branch1_conv = nn.Conv3d(cin, cout, 1, stride=(ts, ss, ss),
+                                          bias=False)
+            self.branch1_norm = nn.BatchNorm3d(cout)
+        self.branch2 = TCSNBranch2(cin, inner, cout, ts, ss)
+
+    def forward(self, x):
+        res = x
+        if hasattr(self, "branch1_conv"):
+            res = self.branch1_norm(self.branch1_conv(x))
+        return F.relu(res + self.branch2(x))
+
+
+class TCSNStage(nn.Module):
+    def __init__(self, cin, inner, cout, ts, ss, depth):
+        super().__init__()
+        self.res_blocks = nn.ModuleList(
+            [TCSNBlock(cin if i == 0 else cout, inner, cout,
+                       ts if i == 0 else 1, ss if i == 0 else 1)
+             for i in range(depth)])
+
+    def forward(self, x):
+        for b in self.res_blocks:
+            x = b(x)
+        return x
+
+
+class TorchCSNTiny(nn.Module):
+    """2-stage ir-CSN; state_dict names = pytorchvideo create_csn =
+    create_resnet skeleton ((3,7,7) stem + 1x3x3 maxpool). Stage 2 carries
+    the (2,2,2) dual stride of the full model's res3/res4/res5 entries."""
+
+    def __init__(self, n_classes=5):
+        super().__init__()
+        self.blocks = nn.ModuleDict({
+            "0": TConvBN(3, 8, (3, 7, 7), (1, 2, 2)),
+            "1": TCSNStage(8, 8, 32, 1, 1, depth=1),
+            "2": TCSNStage(32, 16, 64, 2, 2, depth=2),
+            "5": THead(64, n_classes),
+        })
+
+    def forward(self, x):
+        x = _stem_pool(self.blocks["0"](x))
+        x = self.blocks["2"](self.blocks["1"](x))
+        x = x.mean(dim=(2, 3, 4))
+        return self.blocks["5"].proj(x)
+
+
+@pytest.mark.parametrize("impl", ["conv", "shift"])
+def test_csn_forward_parity(impl):
+    """Both depthwise lowerings must reproduce the torch grouped conv —
+    the converted (kt,kh,kw,1,C) kernel feeds either path unchanged."""
+    from pytorchvideo_accelerate_tpu.models.csn import CSN
+
+    tm = TorchCSNTiny().eval()
+    _randomize(tm, 7)
+    x = np.random.default_rng(7).standard_normal(
+        (2, 8, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        theirs = tm(_nchw(x)).numpy()
+
+    fm = CSN(num_classes=5, depths=(1, 2), stem_features=8,
+             spatial_strides=(1, 2), temporal_strides=(1, 2),
+             dropout_rate=0.0, depthwise_impl=impl)
+    variables = fm.init(jax.random.key(0), jnp.asarray(x))
+    tree = _convert_and_check_coverage(tm, "csn_r101", variables)
+    ours = fm.apply({"params": tree["params"],
+                     "batch_stats": tree["batch_stats"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-4, atol=1e-4)

@@ -31,6 +31,8 @@ from pytorchvideo_accelerate_tpu.models.mvit import MViT
 from pytorchvideo_accelerate_tpu.models.resnet3d import SlowR50
 from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
 from pytorchvideo_accelerate_tpu.models.x3d import X3D
+from pytorchvideo_accelerate_tpu.models.r2plus1d import R2Plus1D
+from pytorchvideo_accelerate_tpu.models.csn import CSN
 
 N = 400  # Kinetics-400, as shipped by the hub checkpoints
 
@@ -52,6 +54,10 @@ CASES = {
               (_spec(1, 13, 64, 64, 3),)),
     "mvit_b": (lambda: MViT(num_classes=N),
                (_spec(1, 16, 224, 224, 3),)),
+    "r2plus1d_r50": (lambda: R2Plus1D(num_classes=N),
+                     (_spec(1, 4, 32, 32, 3),)),
+    "csn_r101": (lambda: CSN(num_classes=N),
+                 (_spec(1, 8, 32, 32, 3),)),
 }
 
 
@@ -113,3 +119,6 @@ def test_manifest_sizes_are_full_depth():
     assert 33e6 < totals["slowfast_r50"] < 36.5e6, totals
     assert 3.3e6 < totals["x3d_s"] < 4.3e6, totals
     assert 35e6 < totals["mvit_b"] < 38e6, totals
+    # r2plus1d_r50 ~28.11M; csn_r101 ~22.21M
+    assert 27e6 < totals["r2plus1d_r50"] < 29.5e6, totals
+    assert 21.3e6 < totals["csn_r101"] < 23e6, totals
