@@ -104,6 +104,20 @@ def _x3d_l(cfg: ModelConfig, dtype, mesh=None):
                depthwise_impl=cfg.depthwise_impl, dtype=dtype)
 
 
+@register_model("c2d_r50")
+def _c2d_r50(cfg: ModelConfig, dtype, mesh=None):
+    """Hub `c2d_r50` (Kinetics-400 8x8): the create_resnet skeleton with
+    NO temporal convolutions anywhere — slow_r50 with all-1 temporal
+    kernels (per-frame 2D convs batched over time; parameter count 24.3M
+    = the published hub figure) plus the builder's parameterless (2,1,1)
+    temporal max-pool after res2. models/resnet3d.py."""
+    return SlowR50(
+        num_classes=cfg.num_classes, temporal_kernels=(1, 1, 1, 1),
+        stage1_temporal_pool=True,
+        dropout_rate=cfg.dropout_rate, dtype=dtype,
+    )
+
+
 @register_model("csn_r101")
 def _csn_r101(cfg: ModelConfig, dtype, mesh=None):
     """Hub `csn_r101` (ir-CSN-101, Kinetics-400 32x2); models/csn.py."""

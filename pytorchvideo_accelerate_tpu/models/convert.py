@@ -228,11 +228,11 @@ def torch_key_for(collection: str, path: Path, model: str) -> Optional[str]:
 # norm_b}. Full-depth key coverage in tests/hub_manifests.py.
 
 _R2P1D_CONVB = {
-    # torch member under branch2 -> (flax block member, is_norm)
-    "conv_b.conv_t": ("conv_b_s", False),
-    "conv_b.norm": ("conv_b_s", True),
-    "conv_b.conv_xy": ("conv_b_t", False),
-    "norm_b": ("conv_b_t", True),
+    # torch member (incl. the branch2 level) -> (flax block member, is_norm)
+    "branch2.conv_b.conv_t": ("conv_b_s", False),
+    "branch2.conv_b.norm": ("conv_b_s", True),
+    "branch2.conv_b.conv_xy": ("conv_b_t", False),
+    "branch2.norm_b": ("conv_b_t", True),
 }
 
 
@@ -302,7 +302,7 @@ def r2plus1d_torch_key_for(collection: str, path: Path) -> Optional[str]:
         for tkey, (fmember, is_norm) in _R2P1D_CONVB.items():
             if fmember == member and is_norm == (path[2] == "norm"):
                 leaf = "weight" if path[2] == "conv" else inv_bn[path[3]]
-                return f"{prefix}.branch2.{tkey}.{leaf}"
+                return f"{prefix}.{tkey}.{leaf}"
         return None
     if member in ("conv_a", "conv_c"):
         letter = member[-1]
@@ -795,6 +795,13 @@ def detect_model(sd: Dict) -> str:
         shape = np.shape(sd[k])
         if len(shape) == 5 and shape[1] == 1:
             return "csn_r101"
+    # c2d also shares the key names; its signature is a kernel-1 temporal
+    # conv_a where slow_r50 carries its (3,1,1) taps (res4 entry)
+    k = "blocks.3.res_blocks.0.branch2.conv_a.weight"
+    if k in sd:
+        shape = np.shape(sd[k])
+        if len(shape) == 5 and shape[2] == 1:
+            return "c2d_r50"
     return "slow_r50"
 
 

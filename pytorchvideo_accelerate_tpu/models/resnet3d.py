@@ -36,6 +36,11 @@ class SlowR50(nn.Module):
     depths: Tuple[int, ...] = (3, 4, 6, 3)
     stem_features: int = 64
     temporal_kernels: Tuple[int, ...] = (1, 1, 3, 3)
+    # c2d_r50 (all-2D convs): pytorchvideo's builder inserts a
+    # parameterless (2,1,1) temporal max-pool after res2 (stage1_pool) —
+    # the hub head's fixed AvgPool3d(4,7,7) at the card's 8-frame sampling
+    # requires the 8->4 reduction. Parameter shapes are unaffected.
+    stage1_temporal_pool: bool = False
     dropout_rate: float = 0.5
     dtype: Any = jnp.float32
 
@@ -63,6 +68,9 @@ class SlowR50(nn.Module):
                 dtype=self.dtype,
                 name=f"res{stage_idx + 2}",
             )(x, train)
+            if stage_idx == 0 and self.stage1_temporal_pool:
+                x = nn.max_pool(x, window_shape=(2, 1, 1),
+                                strides=(2, 1, 1), padding="VALID")
             features_inner *= 2
             features_out *= 2
 

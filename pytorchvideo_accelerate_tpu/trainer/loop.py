@@ -55,13 +55,14 @@ logger = get_logger("pva_tpu")
 
 def _parse_checkpointing_steps(value: str):
     """Reference parsing semantics (run.py:123-133): "" -> None, "epoch" ->
-    "epoch", digits -> int, else error."""
+    "epoch", digits -> int, else error. "0" normalizes to None (disabled)
+    here at parse time — the reference would crash on `step % 0`."""
     if not value:
         return None
     if value == "epoch":
         return "epoch"
     if value.isdigit():
-        return int(value)
+        return int(value) or None
     raise ValueError(
         f"checkpointing_steps must be a number or 'epoch', got {value!r}"
     )
@@ -561,9 +562,8 @@ class Trainer:
                              "grad_norm": float(metrics["grad_norm"])},
                             step=gstep,
                         )
-                    if isinstance(self.checkpointing_steps, int) and (
-                        gstep % self.checkpointing_steps == 0
-                    ):
+                    if (isinstance(self.checkpointing_steps, int)
+                            and gstep % self.checkpointing_steps == 0):
                         self._save("step", epoch)
                         main_print(f"saved checkpoint at step {gstep}")
                     if 0 <= cfg.data.limit_train_batches <= step_in_epoch + 1:

@@ -45,6 +45,12 @@ VARIANTS = [
     ("slowfast_r50", {}, dict(frames=32, crop=256, batch=4)),
     ("slowfast_r50", {}, dict(frames=32, crop=256, batch=8)),
     ("slowfast_r50", {}, dict(frames=32, crop=256, batch=16)),
+    # ir-CSN: the second depthwise consumer — same conv-vs-shift question
+    # at a different operating point (r5 model-zoo widening)
+    ("csn_r101", {"depthwise_impl": "conv"}, dict(frames=32, crop=224, batch=8)),
+    ("csn_r101", {"depthwise_impl": "shift"}, dict(frames=32, crop=224, batch=8)),
+    # R(2+1)D: factorized dense convs, pure MXU path
+    ("r2plus1d_r50", {}, dict(frames=16, crop=224, batch=8)),
 ]
 
 

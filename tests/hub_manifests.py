@@ -65,13 +65,12 @@ def _bottleneck(prefix: str, cin: int, inner: int, out: int,
     return m
 
 
-def slow_r50_manifest() -> Dict[str, Shape]:
+def _resnet50_manifest(temporal_a: Tuple[int, ...]) -> Dict[str, Shape]:
     m: Dict[str, Shape] = {"blocks.0.conv.weight": (64, 3, 1, 7, 7)}
     m.update(_bn("blocks.0.norm", 64))
     depths = (3, 4, 6, 3)
     ins, inners, outs = (64, 256, 512, 1024), (64, 128, 256, 512), (
         256, 512, 1024, 2048)
-    temporal_a = (1, 1, 3, 3)  # create_resnet stage_conv_a_kernel_size
     for s in range(4):
         for j in range(depths[s]):
             m.update(_bottleneck(
@@ -81,6 +80,18 @@ def slow_r50_manifest() -> Dict[str, Shape]:
     m["blocks.5.proj.weight"] = (KINETICS_CLASSES, 2048)
     m["blocks.5.proj.bias"] = (KINETICS_CLASSES,)
     return m
+
+
+def slow_r50_manifest() -> Dict[str, Shape]:
+    # (1,1,3,3) = create_resnet stage_conv_a_kernel_size for slow_r50
+    return _resnet50_manifest((1, 1, 3, 3))
+
+
+def c2d_r50_manifest() -> Dict[str, Shape]:
+    """c2d_r50 = the same create_resnet tree with NO temporal conv taps
+    (all conv_a 1x1x1). Total parameters 24.3M = the published hub figure
+    (24.33M) = slow_r50 minus its res4/res5 temporal taps (8.13M)."""
+    return _resnet50_manifest((1, 1, 1, 1))
 
 
 def slowfast_r50_manifest() -> Dict[str, Shape]:
@@ -318,4 +329,5 @@ MANIFESTS = {
     "mvit_b": mvit_b_manifest,
     "r2plus1d_r50": r2plus1d_r50_manifest,
     "csn_r101": csn_r101_manifest,
+    "c2d_r50": c2d_r50_manifest,
 }

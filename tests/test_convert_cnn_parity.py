@@ -875,3 +875,46 @@ def test_csn_forward_parity(impl):
     ours = fm.apply({"params": tree["params"],
                      "batch_stats": tree["batch_stats"]}, jnp.asarray(x))
     np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-4, atol=1e-4)
+
+
+# --- C2D --------------------------------------------------------------------
+
+class TorchC2DTiny(nn.Module):
+    """2-stage c2d: the create_resnet skeleton with kernel-1 conv_a
+    everywhere and the builder's parameterless (2,1,1) temporal max-pool
+    after stage 1 (hub c2d_r50's stage1_pool)."""
+
+    def __init__(self, n_classes=5):
+        super().__init__()
+        self.blocks = nn.ModuleDict({
+            "0": TConvBN(3, 8, (1, 7, 7), (1, 2, 2)),
+            "1": TStage(8, 8, 32, 1, 1, depth=1),
+            "2": TStage(32, 16, 64, 1, 2, depth=1),
+            "5": THead(64, n_classes),
+        })
+
+    def forward(self, x):
+        x = _stem_pool(self.blocks["0"](x))
+        x = self.blocks["1"](x)
+        x = F.max_pool3d(x, (2, 1, 1), (2, 1, 1))
+        x = self.blocks["2"](x)
+        x = x.mean(dim=(2, 3, 4))
+        return self.blocks["5"].proj(x)
+
+
+def test_c2d_forward_parity():
+    tm = TorchC2DTiny().eval()
+    _randomize(tm, 11)
+    x = np.random.default_rng(11).standard_normal(
+        (2, 4, 16, 16, 3)).astype(np.float32)
+    with torch.no_grad():
+        theirs = tm(_nchw(x)).numpy()
+
+    fm = SlowR50(num_classes=5, depths=(1, 1), stem_features=8,
+                 temporal_kernels=(1, 1), stage1_temporal_pool=True,
+                 dropout_rate=0.0)
+    variables = fm.init(jax.random.key(0), jnp.asarray(x))
+    tree = _convert_and_check_coverage(tm, "c2d_r50", variables)
+    ours = fm.apply({"params": tree["params"],
+                     "batch_stats": tree["batch_stats"]}, jnp.asarray(x))
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=1e-4, atol=1e-4)

@@ -58,6 +58,9 @@ CASES = {
                      (_spec(1, 4, 32, 32, 3),)),
     "csn_r101": (lambda: CSN(num_classes=N),
                  (_spec(1, 8, 32, 32, 3),)),
+    "c2d_r50": (lambda: SlowR50(num_classes=N,
+                                temporal_kernels=(1, 1, 1, 1)),
+                (_spec(1, 8, 64, 64, 3),)),
 }
 
 
@@ -119,6 +122,7 @@ def test_manifest_sizes_are_full_depth():
     assert 33e6 < totals["slowfast_r50"] < 36.5e6, totals
     assert 3.3e6 < totals["x3d_s"] < 4.3e6, totals
     assert 35e6 < totals["mvit_b"] < 38e6, totals
-    # r2plus1d_r50 ~28.11M; csn_r101 ~22.21M
+    # r2plus1d_r50 ~28.11M; csn_r101 ~22.21M; c2d_r50 ~24.33M
     assert 27e6 < totals["r2plus1d_r50"] < 29.5e6, totals
     assert 21.3e6 < totals["csn_r101"] < 23e6, totals
+    assert 23.5e6 < totals["c2d_r50"] < 25.5e6, totals

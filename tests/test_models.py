@@ -174,3 +174,38 @@ def test_csn_in_registry_with_depthwise_knob():
     x = jnp.zeros((1, 4, 32, 32, 3))
     variables = jax.eval_shape(model.init, jax.random.key(0), x)
     assert variables["params"]["head"]["proj"]["kernel"].shape == (2048, 9)
+
+
+def test_c2d_r50_param_count_and_detection():
+    """c2d_r50 = create_resnet with zero temporal taps: published count
+    ~24.33M; its state_dict must auto-detect as c2d (kernel-1 conv_a at
+    the res4 entry where slow_r50 carries (3,1,1))."""
+    from pytorchvideo_accelerate_tpu.models import convert
+
+    model = SlowR50(num_classes=400, temporal_kernels=(1, 1, 1, 1))
+    spec = jax.ShapeDtypeStruct((1, 8, 64, 64, 3), jnp.float32)
+    variables = jax.eval_shape(model.init, jax.random.key(0), spec)
+    n = _count(variables["params"])
+    assert 23.5e6 < n < 25.5e6, n
+
+    sys_path_probe = {
+        "blocks.3.res_blocks.0.branch2.conv_a.weight":
+            np.zeros((256, 512, 1, 1, 1), np.float32),
+    }
+    assert convert.detect_model(sys_path_probe) == "c2d_r50"
+    slow_probe = {
+        "blocks.3.res_blocks.0.branch2.conv_a.weight":
+            np.zeros((256, 512, 3, 1, 1), np.float32),
+    }
+    assert convert.detect_model(slow_probe) == "slow_r50"
+
+    # the builder's stage-1 temporal max-pool halves T after res2 (the hub
+    # head's AvgPool3d(4,7,7) at 8-frame sampling needs 8->4); it is
+    # parameterless, so weights are unaffected
+    tiny = SlowR50(num_classes=3, depths=(1, 1), stem_features=8,
+                   temporal_kernels=(1, 1), stage1_temporal_pool=True,
+                   dropout_rate=0.0)
+    x = jnp.zeros((1, 4, 32, 32, 3))
+    v = tiny.init(jax.random.key(0), x)
+    out = tiny.apply(v, x)
+    assert out.shape == (1, 3)
