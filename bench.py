@@ -705,6 +705,8 @@ def feed_projection(dp: dict) -> dict:
     cache_cps = dp.get("cache_clips_per_sec")
     # cache bench runs 2 reader threads (cache.bench_decode_vs_cache)
     cache_cps_per_core = cache_cps / min(2, cores) if cache_cps else None
+    cold_cps = dp.get("cache_cold_clips_per_sec")  # storage-bound (pread,
+    #                                                evicted page cache)
     per_worker = loader_cps / dp["num_workers"]
     rows = []
     for rate in (100, 200, 400):
@@ -713,12 +715,18 @@ def feed_projection(dp: dict) -> dict:
                "decode_cores_per_chip": round(rate / loader_cps_per_core, 1)}
         if cache_cps_per_core:
             row["cache_cores_per_chip"] = round(rate / cache_cps_per_core, 2)
+        if cold_cps:
+            # storage, not CPU: fraction of one cold-read stream's
+            # bandwidth a chip's appetite consumes
+            row["cache_cold_streams_per_chip"] = round(rate / cold_cps, 2)
         rows.append(row)
     out = {
         "basis": {"loader_clips_per_sec_per_core":
                   round(loader_cps_per_core, 2),
                   "measured_on_cores": cores,
-                  "cache_is_page_cache_resident": True},
+                  "cache_is_page_cache_resident": True,
+                  "cache_cold_clips_per_sec": cold_cps,
+                  "cache_cold_mb_per_sec": dp.get("cache_cold_mb_per_sec")},
         "rows": rows,
         "conclusion": ("live decode costs multiple host cores per chip, "
                        "linear in device rate; the cache path costs <0.1 — "

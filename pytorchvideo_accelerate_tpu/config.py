@@ -69,7 +69,8 @@ class DataConfig:
     # device anyway — NOT applied to VideoMAE pretraining, whose fp32
     # regression target would be quantized). "auto" follows
     # TrainConfig.mixed_precision; "fp32" keeps float32 clips.
-    host_cast: str = "auto"  # auto | fp32
+    host_cast: str = "auto"  # auto (bf16 host cast) | fp32 | u8 (ship raw
+    # uint8, normalize in-graph on device: 4x less host->HBM transfer)
     decode_audio: bool = False
     # multi-view val: views/video with view-averaged logits (the reference's
     # uniform clip-tiling eval, run.py:163); 1 = single center clip

@@ -179,8 +179,21 @@ def make_transform(
     loses nothing numerically while halving host-RAM, shm-ring, and
     host->HBM transfer bytes — the transfer is the input-bound regime's
     bottleneck at 32f/256^2 batches (~250 MB/step fp32).
+
+    `output_dtype="uint8"` goes further (4x less than fp32): normalization
+    is SKIPPED on the host and the geometric ops run on raw uint8 — the
+    jitted step applies `x*scale + bias` on device, where XLA fuses it
+    into the first conv's input read (trainer/steps.py device_normalize).
+    Bilinear resize commutes with the affine normalize, so the only
+    numeric delta vs the fp32 path is the resize's round-to-integer
+    (±0.5/255 ≈ 0.009σ at the reference std) — the returned callable
+    exposes `device_normalize = (mean, std)` so the trainer can finish
+    the job in-graph.
     """
-    if output_dtype == "float32":
+    u8_through = output_dtype == "uint8"
+    if u8_through:
+        out_dtype = np.uint8
+    elif output_dtype == "float32":
         out_dtype = np.float32
     else:
         import ml_dtypes  # jax dependency, always present
@@ -194,7 +207,8 @@ def make_transform(
 
     def _precrop_eval(frames: np.ndarray) -> np.ndarray:
         x = uniform_temporal_subsample(frames, num_frames)
-        x = normalize_u8(x, mean, std)
+        if not u8_through:
+            x = normalize_u8(x, mean, std)
         return short_side_scale(x, min_short_side_scale)
 
     def _finalize(x: np.ndarray) -> Dict[str, np.ndarray]:
@@ -213,7 +227,8 @@ def make_transform(
             raise ValueError("training transform requires an rng")
         if training:
             x = uniform_temporal_subsample(frames, num_frames)
-            x = normalize_u8(x, mean, std)
+            if not u8_through:
+                x = normalize_u8(x, mean, std)
             x = random_short_side_scale(
                 x, min_short_side_scale, max_short_side_scale, rng
             )
@@ -245,4 +260,7 @@ def make_transform(
 
         transform.spatial_views = spatial_views
     transform.num_spatial_crops = num_spatial_crops
+    # u8-through clips still need `x*scale + bias` — on device, in-graph
+    # (trainer/steps.py); None means the host already normalized
+    transform.device_normalize = (tuple(mean), tuple(std)) if u8_through else None
     return transform

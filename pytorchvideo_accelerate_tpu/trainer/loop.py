@@ -164,10 +164,22 @@ class Trainer:
         # anyway); VideoMAE pretraining is excluded — its regression target
         # is computed in fp32 from the raw clip (videomae.py patchify), so a
         # host cast would quantize the objective itself.
-        if d.host_cast not in ("auto", "fp32"):
+        # "u8" goes further: clips stay raw uint8 through the geometric
+        # transforms (4x less than fp32 everywhere host-side and over the
+        # host->HBM link) and the jitted step applies the normalize affine
+        # in-graph (steps.device_normalize_batch), where XLA fuses it into
+        # the first conv. Supervised-only, same pretraining rationale.
+        if d.host_cast not in ("auto", "fp32", "u8"):
             raise ValueError(
-                f"data.host_cast must be 'auto' or 'fp32', got {d.host_cast!r}"
+                f"data.host_cast must be 'auto', 'fp32' or 'u8', "
+                f"got {d.host_cast!r}"
             )
+        if d.host_cast == "u8" and self.is_pretraining:
+            raise ValueError(
+                "data.host_cast='u8' is supervised-only: the MAE target is "
+                "computed from the raw clip in fp32 (videomae.py patchify)"
+            )
+        u8 = d.host_cast == "u8"
         bf16 = (cfg.mixed_precision in ("bf16", "fp16")
                 and d.host_cast == "auto" and not self.is_pretraining)
         common = dict(
@@ -180,9 +192,11 @@ class Trainer:
             mean=d.mean,
             std=d.std,
             horizontal_flip_p=d.horizontal_flip_p,
-            output_dtype="bfloat16" if bf16 else "float32",
+            output_dtype=("uint8" if u8
+                          else "bfloat16" if bf16 else "float32"),
         )
         train_tf = make_transform(training=True, **common)
+        self._device_normalize = train_tf.device_normalize
 
         # multi-view eval is supervised-only: the pretrain eval step scores
         # reconstructions clip-by-clip, so a view axis would just crash it
@@ -348,9 +362,12 @@ class Trainer:
                 label_smoothing=cfg.optim.label_smoothing,
                 lr_schedule=self.lr_schedule,
                 debug_asserts=cfg.debug_asserts,
+                device_normalize=self._device_normalize,
             )
             self.eval_step = make_eval_step(
-                self.model, self.mesh, label_smoothing=cfg.optim.label_smoothing
+                self.model, self.mesh,
+                label_smoothing=cfg.optim.label_smoothing,
+                device_normalize=self._device_normalize,
             )
 
     def _capture_step_flops(self, global_batch, gstep: int) -> None:

@@ -44,6 +44,36 @@ def model_inputs(batch: dict):
     return batch["video"]
 
 
+def device_normalize_batch(batch: dict, norm) -> dict:
+    """In-graph normalize for u8-through clips (data/transforms.py
+    `output_dtype="uint8"`): the host ships raw uint8 — 4x less
+    host->HBM transfer than fp32 — and the graph applies the same
+    `x/255` + mean/std affine the host path fuses (`normalize_u8`).
+    Computed in f32 so the model's own compute-dtype cast produces
+    bit-identical bf16 to the host-normalized path; XLA fuses the
+    affine into the first conv's input read, so nothing extra is
+    materialized in HBM. No-op when `norm` is None or a clip is
+    already floating-point."""
+    if norm is None:
+        return batch
+    mean, std = norm
+    mean32 = jnp.asarray(mean, jnp.float32)
+    std32 = jnp.asarray(std, jnp.float32)
+    scale = 1.0 / (255.0 * std32)
+    bias = -mean32 / std32
+
+    def f(x):
+        if x.dtype != jnp.uint8:
+            return x
+        return x.astype(jnp.float32) * scale + bias
+
+    out = dict(batch)
+    for k in ("video", "slow", "fast"):
+        if k in out:
+            out[k] = f(out[k])
+    return out
+
+
 def _constrain_batch(batch: dict, mesh, leading_micro: bool) -> dict:
     """Pin the (global) batch dim to the DP axes inside the graph."""
     axes = (None, BATCH_AXES) if leading_micro else (BATCH_AXES,)
@@ -169,11 +199,14 @@ def make_train_step(
     label_smoothing: float = 0.0,
     lr_schedule: Optional[Callable] = None,
     debug_asserts: bool = False,
+    device_normalize=None,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
-    (state, metrics)` (see `_make_update_step`)."""
+    (state, metrics)` (see `_make_update_step`). `device_normalize`:
+    (mean, std) for u8-through batches (`device_normalize_batch`)."""
 
     def forward_loss(params, batch_stats, batch, key):
+        batch = device_normalize_batch(batch, device_normalize)
         mask = batch.get("mask")
         if mask is None:
             mask = jnp.ones(batch["label"].shape, jnp.float32)
@@ -248,7 +281,8 @@ def make_pretrain_eval_step(model, mesh) -> Callable:
     return jax.jit(eval_step)
 
 
-def make_eval_step(model, mesh, label_smoothing: float = 0.0) -> Callable:
+def make_eval_step(model, mesh, label_smoothing: float = 0.0,
+                   device_normalize=None) -> Callable:
     """Build `eval_step(state, batch) -> {loss_sum, correct, count}` —
     in-graph masked sums; the host just adds them across batches
     (trainer/metrics.py), nothing to gather.
@@ -261,6 +295,7 @@ def make_eval_step(model, mesh, label_smoothing: float = 0.0) -> Callable:
 
     def eval_step(state: TrainState, batch: dict) -> dict:
         batch = _constrain_batch(batch, mesh, leading_micro=False)
+        batch = device_normalize_batch(batch, device_normalize)
         mask = batch.get("mask")
         if mask is None:
             mask = jnp.ones(batch["label"].shape, jnp.float32)

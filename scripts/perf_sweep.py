@@ -42,6 +42,10 @@ VARIANTS = [
     ("mvit_b", {"depthwise_impl": "shift"}, dict(frames=16, crop=224, batch=8)),
     ("mvit_b", {"remat": True}, dict(frames=16, crop=224, batch=8)),
     ("mvit_b", {"remat": True}, dict(frames=16, crop=224, batch=16)),
+    # attention backend A/B: XLA-fused dense vs the hand-tiled Pallas
+    # flash kernel (ops/pallas_attention.py) — same escape-hatch question
+    # as depthwise conv-vs-shift, decided by device timing
+    ("mvit_b", {"attention": "pallas"}, dict(frames=16, crop=224, batch=8)),
     ("slowfast_r50", {}, dict(frames=32, crop=256, batch=4)),
     ("slowfast_r50", {}, dict(frames=32, crop=256, batch=8)),
     ("slowfast_r50", {}, dict(frames=32, crop=256, batch=16)),

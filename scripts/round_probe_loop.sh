@@ -29,6 +29,10 @@ EOF
     echo "bench exit: $? (out: /tmp/bench_r5.out)"
     timeout 3600 python scripts/perf_sweep.py >/tmp/sweep_r5.out 2>/tmp/sweep_r5.err
     echo "sweep exit: $?"
+    timeout 900 python -m pytorchvideo_accelerate_tpu.utils.memfit \
+      --model slowfast_r50 --frames 32 --crop 256 \
+      >/tmp/memfit_r5.out 2>/tmp/memfit_r5.err
+    echo "memfit exit: $?"
     RAN_BENCH=1
   fi
   sleep 1200

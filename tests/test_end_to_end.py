@@ -233,3 +233,32 @@ def test_profile_writes_trace(tmp_path):
     Trainer(cfg).fit()
     found = list((tmp_path / "trace").rglob("*"))
     assert any(f.is_file() for f in found), "no trace artifacts written"
+
+
+def test_parse_checkpointing_steps_zero_disables():
+    # "0" normalizes to disabled (None) at parse time; the reference
+    # stack would crash with `step % 0`
+    assert _parse_checkpointing_steps("0") is None
+
+
+def test_fit_with_u8_host_cast(tmp_path):
+    """host_cast='u8': clips ship as raw uint8 and the step normalizes
+    in-graph — training must converge the same machinery end to end, and
+    the loader batches must actually BE uint8 (the 4x transfer saving)."""
+    cfg = _cfg(tmp_path, **{"data.host_cast": "u8"})
+    tr = Trainer(cfg)
+    # sample the source directly (not the loader — its served-batch count
+    # feeds resume bookkeeping): the clip must actually BE uint8
+    assert tr.train_source.get(0, epoch=0)["video"].dtype == np.uint8
+    assert tr._device_normalize is not None
+    result = tr.fit()
+    assert result["steps"] == 4
+    assert np.isfinite(result["train_loss"])
+    assert 0.0 <= result["val_accuracy"] <= 1.0
+
+
+def test_u8_host_cast_rejected_for_pretraining(tmp_path):
+    cfg = _cfg(tmp_path, **{"data.host_cast": "u8",
+                            "model.name": "videomae_b_pretrain"})
+    with pytest.raises(ValueError, match="supervised-only"):
+        Trainer(cfg)

@@ -158,3 +158,65 @@ def test_normalize_u8_matches_unfused_pair():
     b = normalize_u8(frames, mean, std)
     assert b.dtype == np.float32
     np.testing.assert_allclose(b, a, atol=2e-6)
+
+
+def test_u8_through_path_matches_host_normalize():
+    """output_dtype='uint8' defers normalization to the device step; the
+    eval pipeline (deterministic) must produce the same final tensor as
+    the fp32 host path once the affine is applied — bilinear resize
+    commutes with the normalize affine up to uint8 rounding (±0.5 LSB)."""
+    rng = np.random.default_rng(0)
+    frames = rng.integers(0, 255, (12, 48, 64, 3), np.uint8)
+    kw = dict(num_frames=8, training=False, crop_size=32,
+              min_short_side_scale=40, max_short_side_scale=40)
+    f32 = make_transform(output_dtype="float32", **kw)
+    u8 = make_transform(output_dtype="uint8", **kw)
+    assert f32.device_normalize is None
+    mean, std = u8.device_normalize
+    a = f32(frames)["video"]
+    raw = u8(frames)["video"]
+    assert raw.dtype == np.uint8
+    b = (raw.astype(np.float32) / 255.0 - np.float32(mean)) / np.float32(std)
+    # uint8 resize rounds to integers: bound the delta by ~1 LSB in
+    # normalized units (1/255/std ≈ 0.0174) — tight enough to catch any
+    # ordering or scaling mistake, loose enough for the rounding
+    np.testing.assert_allclose(a, b, atol=1.5 / 255.0 / 0.225)
+
+
+def test_u8_through_training_keeps_uint8_and_geometry():
+    rng = np.random.default_rng(1)
+    frames = rng.integers(0, 255, (16, 48, 64, 3), np.uint8)
+    tf = make_transform(num_frames=4, training=True, is_slowfast=True,
+                        slowfast_alpha=2, crop_size=32,
+                        min_short_side_scale=36, max_short_side_scale=44,
+                        output_dtype="uint8")
+    out = tf(frames, np.random.default_rng(0))
+    assert out["slow"].dtype == np.uint8 and out["fast"].dtype == np.uint8
+    assert out["fast"].shape == (4, 32, 32, 3)
+    assert out["slow"].shape == (2, 32, 32, 3)
+    assert tf.device_normalize is not None
+
+
+def test_device_normalize_batch_matches_host_values():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchvideo_accelerate_tpu.trainer.steps import (
+        device_normalize_batch,
+    )
+
+    rng = np.random.default_rng(2)
+    clip = rng.integers(0, 255, (2, 4, 8, 8, 3), np.uint8)
+    mean, std = (0.45, 0.45, 0.45), (0.225, 0.225, 0.225)
+    batch = {"video": jnp.asarray(clip), "label": jnp.zeros(2, jnp.int32)}
+    out = device_normalize_batch(batch, (mean, std))
+    want = (clip.astype(np.float32) / 255.0 - 0.45) / 0.225
+    np.testing.assert_allclose(np.asarray(out["video"]), want, rtol=1e-6,
+                               atol=1e-6)
+    assert out["label"] is batch["label"]
+    # no-op contracts: norm=None, and float inputs pass through untouched
+    assert device_normalize_batch(batch, None) is batch
+    fbatch = {"video": jnp.ones((1, 2, 2, 2, 3), jnp.float32)}
+    np.testing.assert_array_equal(
+        np.asarray(device_normalize_batch(fbatch, (mean, std))["video"]),
+        np.ones((1, 2, 2, 2, 3), np.float32))
