@@ -33,6 +33,17 @@ EOF
       --model slowfast_r50 --frames 32 --crop 256 \
       >/tmp/memfit_r5.out 2>/tmp/memfit_r5.err
     echo "memfit exit: $?"
+    # profiler trace of the flagship step on device (VERDICT r4 item 2)
+    timeout 1800 python -m pytorchvideo_accelerate_tpu.run \
+      --data.synthetic --data.synthetic_num_videos 16 \
+      --model.name slowfast_r50 --model.num_classes 700 \
+      --num_frames 32 --data.crop_size 256 --batch_size 8 \
+      --limit_train_batches 8 --limit_val_batches 1 --num_epochs 1 \
+      --profile --profile_dir /tmp/trace_r5 \
+      --output_dir /tmp/profile_run_r5 \
+      >/tmp/profile_r5.out 2>/tmp/profile_r5.err
+    echo "profile exit: $? (trace: /tmp/trace_r5)"
+    ls -la /tmp/trace_r5 2>/dev/null | head -5
     RAN_BENCH=1
   fi
   sleep 1200
