@@ -101,7 +101,7 @@ def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
     import numpy as np
 
     from pytorchvideo_accelerate_tpu.utils.bench_setup import (
-        build_step_setup, xla_flops,
+        build_step_setup, fetch_loss, xla_flops,
     )
 
     frames, crop, bsz = wl["num_frames"], wl["crop"], wl["batch_size"]
@@ -130,15 +130,9 @@ def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
     log(f"[{name}] compile: {compile_s:.1f}s, "
         f"flops/step: {flops_per_step and f'{flops_per_step / 1e12:.2f}T'}")
 
-    # Sync discipline: `jax.block_until_ready` is ACKED EARLY by the axon
-    # forwarding backend (r3 + r5 evidence: 430%+ "MFU" with per-step
-    # block_until_ready in the loop — physically impossible, so the call
-    # returned before execution). The only sync a forwarder cannot fake is
-    # a device->host VALUE transfer: the caller holds the computed bytes.
-    # np.asarray on a *fresh* jax.Array forces exactly that (fetched values
-    # are cached per-array, hence fresh arrays throughout).
-    def _fetch(m) -> float:
-        return float(np.asarray(m["loss"]))
+    # Sync discipline: value-fetch, never block_until_ready (acked early
+    # by the axon forwarder — see utils/bench_setup.fetch_loss)
+    _fetch = fetch_loss
 
     for i in range(max(args.warmup, 1)):  # >=1: later loops read `metrics`
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(i))
@@ -146,7 +140,7 @@ def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
 
     # tunnel round-trip floor: tiny fresh result each probe, so the timing
     # is dispatch + transfer with negligible compute
-    one = jnp.ones((1,), jnp.float32) + jnp.zeros((1,), jnp.float32)
+    one = jnp.ones((1,), jnp.float32)
     rtts = []
     for i in range(5):
         y = one * float(i + 1)
@@ -705,8 +699,8 @@ def feed_projection(dp: dict) -> dict:
     cache_cps = dp.get("cache_clips_per_sec")
     # cache bench runs 2 reader threads (cache.bench_decode_vs_cache)
     cache_cps_per_core = cache_cps / min(2, cores) if cache_cps else None
-    cold_cps = dp.get("cache_cold_clips_per_sec")  # storage-bound (pread,
-    #                                                evicted page cache)
+    # storage-bound companion (pread over an evicted page cache)
+    cold_cps = dp.get("cache_cold_clips_per_sec")
     per_worker = loader_cps / dp["num_workers"]
     rows = []
     for rate in (100, 200, 400):

@@ -307,14 +307,14 @@ def _bench_cache_cold(data_path: str, ranges, n_clips: int) -> Optional[dict]:
     if not hasattr(os, "posix_fadvise"):
         return None
     try:
-        fd = os.open(data_path, os.O_RDWR)
+        fd = os.open(data_path, os.O_RDONLY)
     except OSError:
-        try:
-            fd = os.open(data_path, os.O_RDONLY)
-        except OSError:
-            return None
+        return None
     try:
-        os.fsync(fd)  # flush writeback so DONTNEED can actually evict
+        try:  # flush writeback so DONTNEED can actually evict (fsync on a
+            os.fsync(fd)  # read-only fd works on Linux; best-effort)
+        except OSError:
+            pass
         dt = 0.0
         read_bytes = 0
         for i in range(n_clips):

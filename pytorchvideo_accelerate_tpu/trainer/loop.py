@@ -47,6 +47,7 @@ from pytorchvideo_accelerate_tpu.trainer.steps import (
 )
 from pytorchvideo_accelerate_tpu.trainer.tracking import TrackerHub
 from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+from pytorchvideo_accelerate_tpu.utils.bench_setup import fetch_loss
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 from pytorchvideo_accelerate_tpu.utils.rng import RngManager, set_seed
 
@@ -586,13 +587,10 @@ class Trainer:
                     if 0 <= cfg.data.limit_train_batches <= step_in_epoch + 1:
                         break
                 if metrics is not None:
-                    # value-fetch sync, not block_until_ready: forwarding
-                    # backends (the axon tunnel) ack block_until_ready
-                    # before execution finishes, which would end the epoch
-                    # timer with work still queued; fetching the scalar's
-                    # bytes can't complete early, and the step-state chain
-                    # means the last loss implies all steps are done
-                    np.asarray(metrics["loss"])
+                    # value-fetch sync, never block_until_ready (acked
+                    # early by forwarding backends — would end the epoch
+                    # timer with work still queued; bench_setup.fetch_loss)
+                    fetch_loss(metrics)
                 epoch_train_times.append(time.time() - t_epoch)
 
                 # Evaluation (reference run.py:287-304, in-graph metric sums)

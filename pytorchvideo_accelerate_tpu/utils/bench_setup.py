@@ -138,3 +138,16 @@ def xla_flops(compiled) -> Optional[float]:
         logger.warning("cost_analysis unavailable: %s: %s",
                        type(e).__name__, e)
         return None
+
+
+def fetch_loss(metrics) -> float:
+    """Value-fetch sync for timed loops: `jax.block_until_ready` is acked
+    EARLY by the axon forwarding backend (the r3/r5 430%+ "MFU" readings —
+    physically impossible, so the call returned before execution). A
+    device->host transfer of the loss scalar's bytes cannot complete
+    early, and the step-state chain means the last loss implies every
+    prior step executed. Fetched values are cached per-array, so callers
+    must pass a FRESH array each time (each step's metrics are)."""
+    import numpy as np
+
+    return float(np.asarray(metrics["loss"]))

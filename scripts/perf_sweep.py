@@ -21,8 +21,6 @@ import subprocess
 import sys
 import time
 
-import numpy as np
-
 HERE = os.path.dirname(os.path.abspath(__file__))
 ROOT = os.path.dirname(HERE)
 sys.path.insert(0, ROOT)
@@ -81,18 +79,16 @@ def time_variant(model_name: str, overrides: dict, wl: dict, smoke: bool,
     compiled = setup.step.lower(state, gbs[0], jax.random.key(0)).compile()
     compile_s = time.perf_counter() - t0
     flops = xla_flops(compiled)
+    from pytorchvideo_accelerate_tpu.utils.bench_setup import fetch_loss
+
     for i in range(max(warmup, 1)):
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(i))
-    # value-fetch sync throughout: the axon forwarder acks
-    # block_until_ready early (bench.py r5 fix); fetching the scalar's
-    # bytes cannot return before the step (and, via the state chain,
-    # every prior step) has executed
-    float(np.asarray(metrics["loss"]))
+    fetch_loss(metrics)  # value-fetch sync, never block_until_ready
     blocked = []
     for i in range(steps):
         t0 = time.perf_counter()
         state, metrics = compiled(state, gbs[i % 2], jax.random.key(9 + i))
-        float(np.asarray(metrics["loss"]))
+        fetch_loss(metrics)
         blocked.append(time.perf_counter() - t0)
     ms = statistics.median(blocked) * 1e3
     devices = jax.devices()
