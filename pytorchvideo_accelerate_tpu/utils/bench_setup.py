@@ -33,6 +33,7 @@ class StepSetup:
     host_batch: Callable[[int], dict]  # seed -> host numpy batch
     device_batch: Callable[[int], Any]  # seed -> mesh-sharded batch
     pretrain: bool
+    input_u8: bool = False  # effective (clamped off for pretrain)
 
 
 def build_step_setup(
@@ -50,13 +51,15 @@ def build_step_setup(
     total_steps: int = 30,
     fill: str = "random",  # random | zeros (compile-only callers: zeros
     #                        pages are calloc'd, no RNG cost at big batches)
+    input_u8: bool = False,  # raw-u8 batches + in-graph normalize (the
+    #                          host_cast=u8 production path; supervised only)
 ) -> StepSetup:
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from pytorchvideo_accelerate_tpu.config import (
-        MeshConfig, ModelConfig, OptimConfig,
+        DataConfig, MeshConfig, ModelConfig, OptimConfig,
     )
     from pytorchvideo_accelerate_tpu.models import create_model
     from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
@@ -67,6 +70,7 @@ def build_step_setup(
 
     if pretrain is None:
         pretrain = is_pretrain_model(model_name)
+    input_u8 = input_u8 and not pretrain  # MAE target needs the f32 clip
     cfg = ModelConfig(name=model_name, num_classes=num_classes,
                       slowfast_alpha=alpha, **(overrides or {}))
     model = create_model(cfg, "bf16")
@@ -85,6 +89,14 @@ def build_step_setup(
         r = np.random.default_rng(seed)
 
         def clips(shape):
+            if input_u8:
+                # raw-u8 batches (the --data.host_cast u8 production path):
+                # 4x fewer bytes over the host->device link — which is the
+                # bench's single most wedge-exposed phase on the tunnel —
+                # with the normalize affine applied in-graph by the step
+                if fill == "zeros":
+                    return np.zeros(shape, np.uint8)
+                return r.integers(0, 256, shape, np.uint8)
             if fill == "zeros":
                 return np.zeros(shape, np.float32)
             return r.standard_normal(shape, dtype=np.float32)
@@ -120,10 +132,16 @@ def build_step_setup(
     if pretrain:
         step = make_pretrain_step(model, tx, mesh, accum_steps=accum)
     else:
-        step = make_train_step(model, tx, mesh, accum_steps=accum)
+        d = DataConfig()  # canonical mean/std — the stats the u8
+        #                   production path normalizes with
+        step = make_train_step(
+            model, tx, mesh, accum_steps=accum,
+            device_normalize=(d.mean, d.std) if input_u8 else None,
+        )
     return StepSetup(model=model, mesh=mesh, state=state, step=step,
                      n_chips=n_chips, global_batch=B, host_batch=host_batch,
-                     device_batch=device_batch, pretrain=pretrain)
+                     device_batch=device_batch, pretrain=pretrain,
+                     input_u8=input_u8)
 
 
 def xla_flops(compiled) -> Optional[float]:

@@ -27,11 +27,14 @@ from typing import Callable, Optional, Tuple
 
 def step_memory_bytes(model_name: str, batch: int, frames: int, crop: int,
                       num_classes: int = 700, accum: int = 1,
-                      overrides: Optional[dict] = None) -> dict:
+                      overrides: Optional[dict] = None,
+                      input_u8: bool = False) -> dict:
     """Compile the train step at `batch` (per chip) and return XLA's
     memory accounting in bytes. Compile-only: nothing executes.
     Pretrain models (videomae_b_pretrain) are handled via the shared
-    setup's pretrain branch."""
+    setup's pretrain branch. `input_u8=False` (default) sizes the fp32
+    clip layout — conservative vs the u8-ingest path (whose inputs are
+    4x smaller); pass True to fit the `--data.host_cast u8` config."""
     import jax
 
     from pytorchvideo_accelerate_tpu.utils.bench_setup import build_step_setup
@@ -40,6 +43,7 @@ def step_memory_bytes(model_name: str, batch: int, frames: int, crop: int,
         model_name, frames=frames, crop=crop, batch_per_chip=batch,
         num_classes=num_classes, accum=accum, overrides=overrides,
         devices=jax.devices()[:1], fill="zeros",  # compile-only: no RNG cost
+        input_u8=input_u8,
     )
     compiled = setup.step.lower(
         setup.state, setup.device_batch(0), jax.random.key(0)).compile()
@@ -118,6 +122,9 @@ def main(argv=None):
     ap.add_argument("--cpu", action="store_true",
                     help="force CPU-backend compile (safe when the device "
                          "tunnel is wedged; estimates are approximate)")
+    ap.add_argument("--inputs", choices=("f32", "u8"), default="f32",
+                    help="clip staging to size: f32 (conservative default) "
+                         "or the --data.host_cast u8 ingest layout")
     args = ap.parse_args(argv)
 
     import jax
@@ -134,7 +141,8 @@ def main(argv=None):
     # micro-steps: bisect over the MICRO batch k, measure k*accum
     def measure(k):
         r = step_memory_bytes(args.model, k * args.accum, args.frames,
-                              args.crop, args.num_classes, args.accum)
+                              args.crop, args.num_classes, args.accum,
+                              input_u8=args.inputs == "u8")
         print(json.dumps(r), file=sys.stderr, flush=True)
         return r["estimate_bytes"]
 

@@ -71,6 +71,8 @@ def time_variant(model_name: str, overrides: dict, wl: dict, smoke: bool,
     setup = build_step_setup(
         model_name, frames=frames, crop=crop, batch_per_chip=bsz,
         overrides=overrides, total_steps=steps + warmup,
+        input_u8=True,  # match bench.py's default staging so SWEEP.json
+        #                 rows are apples-to-apples with the bench numbers
     )
     state = setup.state
     gbs = [setup.device_batch(0), setup.device_batch(1)]
