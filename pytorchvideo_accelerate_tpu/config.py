@@ -88,7 +88,7 @@ class ModelConfig:
 
     name: str = "slow_r50"  # models.available_models(): slow_r50|slowfast_r50|
     # slowfast_r101|c2d_r50|x3d_xs|x3d_s|x3d_m|x3d_l|r2plus1d_r50|csn_r101|
-    # mvit_b|videomae_b|videomae_b_pretrain
+    # mvit_b|mvit_b_32x3|videomae_b|videomae_b_pretrain
     num_classes: int = 0  # 0 = infer from dataset labels (replaces run.py:185)
     pretrained: bool = False
     pretrained_path: str = ""  # converted torch-hub weights (models/convert.py)

@@ -153,6 +153,15 @@ def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
     )
 
 
+@register_model("mvit_b_32x3")
+def _mvit_b_32x3(cfg: ModelConfig, dtype, mesh=None):
+    """Hub `mvit_base_32x3` (32 frames x stride 3): structurally the same
+    MViT-B — the pos embeds are input-sized, so only the training recipe
+    (drop_path 0.3) and sampling geometry differ. Run with
+    --num_frames 32 --sampling_rate 3."""
+    return _mvit_b(cfg, dtype, mesh=mesh).clone(drop_path_rate=0.3)
+
+
 @register_model("videomae_b")
 def _videomae_b(cfg: ModelConfig, dtype, mesh=None):
     """Fine-tune path of BASELINE config 5 (SSv2/K400 classification)."""

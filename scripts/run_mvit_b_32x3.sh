@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# MViT-B 32x3 on Kinetics (hub mvit_base_32x3; Fan 2021 arXiv:2104.11227).
+# Same architecture as mvit_b — input-sized pos embeds — with the 32-frame
+# stride-3 sampling and the recipe's drop_path 0.3. Long-clip memory knobs:
+# --model.remat (per-block) and --model.attention ring|ulysses (context
+# parallel over the mesh).
+set -euo pipefail
+
+python -m pytorchvideo_accelerate_tpu.run \
+  --data_dir "${DATA_DIR:-/data/kinetics}" \
+  --output_dir outputs_mvit_b_32x3 \
+  --model.name mvit_b_32x3 \
+  --num_frames 32 \
+  --sampling_rate 3 \
+  --data.crop_size 224 \
+  --batch_size 8 \
+  --num_workers 8 \
+  --checkpointing_steps epoch \
+  --with_tracking \
+  "$@"

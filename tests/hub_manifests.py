@@ -196,15 +196,19 @@ MVIT_B_BLOCKS = (
 )
 
 
-def mvit_b_manifest() -> Dict[str, Shape]:
+def mvit_b_manifest(temporal_positions: int = 8) -> Dict[str, Shape]:
+    """16x4 by default (post-patch grid (8,56,56)); `temporal_positions=16`
+    is the hub's 32x3 variant (`mvit_base_32x3`) — structurally the same
+    tree, only the temporal pos-embed table differs."""
     head_dim = 96
     m: Dict[str, Shape] = {
         "patch_embed.patch_model.weight": (96, 3, 3, 7, 7),
         "patch_embed.patch_model.bias": (96,),
-        # separable pos embeds for 16x224^2 input -> (8, 56, 56) grid
+        # separable pos embeds for Tx224^2 input -> (T/2, 56, 56) grid
         "cls_positional_encoding.cls_token": (1, 1, 96),
         "cls_positional_encoding.pos_embed_spatial": (1, 56 * 56, 96),
-        "cls_positional_encoding.pos_embed_temporal": (1, 8, 96),
+        "cls_positional_encoding.pos_embed_temporal":
+            (1, temporal_positions, 96),
         "cls_positional_encoding.pos_embed_class": (1, 1, 96),
     }
     assert len(MVIT_B_BLOCKS) == 16
@@ -330,4 +334,5 @@ MANIFESTS = {
     "r2plus1d_r50": r2plus1d_r50_manifest,
     "csn_r101": csn_r101_manifest,
     "c2d_r50": c2d_r50_manifest,
+    "mvit_b_32x3": lambda: mvit_b_manifest(temporal_positions=16),
 }

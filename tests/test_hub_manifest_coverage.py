@@ -61,6 +61,9 @@ CASES = {
     "c2d_r50": (lambda: SlowR50(num_classes=N,
                                 temporal_kernels=(1, 1, 1, 1)),
                 (_spec(1, 8, 64, 64, 3),)),
+    # 32x3 MViT-B: same tree, 16-entry temporal pos-embed table
+    "mvit_b_32x3": (lambda: MViT(num_classes=N),
+                    (_spec(1, 32, 224, 224, 3),)),
 }
 
 
@@ -126,3 +129,5 @@ def test_manifest_sizes_are_full_depth():
     assert 27e6 < totals["r2plus1d_r50"] < 29.5e6, totals
     assert 21.3e6 < totals["csn_r101"] < 23e6, totals
     assert 23.5e6 < totals["c2d_r50"] < 25.5e6, totals
+    # 32x3 = mvit_b + 8 more temporal pos-embed rows (768 params)
+    assert totals["mvit_b_32x3"] - totals["mvit_b"] == 8 * 96, totals
