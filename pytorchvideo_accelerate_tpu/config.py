@@ -41,6 +41,11 @@ class DataConfig:
     """Data pipeline knobs (reference `run.py:140-183` + transform stack R6)."""
 
     data_dir: str = ""
+    # alternative to the dir-per-class tree: pytorchvideo from_csv-style
+    # `path label` list files (one video per line, space- or comma-
+    # separated, integer labels; relative paths resolve against data_dir)
+    train_list: str = ""
+    val_list: str = ""
     # pre-decoded frame cache (data/cache.py, built offline with
     # `python -m pytorchvideo_accelerate_tpu.data.cache build`): when set,
     # clips come from memmap slices instead of per-clip video decode; expects

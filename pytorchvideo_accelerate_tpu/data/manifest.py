@@ -1,10 +1,16 @@
-"""Dataset manifest: dir-per-class video index.
+"""Dataset manifest: dir-per-class video index, or a path+label list file.
 
 Replaces pytorchvideo's `Kinetics` path/label discovery and the reference's
 private-attribute label-count hack
 (`train_dataset.dataset._labeled_videos._paths_and_labels`, run.py:185) with
 an explicit, inspectable manifest over the same on-disk layout the reference
 README documents (README.md:17: `data_dir/{train,val}/{class}/*.mp4`).
+
+`from_list` additionally reads the path+label list format pytorchvideo's
+`LabeledVideoDataset.from_csv` consumes (one `relative/path.mp4 <label>`
+per line, space- or comma-separated) — how Kinetics/SSv2 splits are
+commonly distributed — so users migrating with existing .csv/.txt split
+files don't have to restructure their storage into class directories.
 """
 
 from __future__ import annotations
@@ -38,6 +44,50 @@ class Manifest:
 
     def __len__(self) -> int:
         return len(self.entries)
+
+
+def from_list(list_path: str, root: str = "") -> Manifest:
+    """Read a `path label` list file (pytorchvideo from_csv format: one
+    video per line, space- or comma-separated, label an integer id).
+    Relative paths resolve against `root`. Class ids come from the file;
+    names are synthesized (`class_<id>`) since list files carry none —
+    `Manifest.class_names` stays index-aligned either way."""
+    if not os.path.isfile(list_path):
+        raise FileNotFoundError(f"manifest list file not found: {list_path}")
+    entries: List[VideoEntry] = []
+    max_label = -1
+    with open(list_path) as f:
+        for lineno, raw in enumerate(f, 1):
+            line = raw.strip()
+            if not line or line.startswith("#"):
+                continue
+            # comma (csv) or whitespace separated; label is the LAST field
+            # so paths containing spaces survive the common space format
+            parts = (line.rsplit(",", 1) if "," in line
+                     else line.rsplit(None, 1))
+            if len(parts) != 2:
+                raise ValueError(
+                    f"{list_path}:{lineno}: expected 'path label', "
+                    f"got {line!r}")
+            path, label_s = parts[0].strip(), parts[1].strip()
+            try:
+                label = int(label_s)
+            except ValueError:
+                raise ValueError(
+                    f"{list_path}:{lineno}: label must be an integer id, "
+                    f"got {label_s!r} (dir-per-class trees carry names; "
+                    "list files carry ids)") from None
+            if label < 0:
+                raise ValueError(
+                    f"{list_path}:{lineno}: negative label {label}")
+            if root and not os.path.isabs(path):
+                path = os.path.join(root, path)
+            max_label = max(max_label, label)
+            entries.append(VideoEntry(path, label, f"class_{label}"))
+    if not entries:
+        raise ValueError(f"no entries in {list_path}")
+    class_names = [f"class_{i}" for i in range(max_label + 1)]
+    return Manifest(entries=entries, class_names=class_names)
 
 
 def scan_directory(split_dir: str) -> Manifest:

@@ -17,7 +17,7 @@ import jax
 import numpy as np
 
 from pytorchvideo_accelerate_tpu.config import TrainConfig
-from pytorchvideo_accelerate_tpu.data.manifest import scan_directory
+from pytorchvideo_accelerate_tpu.data.manifest import from_list, scan_directory
 from pytorchvideo_accelerate_tpu.data.pipeline import (
     ClipLoader,
     LoaderState,
@@ -235,8 +235,25 @@ class Trainer:
             )
             num_classes = self.train_source.num_classes
         else:
-            train_manifest = scan_directory(os.path.join(d.data_dir, "train"))
-            val_manifest = scan_directory(os.path.join(d.data_dir, "val"))
+            if d.train_list or d.val_list:
+                if not (d.train_list and d.val_list):
+                    raise ValueError(
+                        "train_list and val_list must be set together "
+                        "(mixing a list split with a scanned split would "
+                        "give the two splits different label id spaces)")
+                train_manifest = from_list(d.train_list, root=d.data_dir)
+                val_manifest = from_list(d.val_list, root=d.data_dir)
+                val_max = max(e.label for e in val_manifest.entries)
+                if val_max >= train_manifest.num_classes:
+                    raise ValueError(
+                        f"val_list label {val_max} is outside the train "
+                        f"list's class space (num_classes="
+                        f"{train_manifest.num_classes}): out-of-range "
+                        "labels would silently corrupt eval metrics")
+            else:
+                train_manifest = scan_directory(
+                    os.path.join(d.data_dir, "train"))
+                val_manifest = scan_directory(os.path.join(d.data_dir, "val"))
             num_classes = train_manifest.num_classes  # replaces run.py:185
             self.train_source = VideoClipSource(
                 train_manifest, train_tf, cfg.clip_duration, training=True,
