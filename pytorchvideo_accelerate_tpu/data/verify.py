@@ -105,6 +105,10 @@ def main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("split_dir")
+    ap.add_argument("--list", dest="list_file", default="",
+                    help="audit a 'path label' list file instead of "
+                         "scanning split_dir/{class}/ (from_list format; "
+                         "relative paths resolve against split_dir)")
     ap.add_argument("--clip_duration", type=float, default=0.0,
                     help="flag videos shorter than this many seconds")
     ap.add_argument("--num_workers", type=int, default=8)
@@ -112,8 +116,13 @@ def main(argv=None) -> int:
                     help="also decode one mid-file frame per video")
     args = ap.parse_args(argv)
 
+    manifest = None
+    if args.list_file:
+        from pytorchvideo_accelerate_tpu.data.manifest import from_list
+
+        manifest = from_list(args.list_file, root=args.split_dir)
     report = verify_tree(args.split_dir, args.clip_duration,
-                         args.num_workers, args.deep)
+                         args.num_workers, args.deep, manifest=manifest)
     print(json.dumps(report, indent=1))
     if report["unreadable"]:
         return 1
