@@ -106,8 +106,10 @@ class ModelConfig:
     mask_ratio: float = 0.9  # VideoMAE pretrain tube-mask ratio
     # depthwise-conv lowering for X3D / MViT pooling (ops/depthwise.py):
     # "conv" = XLA grouped convolution; "shift" = tap decomposition into
-    # fused VPU multiply-adds. Same param tree either way; A/B on device
-    # with scripts/perf_sweep.py
+    # fused VPU multiply-adds; "pallas" = hand-tiled halo kernel (one
+    # HBM->VMEM DMA per output tile; stride-1 blocks only, strided entries
+    # fall back to conv). Same param tree in all cases; A/B on device with
+    # scripts/perf_sweep.py
     depthwise_impl: str = "conv"
     # per-block jax.checkpoint (rematerialization): only block-boundary
     # activations (plus one block's interior at a time) stay resident,

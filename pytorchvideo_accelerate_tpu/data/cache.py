@@ -345,6 +345,10 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="cmd", required=True)
     b = sub.add_parser("build", help="decode a manifest directory into a cache")
     b.add_argument("--data_dir", required=True)
+    b.add_argument("--list", dest="list_file", default="",
+                   help="build from a 'path label' list file instead of "
+                        "scanning data_dir/{class}/ (manifest.from_list "
+                        "format; relative paths resolve against data_dir)")
     b.add_argument("--out", required=True)
     b.add_argument("--fps", type=float, default=30.0)
     b.add_argument("--short_side", type=int, default=320)
@@ -358,9 +362,14 @@ def main(argv=None):
     args = ap.parse_args(argv)
 
     if args.cmd == "build":
+        manifest = None
+        if args.list_file:
+            from pytorchvideo_accelerate_tpu.data.manifest import from_list
+
+            manifest = from_list(args.list_file, root=args.data_dir)
         index = build_cache(args.data_dir, args.out, fps=args.fps,
                             short_side=args.short_side,
-                            num_workers=args.num_workers)
+                            num_workers=args.num_workers, manifest=manifest)
         total = sum(v["frames"] for v in index["videos"])
         size = os.path.getsize(os.path.join(args.out, DATA_NAME))
         print(f"cached {len(index['videos'])} videos, {total} frames, "

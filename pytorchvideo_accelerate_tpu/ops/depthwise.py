@@ -13,13 +13,20 @@ that is 27 fused FMAs over the tensor: arithmetic intensity is low but so
 is the op's share of FLOPs; what matters is not starving on a bad grouped
 matmul schedule.
 
+A third lowering, `pallas`, is the hand-tiled halo kernel
+(ops/pallas_depthwise.py): one HBM->VMEM DMA per output tile (tile +
+halo), all taps accumulated from the single VMEM-resident window — the
+explicit-bandwidth answer where the shift decomposition's fused reads
+may re-amplify. Stride-1 only (the non-entry blocks, which dominate);
+strided calls under `pallas` fall back to the XLA grouped path.
+
 Which implementation wins is an empirical, device-level question —
-`scripts/perf_sweep.py` A/Bs them on real hardware. Both impls create the
+`scripts/perf_sweep.py` A/Bs them on real hardware. All impls create the
 SAME parameter ("kernel", shape (kt, kh, kw, 1, C)) at the module's own
 scope — exactly the tree `nn.Conv(feature_group_count=C, name=<same>)`
 would create — so converted/pretrained checkpoints load identically and
-the choice is a deployment knob (`--model.depthwise_impl shift|conv`),
-not a model change.
+the choice is a deployment knob (`--model.depthwise_impl
+conv|shift|pallas`), not a model change.
 """
 
 from __future__ import annotations
@@ -87,14 +94,14 @@ class DepthwiseConv3D(nn.Module):
     features: int
     kernel_size: Tuple[int, int, int]
     stride: Tuple[int, int, int] = (1, 1, 1)
-    impl: str = "conv"  # conv (XLA grouped) | shift (tap decomposition)
+    impl: str = "conv"  # conv (XLA grouped) | shift (taps) | pallas (halo)
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        if self.impl not in ("conv", "shift"):
+        if self.impl not in ("conv", "shift", "pallas"):
             raise ValueError(
-                f"depthwise impl must be conv|shift, got {self.impl!r}")
+                f"depthwise impl must be conv|shift|pallas, got {self.impl!r}")
         kt, kh, kw = self.kernel_size
         kernel = self.param(
             "kernel",
@@ -106,6 +113,17 @@ class DepthwiseConv3D(nn.Module):
         kernel = kernel.astype(self.dtype)
         if self.impl == "shift":
             return depthwise_conv3d_shift(x, kernel, self.stride)
+        if (self.impl == "pallas" and self.stride == (1, 1, 1)
+                and all(k % 2 for k in self.kernel_size)):
+            from pytorchvideo_accelerate_tpu.ops.pallas_depthwise import (
+                pallas_depthwise3d_s1,
+            )
+
+            return pallas_depthwise3d_s1(x, kernel)
+        # strided or even-kernel pallas calls fall through to the XLA
+        # grouped path (the halo kernel hard-codes odd-kernel SAME
+        # semantics; every in-tree consumer is odd, but an even kernel
+        # must not silently change function)
         return lax.conv_general_dilated(
             x, kernel,
             window_strides=self.stride,

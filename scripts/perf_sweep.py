@@ -34,6 +34,7 @@ def log(*a):
 VARIANTS = [
     ("x3d_s", {"depthwise_impl": "conv"}, dict(frames=13, crop=160, batch=8)),
     ("x3d_s", {"depthwise_impl": "shift"}, dict(frames=13, crop=160, batch=8)),
+    ("x3d_s", {"depthwise_impl": "pallas"}, dict(frames=13, crop=160, batch=8)),
     ("x3d_s", {"depthwise_impl": "conv"}, dict(frames=13, crop=160, batch=16)),
     ("x3d_s", {"depthwise_impl": "shift"}, dict(frames=13, crop=160, batch=16)),
     ("mvit_b", {"depthwise_impl": "conv"}, dict(frames=16, crop=224, batch=8)),
@@ -51,6 +52,7 @@ VARIANTS = [
     # at a different operating point (r5 model-zoo widening)
     ("csn_r101", {"depthwise_impl": "conv"}, dict(frames=32, crop=224, batch=8)),
     ("csn_r101", {"depthwise_impl": "shift"}, dict(frames=32, crop=224, batch=8)),
+    ("csn_r101", {"depthwise_impl": "pallas"}, dict(frames=32, crop=224, batch=8)),
     # R(2+1)D: factorized dense convs, pure MXU path
     ("r2plus1d_r50", {}, dict(frames=16, crop=224, batch=8)),
 ]
