@@ -72,7 +72,17 @@ WORKLOADS = {
     "mvit_b": dict(num_frames=16, crop=224, batch_size=8, pretrain=False),
     "videomae_b_pretrain": dict(num_frames=16, crop=224, batch_size=8,
                                 pretrain=True),
+    # r5 zoo additions — opt-in (--models), not in the default set: the
+    # default bench covers the four BASELINE configs and every extra child
+    # spends scarce tunnel-window minutes
+    "r2plus1d_r50": dict(num_frames=16, crop=224, batch_size=8,
+                         pretrain=False),
+    "csn_r101": dict(num_frames=32, crop=224, batch_size=8, pretrain=False),
 }
+
+# the driver's plain `python bench.py` measures these (BASELINE configs);
+# `--models all` or explicit names reach the rest of WORKLOADS
+DEFAULT_MODELS = ("slowfast_r50", "x3d_s", "mvit_b", "videomae_b_pretrain")
 
 
 def _utcnow() -> str:
@@ -507,8 +517,11 @@ def child_main(args) -> None:
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--models", default="all",
-                    help="comma list of " + ",".join(WORKLOADS) + " or 'all'")
+    ap.add_argument("--models", default="default",
+                    help="comma list of " + ",".join(WORKLOADS)
+                         + "; 'default' = the BASELINE four ("
+                         + ",".join(DEFAULT_MODELS) + "); 'all' = every "
+                         "workload incl. the r5 zoo additions")
     ap.add_argument("--alpha", type=int, default=4)
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--inputs", choices=("u8", "f32"), default="u8",
@@ -563,7 +576,12 @@ def main():
             log("TPU unreachable on first probe; will re-probe between "
                 "models — CPU smoke numbers are NOT device numbers")
 
-    names = list(WORKLOADS) if args.models == "all" else args.models.split(",")
+    if args.models == "default":
+        names = list(DEFAULT_MODELS)
+    elif args.models == "all":
+        names = list(WORKLOADS)
+    else:
+        names = args.models.split(",")
 
     def bench_one(name, smoke):
         # smoke children are capped tighter (tiny shapes) but still honor

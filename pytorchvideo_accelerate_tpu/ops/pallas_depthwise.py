@@ -16,8 +16,19 @@ window (the tile plus its (k-1)-halo, full W and C) from HBM into VMEM,
 then accumulates all taps on the VPU in f32 from that single resident
 copy — each input element crosses HBM->VMEM once per tile (plus halo
 overlap ~ (tb+2)(hb+2)/(tb*hb) ≈ 1.56x at 8x8 tiles), and the output
-tile is written once. Whether that beats XLA's schedule is a device
-question — `scripts/perf_sweep.py` A/Bs all three lowerings.
+tile is written once.
+
+Honest bandwidth accounting: the wrapper pre-pads the input with
+`jnp.pad` (pallas_call is opaque to XLA, so the padded tensor
+materializes in HBM — one extra read+write of x per call, ~2x on top of
+the kernel's own traffic). Net: ~3.5x input reads vs the shift path's
+up-to-27x if XLA's tap fusion re-reads per tap — still the bandwidth
+favorite on paper, but the pad copy is why this is an A/B candidate and
+not a default. In-kernel clamped DMA windows would remove the copy at
+the cost of per-tile boundary masking; do that if the sweep shows this
+lowering winning but by less than the pad traffic. Whether any of it
+beats XLA's schedule is a device question — `scripts/perf_sweep.py`
+A/Bs all three lowerings.
 
 Scope: stride 1 (the 22/26 X3D and 29/33 ir-CSN blocks; strided stage
 entries fall back to the XLA grouped path in ops/depthwise.py). Training

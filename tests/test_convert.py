@@ -62,8 +62,27 @@ def slowfast_vars():
     )
 
 
+@pytest.fixture(scope="module")
+def r2plus1d_vars():
+    from pytorchvideo_accelerate_tpu.models.r2plus1d import R2Plus1D
+
+    model = R2Plus1D(num_classes=7, depths=(1, 1), stem_features=8,
+                     spatial_strides=(1, 2), temporal_strides=(1, 2))
+    return model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+
+
+@pytest.fixture(scope="module")
+def csn_vars():
+    from pytorchvideo_accelerate_tpu.models.csn import CSN
+
+    model = CSN(num_classes=7, depths=(1, 1), stem_features=8,
+                spatial_strides=(1, 2), temporal_strides=(1, 2))
+    return model.init(jax.random.key(0), jnp.zeros((1, 4, 32, 32, 3)))
+
+
 @pytest.mark.parametrize("fixture,model", [
     ("slow_vars", "slow_r50"), ("slowfast_vars", "slowfast_r50"),
+    ("r2plus1d_vars", "r2plus1d_r50"), ("csn_vars", "csn_r101"),
 ])
 def test_full_tree_round_trip(fixture, model, request):
     """Every param/batch_stat of the architecture maps torch->flax with the
