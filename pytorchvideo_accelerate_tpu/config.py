@@ -132,6 +132,14 @@ class OptimConfig:
     warmup_steps: int = 0
     label_smoothing: float = 0.0
     grad_clip_norm: float = 0.0  # 0 = off
+    # in-graph mixup (Zhang 2018 arXiv:1710.09412): lambda ~ Beta(a, a)
+    # per step, clips mixed with the FLIPPED batch on device (timm's
+    # pairing — a static reversal GSPMD lowers to a one-hop collective
+    # permute, not the cross-device gather a random permutation would
+    # cost), loss = lam*CE(y) + (1-lam)*CE(y_flip). The MViT/SlowFast
+    # K400 recipes train with it (alpha 0.8 typical); 0 = off.
+    # Supervised steps only.
+    mixup_alpha: float = 0.0
 
 
 @dataclass

@@ -381,6 +381,7 @@ class Trainer:
                 lr_schedule=self.lr_schedule,
                 debug_asserts=cfg.debug_asserts,
                 device_normalize=self._device_normalize,
+                mixup_alpha=cfg.optim.mixup_alpha,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh,

@@ -200,16 +200,46 @@ def make_train_step(
     lr_schedule: Optional[Callable] = None,
     debug_asserts: bool = False,
     device_normalize=None,
+    mixup_alpha: float = 0.0,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
     (state, metrics)` (see `_make_update_step`). `device_normalize`:
-    (mean, std) for u8-through batches (`device_normalize_batch`)."""
+    (mean, std) for u8-through batches (`device_normalize_batch`).
+    `mixup_alpha > 0`: in-graph mixup — clips mixed with a batch
+    permutation on device (the MViT/SlowFast K400 recipes' augmentation,
+    free of host cost), loss mixed as lam*CE(y) + (1-lam)*CE(y_perm);
+    reported accuracy counts the dominant label."""
 
     def forward_loss(params, batch_stats, batch, key):
         batch = device_normalize_batch(batch, device_normalize)
         mask = batch.get("mask")
         if mask is None:
             mask = jnp.ones(batch["label"].shape, jnp.float32)
+        labels2 = None
+        lam = 1.0
+        if mixup_alpha > 0:
+            if batch.get("mask") is not None:
+                raise ValueError(
+                    "mixup with an explicit batch mask is unsupported: "
+                    "padded rows would mix into real clips (the train "
+                    "loader is drop_last, so this can't arise through "
+                    "Trainer)")
+            key, kmix = jax.random.split(key)
+            lam = jax.random.beta(kmix, mixup_alpha, mixup_alpha)
+            # mixup runs AFTER the u8 normalize (floats required). Pairing
+            # is the flipped batch (timm's convention): a STATIC reversal,
+            # which GSPMD lowers to a one-hop collective permute of the
+            # clip tensor — a random global permutation would force a
+            # cross-device gather of the whole batch every step. Every
+            # clip pathway flips together so slow/fast stay paired.
+            batch = dict(batch)
+            for k in ("video", "slow", "fast"):
+                if k in batch:
+                    x = batch[k]
+                    mixed = (lam * x.astype(jnp.float32)
+                             + (1.0 - lam) * x[::-1].astype(jnp.float32))
+                    batch[k] = mixed.astype(x.dtype)
+            labels2 = batch["label"][::-1]
         logits, updates = model.apply(
             {"params": params, "batch_stats": batch_stats},
             model_inputs(batch),
@@ -217,9 +247,18 @@ def make_train_step(
             rngs={"dropout": key},
             mutable=["batch_stats"],
         )
-        loss, correct, count = _loss_and_metrics(
-            logits, batch["label"], mask, label_smoothing
-        )
+        if mixup_alpha > 0:
+            loss_a, correct_a, count = _loss_and_metrics(
+                logits, batch["label"], mask, label_smoothing)
+            loss_b, correct_b, _ = _loss_and_metrics(
+                logits, labels2, mask[::-1], label_smoothing)
+            loss = lam * loss_a + (1.0 - lam) * loss_b
+            # dominant-label accuracy (the standard mixup report)
+            correct = jnp.where(lam >= 0.5, correct_a, correct_b)
+        else:
+            loss, correct, count = _loss_and_metrics(
+                logits, batch["label"], mask, label_smoothing
+            )
         return loss, (updates["batch_stats"], correct, count)
 
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)

@@ -226,3 +226,46 @@ def test_warmup_schedule():
     assert float(sched(0)) == 0.0
     assert abs(float(sched(10)) - 0.1) < 1e-6
     assert float(sched(110)) < 1e-8
+
+
+def test_mixup_invariant_on_identical_batch(mesh8):
+    """Mixing identical clips/labels is a mathematical no-op: the mixup
+    step's loss must equal the plain step's on such a batch, for ANY
+    sampled lambda/permutation — locks the convex-combination math."""
+    model = TinyDense()
+    clip = np.random.RandomState(0).randn(1, 2, 8, 8, 3).astype(np.float32)
+    batch = {"video": np.repeat(clip, 8, axis=0),
+             "label": np.full(8, 2, np.int32)}
+    variables = model.init(jax.random.key(0), jnp.asarray(batch["video"]))
+    tx = build_optimizer(OptimConfig(lr=0.0, weight_decay=0.0),
+                         total_steps=4)
+    mk = lambda a: make_train_step(_NoBN(model), tx, mesh8, mixup_alpha=a)
+    gb = shard_batch(mesh8, batch)
+    fresh = lambda: TrainState.create(  # steps donate state buffers
+        jax.tree.map(jnp.array, variables["params"]), {}, tx)
+    _, m_plain = mk(0.0)(fresh(), gb, jax.random.key(7))
+    _, m_mix = mk(0.8)(fresh(), gb, jax.random.key(7))
+    np.testing.assert_allclose(float(m_mix["loss"]), float(m_plain["loss"]),
+                               rtol=1e-5)
+
+
+def test_mixup_is_active_on_distinct_batch(mesh8):
+    """With distinct clips/labels the mixed loss differs from the plain
+    loss (the augmentation actually fires) and stays finite, as do the
+    params after the update."""
+    model = TinyDense()
+    batch = _synthetic_batch(8)
+    variables = model.init(jax.random.key(0), jnp.asarray(batch["video"]))
+    tx = build_optimizer(OptimConfig(lr=0.05, weight_decay=0.0),
+                         total_steps=4)
+    gb = shard_batch(mesh8, batch)
+    fresh = lambda: TrainState.create(  # steps donate state buffers
+        jax.tree.map(jnp.array, variables["params"]), {}, tx)
+    _, m_plain = make_train_step(_NoBN(model), tx, mesh8)(
+        fresh(), gb, jax.random.key(3))
+    s1, m_mix = make_train_step(_NoBN(model), tx, mesh8, mixup_alpha=0.8)(
+        fresh(), gb, jax.random.key(3))
+    assert np.isfinite(float(m_mix["loss"]))
+    assert abs(float(m_mix["loss"]) - float(m_plain["loss"])) > 1e-6
+    for leaf in jax.tree.leaves(s1.params):
+        assert np.all(np.isfinite(np.asarray(leaf)))
