@@ -140,6 +140,12 @@ class OptimConfig:
     # K400 recipes train with it (alpha 0.8 typical); 0 = off.
     # Supervised steps only.
     mixup_alpha: float = 0.0
+    # in-graph cutmix (Yun 2019 arXiv:1905.04899): a spatial box of the
+    # flipped clip (shared across time), label weight = kept-area
+    # fraction; when both alphas are on, a coin picks mixup OR cutmix per
+    # forward — per MICRO-batch under grad accumulation (timm's
+    # switching at micro granularity). 1.0 typical; 0 = off.
+    cutmix_alpha: float = 0.0
 
 
 @dataclass

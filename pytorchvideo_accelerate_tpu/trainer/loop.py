@@ -382,6 +382,7 @@ class Trainer:
                 debug_asserts=cfg.debug_asserts,
                 device_normalize=self._device_normalize,
                 mixup_alpha=cfg.optim.mixup_alpha,
+                cutmix_alpha=cfg.optim.cutmix_alpha,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh,

@@ -201,14 +201,21 @@ def make_train_step(
     debug_asserts: bool = False,
     device_normalize=None,
     mixup_alpha: float = 0.0,
+    cutmix_alpha: float = 0.0,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
     (state, metrics)` (see `_make_update_step`). `device_normalize`:
     (mean, std) for u8-through batches (`device_normalize_batch`).
-    `mixup_alpha > 0`: in-graph mixup — clips mixed with a batch
-    permutation on device (the MViT/SlowFast K400 recipes' augmentation,
-    free of host cost), loss mixed as lam*CE(y) + (1-lam)*CE(y_perm);
-    reported accuracy counts the dominant label."""
+    `mixup_alpha > 0` / `cutmix_alpha > 0`: in-graph mixup / cutmix (the
+    MViT/SlowFast K400 recipes' augmentations, free of host cost), both
+    expressed as one per-pixel weight w against the FLIPPED batch:
+    out = w*x + (1-w)*x_flip — mixup is w = lam everywhere, cutmix is a
+    spatial box of zeros (shared across time, the video convention) —
+    with loss lam_eff*CE(y) + (1-lam_eff)*CE(y_flip), lam_eff = mean(w).
+    Both on: a coin picks one per forward — i.e. per MICRO-batch under
+    gradient accumulation, each drawing its own mode/lambda/box (timm's
+    switching, at micro granularity). Reported accuracy counts the
+    dominant label."""
 
     def forward_loss(params, batch_stats, batch, key):
         batch = device_normalize_batch(batch, device_normalize)
@@ -217,27 +224,63 @@ def make_train_step(
             mask = jnp.ones(batch["label"].shape, jnp.float32)
         labels2 = None
         lam = 1.0
-        if mixup_alpha > 0:
+        if mixup_alpha > 0 or cutmix_alpha > 0:
             if batch.get("mask") is not None:
                 raise ValueError(
-                    "mixup with an explicit batch mask is unsupported: "
-                    "padded rows would mix into real clips (the train "
-                    "loader is drop_last, so this can't arise through "
-                    "Trainer)")
-            key, kmix = jax.random.split(key)
-            lam = jax.random.beta(kmix, mixup_alpha, mixup_alpha)
-            # mixup runs AFTER the u8 normalize (floats required). Pairing
-            # is the flipped batch (timm's convention): a STATIC reversal,
-            # which GSPMD lowers to a one-hop collective permute of the
-            # clip tensor — a random global permutation would force a
-            # cross-device gather of the whole batch every step. Every
+                    "mixup/cutmix with an explicit batch mask is "
+                    "unsupported: padded rows would mix into real clips "
+                    "(the train loader is drop_last, so this can't arise "
+                    "through Trainer)")
+            # mixing runs AFTER the u8 normalize (floats required).
+            # Pairing is the flipped batch (timm's convention): a STATIC
+            # reversal, which GSPMD lowers to a one-hop collective permute
+            # of the clip tensor — a random global permutation would force
+            # a cross-device gather of the whole batch every step. Every
             # clip pathway flips together so slow/fast stay paired.
+            key, kmix, kbox, kswitch = jax.random.split(key, 4)
+            some_clip = next(batch[k] for k in ("video", "slow", "fast")
+                             if k in batch)
+            hh, ww = some_clip.shape[-3], some_clip.shape[-2]
+            use_cutmix = cutmix_alpha > 0 and (
+                mixup_alpha <= 0
+                or jax.random.bernoulli(kswitch))
+            if mixup_alpha > 0 and cutmix_alpha > 0:
+                lam_mix = jax.random.beta(kmix, mixup_alpha, mixup_alpha)
+                lam_cut = jax.random.beta(kmix, cutmix_alpha, cutmix_alpha)
+            else:
+                a = mixup_alpha if mixup_alpha > 0 else cutmix_alpha
+                lam_mix = lam_cut = jax.random.beta(kmix, a, a)
+
+            def _cut_weight():
+                # spatial box of the flipped clip, shared across time
+                # (video cutmix convention); area approx (1 - lam_cut)
+                rh = jnp.sqrt(1.0 - lam_cut) * hh
+                rw = jnp.sqrt(1.0 - lam_cut) * ww
+                cy = jax.random.uniform(kbox, (), minval=0.0, maxval=1.0) * hh
+                cx = jax.random.uniform(
+                    jax.random.fold_in(kbox, 1), (), minval=0.0,
+                    maxval=1.0) * ww
+                y0, y1 = cy - rh / 2, cy + rh / 2
+                x0, x1 = cx - rw / 2, cx + rw / 2
+                ih = jax.lax.broadcasted_iota(jnp.float32, (hh, ww), 0)
+                iw = jax.lax.broadcasted_iota(jnp.float32, (hh, ww), 1)
+                inside = ((ih >= y0) & (ih < y1) & (iw >= x0) & (iw < x1))
+                return 1.0 - inside.astype(jnp.float32)  # (H, W)
+
+            if cutmix_alpha > 0:
+                w_hw = jnp.where(use_cutmix, _cut_weight(),
+                                 jnp.full((hh, ww), lam_mix))
+            else:
+                w_hw = jnp.full((hh, ww), lam_mix)
+            # effective label weight = mean pixel weight (exact for both)
+            lam = jnp.mean(w_hw)
+            w = w_hw[None, None, :, :, None]  # (1,1,H,W,1) vs (B,T,H,W,C)
             batch = dict(batch)
             for k in ("video", "slow", "fast"):
                 if k in batch:
                     x = batch[k]
-                    mixed = (lam * x.astype(jnp.float32)
-                             + (1.0 - lam) * x[::-1].astype(jnp.float32))
+                    mixed = (w * x.astype(jnp.float32)
+                             + (1.0 - w) * x[::-1].astype(jnp.float32))
                     batch[k] = mixed.astype(x.dtype)
             labels2 = batch["label"][::-1]
         logits, updates = model.apply(
@@ -247,7 +290,7 @@ def make_train_step(
             rngs={"dropout": key},
             mutable=["batch_stats"],
         )
-        if mixup_alpha > 0:
+        if labels2 is not None:
             loss_a, correct_a, count = _loss_and_metrics(
                 logits, batch["label"], mask, label_smoothing)
             loss_b, correct_b, _ = _loss_and_metrics(

@@ -269,3 +269,30 @@ def test_mixup_is_active_on_distinct_batch(mesh8):
     assert abs(float(m_mix["loss"]) - float(m_plain["loss"])) > 1e-6
     for leaf in jax.tree.leaves(s1.params):
         assert np.all(np.isfinite(np.asarray(leaf)))
+
+
+def test_cutmix_invariant_on_identical_batch(mesh8):
+    """Cutting a box from an identical flipped batch changes nothing:
+    cutmix loss == plain loss on an identical-clip batch, locking both
+    the box mix and the lam_eff = mean-weight label math."""
+    model = TinyDense()
+    clip = np.random.RandomState(1).randn(1, 2, 8, 8, 3).astype(np.float32)
+    batch = {"video": np.repeat(clip, 8, axis=0),
+             "label": np.full(8, 1, np.int32)}
+    variables = model.init(jax.random.key(0), jnp.asarray(batch["video"]))
+    tx = build_optimizer(OptimConfig(lr=0.0, weight_decay=0.0),
+                         total_steps=4)
+    gb = shard_batch(mesh8, batch)
+    fresh = lambda: TrainState.create(
+        jax.tree.map(jnp.array, variables["params"]), {}, tx)
+    _, m_plain = make_train_step(_NoBN(model), tx, mesh8)(
+        fresh(), gb, jax.random.key(11))
+    _, m_cut = make_train_step(_NoBN(model), tx, mesh8, cutmix_alpha=1.0)(
+        fresh(), gb, jax.random.key(11))
+    np.testing.assert_allclose(float(m_cut["loss"]), float(m_plain["loss"]),
+                               rtol=1e-5)
+    # and the combined switch path compiles/runs finitely too
+    _, m_both = make_train_step(_NoBN(model), tx, mesh8, mixup_alpha=0.8,
+                                cutmix_alpha=1.0)(fresh(), gb,
+                                                  jax.random.key(12))
+    assert np.isfinite(float(m_both["loss"]))
