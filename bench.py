@@ -340,9 +340,9 @@ def bench_data(args) -> dict:
         epochs = 2 if args.smoke else 4
         n_workers = 2 if args.smoke else 4
         out["num_workers"] = n_workers
-        for transport in ("thread", "process"):
-            src = VideoClipSource(manifest, tf, clip_duration, training=True,
-                                  seed=0)
+        def run_loader(key: str, transform, transport: str):
+            src = VideoClipSource(manifest, transform, clip_duration,
+                                  training=True, seed=0)
             loader = ClipLoader(src, global_batch_size=4, shuffle=True,
                                 num_workers=n_workers, transport=transport)
             try:
@@ -353,13 +353,22 @@ def bench_data(args) -> dict:
                 for ep in range(1, epochs + 1):
                     for batch in loader.epoch(ep):
                         clips += batch["label"].shape[0]
-                dt = time.perf_counter() - t0
-                key = f"loader_{transport}_clips_per_sec"
-                out[key] = round(clips / dt, 2)
+                out[key] = round(clips / (time.perf_counter() - t0), 2)
                 if loader.transport != transport:  # native lib unavailable
                     out[key + "_note"] = f"fell back to {loader.transport}"
             finally:
                 loader.close()
+
+        run_loader("loader_thread_clips_per_sec", tf, "thread")
+        run_loader("loader_process_clips_per_sec", tf, "process")
+        # u8-through transform (host_cast=u8): quantifies the host-side
+        # win of skipping normalize + batching quarter-size clips
+        run_loader("loader_thread_u8_clips_per_sec",
+                   make_transform(num_frames=num_frames, training=True,
+                                  min_short_side_scale=crop,
+                                  max_short_side_scale=crop + 64,
+                                  crop_size=crop, output_dtype="uint8"),
+                   "thread")
         log(f"[data] {out}")
         return out
     finally:
@@ -731,12 +740,18 @@ def feed_projection(dp: dict) -> dict:
     cache_cps_per_core = cache_cps / min(2, cores) if cache_cps else None
     # storage-bound companion (pread over an evicted page cache)
     cold_cps = dp.get("cache_cold_clips_per_sec")
+    # u8-through loader (host_cast=u8): no normalize + quarter-size
+    # batching (measured ratio lives in the data block / docs/PERF.md)
+    u8_cps = dp.get("loader_thread_u8_clips_per_sec")
+    u8_per_core = (u8_cps / cores_used) if u8_cps else None
     per_worker = loader_cps / dp["num_workers"]
     rows = []
     for rate in (100, 200, 400):
         row = {"device_clips_per_sec": rate,
                "decode_workers_per_chip": math.ceil(rate / per_worker),
                "decode_cores_per_chip": round(rate / loader_cps_per_core, 1)}
+        if u8_per_core:
+            row["decode_u8_cores_per_chip"] = round(rate / u8_per_core, 1)
         if cache_cps_per_core:
             row["cache_cores_per_chip"] = round(rate / cache_cps_per_core, 2)
         if cold_cps:
@@ -747,6 +762,8 @@ def feed_projection(dp: dict) -> dict:
     out = {
         "basis": {"loader_clips_per_sec_per_core":
                   round(loader_cps_per_core, 2),
+                  "loader_u8_clips_per_sec_per_core":
+                  round(u8_per_core, 2) if u8_per_core else None,
                   "measured_on_cores": cores,
                   "cache_is_page_cache_resident": True,
                   "cache_cold_clips_per_sec": cold_cps,
