@@ -71,3 +71,20 @@ def test_cli_emits_recommendation(capsys):
     by_batch = sorted((p["batch"], p["bytes"]) for p in rec["probes"])
     sizes = [s for _, s in by_batch]
     assert sizes == sorted(sizes)
+
+
+def test_u8_inputs_shrink_argument_bytes():
+    """--inputs u8 sizes the uint8 ingest layout: the compiled step's
+    argument bytes must drop vs f32 staging (clips are 1/4 the bytes;
+    params unchanged)."""
+    from pytorchvideo_accelerate_tpu.utils.memfit import step_memory_bytes
+
+    kw = dict(batch=2, frames=4, crop=32, num_classes=4)
+    f32 = step_memory_bytes("tiny3d", **kw)
+    u8 = step_memory_bytes("tiny3d", input_u8=True, **kw)
+    assert u8["argument_bytes"] < f32["argument_bytes"], (u8, f32)
+    clip_f32 = 2 * 4 * 32 * 32 * 3 * 4
+    clip_u8 = clip_f32 // 4
+    # the argument delta is ~exactly the clip shrink (params identical)
+    delta = f32["argument_bytes"] - u8["argument_bytes"]
+    assert abs(delta - (clip_f32 - clip_u8)) < 4096, delta
