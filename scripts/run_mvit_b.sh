@@ -4,6 +4,8 @@
 # ulysses) to shard the token axis over ICI, and --model.remat to trade
 # recompute for activation HBM (then re-fit the batch:
 # python -m pytorchvideo_accelerate_tpu.utils.memfit --model mvit_b ...).
+# Augmentations per the MViT K400 recipe (Fan 2021 §4.1):
+# in-graph mixup 0.8 + cutmix 1.0 + label smoothing 0.1.
 set -euo pipefail
 
 python -m pytorchvideo_accelerate_tpu.run \
@@ -15,6 +17,9 @@ python -m pytorchvideo_accelerate_tpu.run \
   --data.crop_size 224 \
   --data.min_short_side_scale 256 \
   --data.max_short_side_scale 320 \
+  --optim.mixup_alpha 0.8 \
+  --optim.cutmix_alpha 1.0 \
+  --optim.label_smoothing 0.1 \
   --batch_size 8 \
   --num_workers 8 \
   --checkpointing_steps epoch \

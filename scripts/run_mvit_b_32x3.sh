@@ -4,6 +4,8 @@
 # stride-3 sampling and the recipe's drop_path 0.3. Long-clip memory knobs:
 # --model.remat (per-block) and --model.attention ring|ulysses (context
 # parallel over the mesh).
+# Augmentations per the MViT K400 recipe (Fan 2021 §4.1):
+# in-graph mixup 0.8 + cutmix 1.0 + label smoothing 0.1.
 set -euo pipefail
 
 python -m pytorchvideo_accelerate_tpu.run \
@@ -13,6 +15,9 @@ python -m pytorchvideo_accelerate_tpu.run \
   --num_frames 32 \
   --sampling_rate 3 \
   --data.crop_size 224 \
+  --optim.mixup_alpha 0.8 \
+  --optim.cutmix_alpha 1.0 \
+  --optim.label_smoothing 0.1 \
   --batch_size 8 \
   --num_workers 8 \
   --checkpointing_steps epoch \
