@@ -146,6 +146,12 @@ class OptimConfig:
     # forward — per MICRO-batch under grad accumulation (timm's
     # switching at micro granularity). 1.0 typical; 0 = off.
     cutmix_alpha: float = 0.0
+    # exponential moving average of weights, updated in-graph each step;
+    # when on, evaluation scores the EMA weights (the MViT/VideoMAE
+    # fine-tune recipes' convention; 0.9999 typical). EMA rides the
+    # checkpoint; toggling it across a resume changes the state tree and
+    # fails loudly. 0 = off.
+    ema_decay: float = 0.0
 
 
 @dataclass

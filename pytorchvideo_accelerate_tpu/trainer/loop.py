@@ -313,7 +313,13 @@ class Trainer:
 
         params = shard_params(self.mesh, variables["params"])
         batch_stats = shard_params(self.mesh, variables.get("batch_stats", {}))
-        self.state = TrainState.create(params, batch_stats, self.tx)
+        if not 0.0 <= cfg.optim.ema_decay < 1.0:
+            raise ValueError(
+                f"optim.ema_decay must be in [0, 1), got "
+                f"{cfg.optim.ema_decay} (1.0 would freeze the EMA at the "
+                "init weights while eval keeps scoring them)")
+        self.state = TrainState.create(params, batch_stats, self.tx,
+                                       ema=cfg.optim.ema_decay > 0)
 
         if cfg.model.pretrained and not cfg.model.pretrained_path:
             # unlike the reference there is no runtime hub fetch (zero
@@ -334,7 +340,11 @@ class Trainer:
                 mesh=self.mesh, model=cfg.model.name,
             )
             self.state = self.state.replace(
-                params=merged["params"], batch_stats=merged["batch_stats"]
+                params=merged["params"], batch_stats=merged["batch_stats"],
+                # the EMA must start from the loaded weights, not the
+                # discarded random init it was copied from at create()
+                ema_params=(jax.tree.map(jnp.copy, merged["params"])
+                            if self.state.ema_params is not None else None),
             )
             main_print(
                 f"pretrained: loaded {len(report['loaded'])} tensors, "
@@ -371,6 +381,7 @@ class Trainer:
                 accum_steps=cfg.optim.gradient_accumulation_steps,
                 lr_schedule=self.lr_schedule,
                 debug_asserts=cfg.debug_asserts,
+                ema_decay=cfg.optim.ema_decay,
             )
             self.eval_step = make_pretrain_eval_step(self.model, self.mesh)
         else:
@@ -383,6 +394,7 @@ class Trainer:
                 device_normalize=self._device_normalize,
                 mixup_alpha=cfg.optim.mixup_alpha,
                 cutmix_alpha=cfg.optim.cutmix_alpha,
+                ema_decay=cfg.optim.ema_decay,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh,

@@ -136,6 +136,7 @@ def _make_update_step(
     lr_schedule: Optional[Callable],
     with_accuracy: bool,
     debug_asserts: bool = False,
+    ema_decay: float = 0.0,
 ) -> Callable:
     """Shared machinery of the supervised and self-supervised steps.
 
@@ -175,11 +176,19 @@ def _make_update_step(
 
         updates, new_opt_state = tx.update(grads, state.opt_state, state.params)
         new_params = optax.apply_updates(state.params, updates)
+        new_ema = state.ema_params
+        if ema_decay > 0 and state.ema_params is not None:
+            # in-graph EMA: pure VPU elementwise, fused with the update
+            new_ema = jax.tree.map(
+                lambda e, p: e * ema_decay + p.astype(e.dtype)
+                * (1.0 - ema_decay),
+                state.ema_params, new_params)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
             batch_stats=new_stats,
             opt_state=new_opt_state,
+            ema_params=new_ema,
         )
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
         if with_accuracy:
@@ -202,6 +211,7 @@ def make_train_step(
     device_normalize=None,
     mixup_alpha: float = 0.0,
     cutmix_alpha: float = 0.0,
+    ema_decay: float = 0.0,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
     (state, metrics)` (see `_make_update_step`). `device_normalize`:
@@ -306,7 +316,8 @@ def make_train_step(
 
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
-                             with_accuracy=True, debug_asserts=debug_asserts)
+                             with_accuracy=True, debug_asserts=debug_asserts,
+                             ema_decay=ema_decay)
 
 
 def make_pretrain_step(
@@ -316,6 +327,7 @@ def make_pretrain_step(
     accum_steps: int = 1,
     lr_schedule: Optional[Callable] = None,
     debug_asserts: bool = False,
+    ema_decay: float = 0.0,
 ) -> Callable:
     """Build the VideoMAE self-supervised step: `step(state, batch, key) ->
     (state, metrics)`. No labels; batch_stats pass through unchanged (pure-LN
@@ -333,7 +345,8 @@ def make_pretrain_step(
 
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
-                             with_accuracy=False, debug_asserts=debug_asserts)
+                             with_accuracy=False, debug_asserts=debug_asserts,
+                             ema_decay=ema_decay)
 
 
 def make_pretrain_eval_step(model, mesh) -> Callable:
@@ -342,8 +355,10 @@ def make_pretrain_eval_step(model, mesh) -> Callable:
 
     def eval_step(state: TrainState, batch: dict) -> dict:
         batch = _constrain_batch(batch, mesh, leading_micro=False)
+        eval_params = (state.ema_params if state.ema_params is not None
+                       else state.params)
         out = model.apply(
-            {"params": state.params}, batch["video"], train=False,
+            {"params": eval_params}, batch["video"], train=False,
             rngs={"mask": jax.random.key(0)},
         )
         mask = batch.get("mask")
@@ -389,8 +404,12 @@ def make_eval_step(model, mesh, label_smoothing: float = 0.0,
                 lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
                 inputs,
             )
+        # score the EMA weights when the state carries them (the recipes'
+        # eval convention); BN stats stay the live ones
+        eval_params = (state.ema_params if state.ema_params is not None
+                       else state.params)
         logits = model.apply(
-            {"params": state.params, "batch_stats": state.batch_stats},
+            {"params": eval_params, "batch_stats": state.batch_stats},
             inputs,
             train=False,
         )

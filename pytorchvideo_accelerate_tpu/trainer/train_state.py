@@ -12,6 +12,7 @@ from __future__ import annotations
 
 from typing import Any
 
+import jax
 import jax.numpy as jnp
 from flax import struct
 
@@ -21,12 +22,21 @@ class TrainState(struct.PyTreeNode):
     params: Any
     batch_stats: Any
     opt_state: Any
+    # exponential moving average of params (None = EMA off). Created as a
+    # copy of the init params when `--optim.ema_decay > 0`; updated
+    # in-graph each step; evaluation scores the EMA weights when present
+    # (the MViT/VideoMAE fine-tune recipes' convention). Rides the
+    # checkpoint pytree like every other field — same-config round trips
+    # restore it; toggling EMA across a resume changes the tree structure
+    # and fails loudly rather than silently dropping state.
+    ema_params: Any = None
 
     @classmethod
-    def create(cls, params, batch_stats, tx) -> "TrainState":
+    def create(cls, params, batch_stats, tx, ema: bool = False) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             batch_stats=batch_stats,
             opt_state=tx.init(params),
+            ema_params=jax.tree.map(jnp.copy, params) if ema else None,
         )

@@ -262,3 +262,59 @@ def test_u8_host_cast_rejected_for_pretraining(tmp_path):
                             "model.name": "videomae_b_pretrain"})
     with pytest.raises(ValueError, match="supervised-only"):
         Trainer(cfg)
+
+
+def test_fit_with_ema_and_resume(tmp_path):
+    """--optim.ema_decay: EMA rides training, eval, and the checkpoint —
+    a resumed run restores the EMA tree and keeps training."""
+    import jax
+
+    cfg = _cfg(tmp_path, **{"optim.ema_decay": 0.9,
+                            "checkpoint.checkpointing_steps": "epoch"})
+    tr = Trainer(cfg)
+    result = tr.fit()
+    assert result["steps"] == 4
+    assert tr.state.ema_params is not None
+    # EMA lags the raw params after training
+    diffs = [float(np.max(np.abs(np.asarray(a) - np.asarray(b))))
+             for a, b in zip(jax.tree.leaves(tr.state.params),
+                             jax.tree.leaves(tr.state.ema_params))]
+    assert max(diffs) > 0, "EMA never moved away from params"
+
+    cfg2 = _cfg(tmp_path, **{"optim.ema_decay": 0.9,
+                             "optim.num_epochs": 3,
+                             "checkpoint.checkpointing_steps": "epoch",
+                             "checkpoint.resume_from_checkpoint": "auto"})
+    result2 = Trainer(cfg2).fit()
+    # cumulative count: resumed at step 4, one more epoch = 6 total
+    assert result2["steps"] == 6
+
+
+def test_ema_decay_range_validated(tmp_path):
+    cfg = _cfg(tmp_path, **{"optim.ema_decay": 1.0})
+    with pytest.raises(ValueError, match="ema_decay"):
+        Trainer(cfg)
+
+
+def test_ema_starts_from_pretrained_weights(tmp_path):
+    """With --model.pretrained, the EMA must be re-seeded from the LOADED
+    weights — not the random init create() copied (which would poison
+    every eval for thousands of steps at recipe decays)."""
+    import jax
+
+    from pytorchvideo_accelerate_tpu.models.convert import save_converted
+
+    cfg0 = _cfg(tmp_path, **{"optim.ema_decay": 0.9})
+    tr0 = Trainer(cfg0)
+    npz = str(tmp_path / "w.npz")
+    save_converted({"params": jax.tree.map(np.asarray, tr0.state.params),
+                    "batch_stats": jax.tree.map(np.asarray,
+                                                tr0.state.batch_stats)}, npz)
+
+    cfg = _cfg(tmp_path, **{"optim.ema_decay": 0.9,
+                            "model.pretrained": True,
+                            "model.pretrained_path": npz})
+    tr = Trainer(cfg)
+    for p, e in zip(jax.tree.leaves(tr.state.params),
+                    jax.tree.leaves(tr.state.ema_params)):
+        np.testing.assert_array_equal(np.asarray(p), np.asarray(e))
