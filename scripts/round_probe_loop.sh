@@ -46,5 +46,5 @@ EOF
     ls -la /tmp/trace_r5 2>/dev/null | head -5
     RAN_BENCH=1
   fi
-  sleep 1200
+  sleep "${PROBE_SLEEP:-1200}"
 done
