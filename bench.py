@@ -233,6 +233,12 @@ def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
     return out
 
 
+# smoke-mode geometry for the trainer lane (frames, crop, per-chip batch);
+# module-level so the tier-1 contract test can shrink it further — it checks
+# perf-dict plumbing, not CPU conv throughput
+SMOKE_TRAINER_SHAPE = (8, 64, 2)
+
+
 def bench_trainer(args) -> dict:
     """Trainer.fit() on synthetic data — its steady-state clips/s/chip is
     compared (in the parent) against the raw-step number to prove the hot
@@ -244,7 +250,7 @@ def bench_trainer(args) -> dict:
     )
     from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
 
-    frames, crop, bsz = (8, 64, 2) if args.smoke else (32, 256, 8)
+    frames, crop, bsz = SMOKE_TRAINER_SHAPE if args.smoke else (32, 256, 8)
     n_videos = bsz * len(jax.devices()) * (4 if args.smoke else 16)
     cfg = TrainConfig(
         model=ModelConfig(name="slowfast_r50", num_classes=700),
@@ -256,6 +262,11 @@ def bench_trainer(args) -> dict:
     )
     tr = Trainer(cfg)
     res = tr.fit()
+    # perf-dict contract: the device-prefetch observability keys must be
+    # present (the smoke run doubles as the CI check that the input-wait
+    # instrumentation didn't silently fall out of fit())
+    for key in ("input_wait_frac", "steps_per_sec"):
+        assert key in res, f"fit() perf dict missing {key!r}: {sorted(res)}"
     # steady-state: train-section wall time of the post-compile epoch only
     # (excludes compile, eval, checkpointing — the quantity the raw-step
     # number measures)
@@ -264,8 +275,10 @@ def bench_trainer(args) -> dict:
     clips = steps_per_epoch * bsz * len(jax.devices())
     cps_chip = clips / dt / len(jax.devices())
     log(f"[trainer] fit() steady-state epoch: {steps_per_epoch} steps in "
-        f"{dt:.2f}s = {cps_chip:.2f} clips/s/chip (incl. data pipeline)")
+        f"{dt:.2f}s = {cps_chip:.2f} clips/s/chip (incl. data pipeline), "
+        f"input_wait_frac {res['input_wait_frac']:.3f}")
     return {"trainer_cps_chip": cps_chip,
+            "input_wait_frac": res["input_wait_frac"],
             "mfu": res.get("mfu"), "smoke": bool(args.smoke)}
 
 
@@ -654,6 +667,12 @@ def main():
                        _model_timeout(args))
         if "trainer_cps_chip" in tr:
             extras["trainer_cps_chip"] = round(tr["trainer_cps_chip"], 3)
+            if tr.get("input_wait_frac") is not None:
+                # time fit()'s step loop spent blocked on input: the proof
+                # (or refutation) that device prefetch overlaps H2D with
+                # compute — << 1 is the healthy reading
+                extras["trainer_input_wait_frac"] = round(
+                    tr["input_wait_frac"], 4)
             if tr.get("mfu") is not None:
                 extras["trainer_mfu"] = round(tr["mfu"], 4)
             raw = (results.get("slowfast_r50") or {}).get(
@@ -830,7 +849,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         },
         "detail": "bench_partial.json",
     }
-    for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu"):
+    for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
+                "trainer_input_wait_frac"):
         if key in extras:
             out[key] = extras[key]
     # error strings can be whole tracebacks: truncate on entry, every one
@@ -870,7 +890,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         for k in ("error", "trainer_error"):
             if k in out:
                 out[k] = out[k][:120]
-    for k in ("probes", "trainer_error", "trainer_mfu", "trainer_cps_chip",
+    for k in ("probes", "trainer_error", "trainer_input_wait_frac",
+              "trainer_mfu", "trainer_cps_chip",
               "trainer_vs_rawstep", "detail", "step_ms_blocked",
               "tflops_per_sec", "models"):  # drop one by one until it fits
         if len(json.dumps(out)) <= MAX_LINE_BYTES:

@@ -63,6 +63,17 @@ class DataConfig:
     # GIL-holding pure-Python transform stacks.
     transport: str = "auto"
     num_workers: int = 8
+    # HOST-side prefetch: decoded numpy batches assembled ahead of
+    # consumption inside ClipLoader (bounds decode-thread run-ahead). Raise
+    # when decode latency is spiky (cold storage, long-GOP videos).
+    prefetch_batches: int = 2
+    # DEVICE-side prefetch: on-device batches held ahead of the step loop by
+    # data/device_prefetch.DevicePrefetcher, overlapping the host->HBM copy
+    # of batch N+1 with compute of batch N. Each unit costs one batch of
+    # HBM; 0 = synchronous inline placement (the A/B baseline). Distinct
+    # from prefetch_batches: that hides DECODE latency on the host, this
+    # hides TRANSFER latency onto the chip.
+    device_prefetch_depth: int = 2
     crop_size: int = 256
     min_short_side_scale: int = 256
     max_short_side_scale: int = 320

@@ -8,6 +8,10 @@ grain/threaded prefetch pipeline with per-host sharding and checkpointable
 iterator state.
 """
 
+# NOTE: data.device_prefetch is intentionally NOT re-exported here — it
+# imports jax (via parallel.sharding), and this package init must stay
+# importable by host-only code paths (forked decode workers, offline cache
+# builds). Import it as `from ...data.device_prefetch import DevicePrefetcher`.
 from pytorchvideo_accelerate_tpu.data.transforms import (  # noqa: F401
     make_transform,
     pack_pathway,

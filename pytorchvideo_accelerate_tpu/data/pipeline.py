@@ -30,7 +30,7 @@ import logging
 import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
-from queue import Queue
+from queue import Empty, Queue
 from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
@@ -397,17 +397,31 @@ class ClipLoader:
         `from_start=True` ignores any stored mid-epoch position — the eval
         contract: a previous early-broken pass (limit_val_batches) must not
         make the next pass silently skip its head batches."""
-        if from_start:
-            self.state = LoaderState(
-                epoch=self.state.epoch if epoch is None else epoch, position=0)
-        elif epoch is not None and epoch != self.state.epoch:
-            self.state = LoaderState(epoch=epoch, position=0)
-        epoch = self.state.epoch
+        for batch, state in self.epoch_items(epoch, from_start):
+            self.state = state
+            if batch is not None:
+                yield batch
+
+    def epoch_items(self, epoch: Optional[int] = None,
+                    from_start: bool = False) -> Iterator[tuple]:
+        """Like `epoch()`, but yields `(batch, LoaderState)` pairs and never
+        mutates `self.state` — the post-consumption state rides alongside each
+        batch, and a final `(None, rollover_state)` pair marks exhaustion.
+
+        This is the contract the device prefetcher needs: it advances this
+        generator from a background thread, so state assignment must happen
+        on the CONSUMER side, when the trainer actually takes a batch —
+        otherwise a mid-epoch checkpoint would record a position several
+        prefetched batches ahead of what training consumed, and resume would
+        silently skip them."""
+        start_state = self._start_state(epoch, from_start)
+        epoch = start_state.epoch
         indices = self._epoch_indices(epoch)
         spy = self.samples_per_yield
         n_batches = self.batches_per_epoch()
         if self.transport == "process":
-            yield from self._epoch_process(epoch, indices, n_batches)
+            yield from self._epoch_process_items(
+                epoch, start_state.position, indices, n_batches)
             return
 
         def fetch_batch(b: int) -> dict:
@@ -417,7 +431,7 @@ class ClipLoader:
             )
             return self._assemble(samples, spy)
 
-        start = self.state.position
+        start = start_state.position
         pending: "Queue[tuple]" = Queue()
         depth = max(self.prefetch_batches, 1)
         next_submit = start
@@ -436,14 +450,38 @@ class ClipLoader:
                         (next_submit, executor.submit(fetch_batch, next_submit))
                     )
                     next_submit += 1
-                self.state = LoaderState(epoch=epoch, position=b + 1)
-                yield batch
-            self.state = LoaderState(epoch=epoch + 1, position=0)
+                yield batch, LoaderState(epoch=epoch, position=b + 1)
+            yield None, LoaderState(epoch=epoch + 1, position=0)
         finally:
-            executor.shutdown(wait=False)
+            # early exit (limit_train_batches break -> GeneratorExit, or an
+            # exception upstream): in-flight fetch_batch futures would keep
+            # decoding whole batches after the consumer is gone. Cancel
+            # everything still queued; shutdown(cancel_futures) catches any
+            # race between the drain and a worker picking one up.
+            while not pending.empty():
+                try:
+                    pending.get_nowait()[1].cancel()
+                except Empty:  # pragma: no cover - single-consumer queue
+                    break
+            try:
+                executor.shutdown(wait=False, cancel_futures=True)
+            except TypeError:  # pragma: no cover - py<3.9 fallback
+                executor.shutdown(wait=False)
 
-    def _epoch_process(self, epoch: int, indices: np.ndarray,
-                       n_batches: int) -> Iterator[dict]:
+    def _start_state(self, epoch: Optional[int],
+                     from_start: bool) -> LoaderState:
+        """Effective starting position for an epoch pass (pure; `epoch()` /
+        the prefetcher assign it back to `self.state` batch by batch)."""
+        if from_start:
+            return LoaderState(
+                epoch=self.state.epoch if epoch is None else epoch, position=0)
+        if epoch is not None and epoch != self.state.epoch:
+            return LoaderState(epoch=epoch, position=0)
+        return self.state
+
+    def _epoch_process_items(self, epoch: int, start: int,
+                             indices: np.ndarray,
+                             n_batches: int) -> Iterator[tuple]:
         """Forked shm workers; batches byte-identical to the thread path.
         Prefetch comes from ring capacity (workers run ahead of assembly)."""
         from pytorchvideo_accelerate_tpu.native.shm_loader import ShmWorkerPool
@@ -460,7 +498,6 @@ class ClipLoader:
                 slots_per_worker=per_worker,
             )
         usable = indices[: n_batches * spy] if self.drop_last else indices
-        start = self.state.position
         samples, dones = [], []
         b = start
 
@@ -478,13 +515,11 @@ class ClipLoader:
             samples.append(sample)
             dones.append(done)
             if len(samples) == spy:
-                self.state = LoaderState(epoch=epoch, position=b + 1)
-                yield flush()
+                yield flush(), LoaderState(epoch=epoch, position=b + 1)
                 b += 1
         if samples:  # non-drop_last tail, padded + masked
-            self.state = LoaderState(epoch=epoch, position=b + 1)
-            yield flush()
-        self.state = LoaderState(epoch=epoch + 1, position=0)
+            yield flush(), LoaderState(epoch=epoch, position=b + 1)
+        yield None, LoaderState(epoch=epoch + 1, position=0)
 
     def close(self) -> None:
         self._pool.shutdown(wait=False)

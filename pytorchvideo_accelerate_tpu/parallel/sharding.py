@@ -9,6 +9,7 @@ sharded over `fsdp`), and everything else follows from XLA's propagation.
 
 from __future__ import annotations
 
+import functools
 import re
 from typing import Any, Optional
 
@@ -23,14 +24,25 @@ from pytorchvideo_accelerate_tpu.parallel.mesh import (
 )
 
 
+@functools.lru_cache(maxsize=64)
+def _cached_sharding(mesh: Mesh, spec: P) -> NamedSharding:
+    """Memoized NamedSharding construction. `shard_batch` runs once per
+    train/eval step (and, with the device prefetcher, on a background
+    thread's critical path), so the {mesh, spec} -> NamedSharding pair is
+    built once per mesh instead of per call. Mesh and PartitionSpec are both
+    hashable; the handful of (mesh, spec) pairs a process ever sees fits
+    comfortably in a small LRU."""
+    return NamedSharding(mesh, spec)
+
+
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading (batch) dim split over the DP axes — the `BatchSamplerShard`
     equivalent, but as a layout annotation instead of an index-stream slicer."""
-    return NamedSharding(mesh, P(BATCH_AXES))
+    return _cached_sharding(mesh, P(BATCH_AXES))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
-    return NamedSharding(mesh, P())
+    return _cached_sharding(mesh, P())
 
 
 def shard_batch(mesh: Mesh, batch: Any, micro_dim: bool = False) -> Any:
@@ -46,7 +58,8 @@ def shard_batch(mesh: Mesh, batch: Any, micro_dim: bool = False) -> Any:
     equivalent of per-rank DataLoader shards feeding DDP.
     """
     sharding = (
-        NamedSharding(mesh, P(None, BATCH_AXES)) if micro_dim else batch_sharding(mesh)
+        _cached_sharding(mesh, P(None, BATCH_AXES)) if micro_dim
+        else batch_sharding(mesh)
     )
 
     def place(x):

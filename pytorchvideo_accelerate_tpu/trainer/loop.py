@@ -24,6 +24,7 @@ from pytorchvideo_accelerate_tpu.data.pipeline import (
     SyntheticClipSource,
     VideoClipSource,
 )
+from pytorchvideo_accelerate_tpu.data.device_prefetch import DevicePrefetcher
 from pytorchvideo_accelerate_tpu.data.transforms import make_transform
 from pytorchvideo_accelerate_tpu.models import create_model, model_input_spec
 from pytorchvideo_accelerate_tpu.parallel.distributed import (
@@ -32,7 +33,7 @@ from pytorchvideo_accelerate_tpu.parallel.distributed import (
     main_print,
 )
 from pytorchvideo_accelerate_tpu.parallel.mesh import data_shard_count, make_mesh
-from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch, shard_params
+from pytorchvideo_accelerate_tpu.parallel.sharding import shard_params
 from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
     Checkpointer,
     resolve_resume_path,
@@ -45,7 +46,10 @@ from pytorchvideo_accelerate_tpu.trainer.steps import (
     make_pretrain_step,
     make_train_step,
 )
-from pytorchvideo_accelerate_tpu.trainer.tracking import TrackerHub
+from pytorchvideo_accelerate_tpu.trainer.tracking import (
+    DeferredStepLogger,
+    TrackerHub,
+)
 from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
 from pytorchvideo_accelerate_tpu.utils.bench_setup import fetch_loss
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
@@ -277,11 +281,23 @@ class Trainer:
         self.train_loader = ClipLoader(
             self.train_source, global_batch,
             accum_steps=cfg.optim.gradient_accumulation_steps,
-            shuffle=True, drop_last=True, **loader_kw,
+            shuffle=True, drop_last=True,
+            prefetch_batches=d.prefetch_batches, **loader_kw,
         )
         self.val_loader = ClipLoader(
             self.val_source, global_batch, accum_steps=1,
-            shuffle=False, drop_last=False, **loader_kw,
+            shuffle=False, drop_last=False,
+            prefetch_batches=d.prefetch_batches, **loader_kw,
+        )
+        # device-side prefetch: the step loops consume pre-placed mesh
+        # batches; the H2D copy of batch N+1 overlaps compute of batch N
+        # (depth 0 = synchronous placement, the A/B baseline)
+        self.train_prefetch = DevicePrefetcher(
+            self.train_loader, self.mesh, depth=d.device_prefetch_depth,
+            micro_dim=cfg.optim.gradient_accumulation_steps > 1,
+        )
+        self.val_prefetch = DevicePrefetcher(
+            self.val_loader, self.mesh, depth=d.device_prefetch_depth,
         )
 
     def _build_model_and_steps(self) -> None:
@@ -497,11 +513,12 @@ class Trainer:
         returns (top1, top5, mean_loss)."""
         val = SumMetrics()
         # from_start: eval is stateless — a prior early-broken pass (e.g.
-        # limit_val_batches) must not make this one resume mid-epoch
+        # limit_val_batches) must not make this one resume mid-epoch.
+        # Batches arrive pre-placed on the mesh (device prefetch), so the
+        # eval H2D transfers overlap eval compute the same way training's do.
         for step_in_epoch, batch in enumerate(
-                self.val_loader.epoch(epoch, from_start=True)):
-            val.update(self.eval_step(self.state,
-                                      shard_batch(self.mesh, batch)))
+                self.val_prefetch.epoch(epoch, from_start=True)):
+            val.update(self.eval_step(self.state, batch))
             if 0 <= self.cfg.data.limit_val_batches <= step_in_epoch + 1:
                 break
         return val.accuracy(), val.accuracy_top5(), val.mean_loss()
@@ -567,6 +584,11 @@ class Trainer:
         # runs (gstep >> 0) still capture a trace
         run_start_step = gstep
         metrics = None
+        # metric logging is one step delayed: the fetch happens after the
+        # NEXT step has been dispatched, so logging never syncs the step
+        # just dispatched (the old float(metrics["loss"]) blocked dispatch
+        # at every log_every boundary)
+        deferred = DeferredStepLogger(self.trackers) if self.trackers else None
         try:
             for epoch in range(starting_epoch, cfg.optim.num_epochs):
                 if use_tqdm:
@@ -574,22 +596,28 @@ class Trainer:
                 epoch_loss = MeanLoss()
                 t_epoch = time.time()
                 train_steps_this_epoch = 0
+                self.train_prefetch.pop_wait()  # epoch-scoped accounting
 
-                for step_in_epoch, batch in enumerate(self.train_loader.epoch(epoch)):
+                # batches arrive pre-placed on the mesh: the device prefetch
+                # thread overlaps the H2D copy of batch N+1 with compute of
+                # batch N, so steady-state steps never block on the host link
+                for step_in_epoch, global_batch in enumerate(
+                        self.train_prefetch.epoch(epoch)):
                     if (cfg.profile and not profiling
                             and gstep - run_start_step == 2):
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling = True
-                    global_batch = shard_batch(
-                        self.mesh, batch,
-                        micro_dim=cfg.optim.gradient_accumulation_steps > 1,
-                    )
                     with jax.profiler.StepTraceAnnotation("train", step_num=gstep):
                         self.state, metrics = self.train_step(
                             self.state, global_batch, self.rng.step_key(gstep)
                         )
                     gstep += 1
                     train_steps_this_epoch += 1
+                    if deferred is not None:
+                        # previous boundary's metrics: their step has retired
+                        # behind the one just dispatched, so this fetch
+                        # doesn't stall the pipeline
+                        deferred.flush()
                     if self._flops_per_step is None:
                         # unconditional (not tracking-gated): fit()'s return
                         # dict and the bench harness both need FLOPs/step
@@ -602,13 +630,13 @@ class Trainer:
                     if use_tqdm:
                         progress.update(1)
                     # device scalar; the host->device sync happens at epoch end
-                    # (MeanLoss.mean) or at the log_every fetch below
+                    # (MeanLoss.mean) or at the deferred log_every fetch
                     epoch_loss.update_async(metrics["loss"])
-                    if self.trackers and gstep % cfg.tracking.log_every == 0:
-                        self.trackers.log(
-                            {"train_loss_step": float(metrics["loss"]),
-                             "lr": float(metrics["lr"]),
-                             "grad_norm": float(metrics["grad_norm"])},
+                    if deferred is not None and gstep % cfg.tracking.log_every == 0:
+                        deferred.defer(
+                            {"train_loss_step": metrics["loss"],
+                             "lr": metrics["lr"],
+                             "grad_norm": metrics["grad_norm"]},
                             step=gstep,
                         )
                     if (isinstance(self.checkpointing_steps, int)
@@ -622,7 +650,13 @@ class Trainer:
                     # early by forwarding backends — would end the epoch
                     # timer with work still queued; bench_setup.fetch_loss)
                     fetch_loss(metrics)
+                if deferred is not None:
+                    deferred.flush()
                 epoch_train_times.append(time.time() - t_epoch)
+                # time the step loop spent blocked waiting for the next
+                # device batch — the number that proves (or disproves) the
+                # transfer/compute overlap (input_wait_frac << 1)
+                train_wait_s = self.train_prefetch.pop_wait()
 
                 # Evaluation (reference run.py:287-304, in-graph metric sums)
                 last_val_acc, last_val_acc5, last_val_loss = \
@@ -652,6 +686,12 @@ class Trainer:
                             sps * self.train_loader.global_batch_size
                             * self.train_loader.accum_steps
                         ),
+                        # fraction of the train section blocked on input:
+                        # << 1 = the H2D overlap is real; -> 1 = the input
+                        # pipeline (host decode or transfer), not the model,
+                        # bounds throughput
+                        "input_wait_s": train_wait_s,
+                        "input_wait_frac": min(train_wait_s / t_train, 1.0),
                     }
                     if self._flops_per_step:
                         from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops

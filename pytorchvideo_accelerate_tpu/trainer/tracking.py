@@ -162,3 +162,47 @@ class TrackerHub:
     def finish(self) -> None:
         for t in self.trackers:
             t.finish()
+
+
+class DeferredStepLogger:
+    """One-step-delayed metric logging off the dispatch critical path.
+
+    `float(metrics["loss"])` at `log_every` inside the step loop blocks the
+    host on the CURRENT step's result before the next one can dispatch —
+    exactly the sync the async-dispatch design works to avoid. Instead,
+    `defer()` stashes the device scalars (kicking off their D2H copies
+    asynchronously where the backend supports it) and `flush()` — called on
+    the NEXT loop iteration, after another step has been dispatched — turns
+    them into floats. By then the deferred step has all but certainly
+    retired, so the fetch is a cache read, not a pipeline stall; at worst it
+    blocks one step later than the old code did, never on the step just
+    dispatched.
+
+    Stash-then-flush also means at most one pending log at a time: a second
+    `defer()` before `flush()` flushes the first (never silently drops it).
+    """
+
+    def __init__(self, hub: TrackerHub):
+        self.hub = hub
+        self._pending: Optional[tuple] = None
+
+    def defer(self, values: Dict[str, object], step: int) -> None:
+        if self._pending is not None:
+            self.flush()
+        for v in values.values():
+            start = getattr(v, "copy_to_host_async", None)
+            if start is not None:
+                try:  # best-effort: a plain float has nothing to start
+                    start()
+                except Exception:  # pragma: no cover - backend-dependent
+                    pass
+        self._pending = (values, step)
+
+    def flush(self) -> None:
+        """Fetch + log the stashed metrics, if any (loop iteration top and
+        epoch end both call this; safe to call with nothing pending)."""
+        if self._pending is None:
+            return
+        values, step = self._pending
+        self._pending = None
+        self.hub.log({k: float(v) for k, v in values.items()}, step=step)

@@ -84,9 +84,12 @@ def test_finalize_extras_passthrough():
     out = bench.finalize(
         _model(),
         {"trainer_vs_rawstep": 0.934, "error": "watchdog: 10s",
+         "trainer_input_wait_frac": 0.012,
          "probe_attempts": [{"ts": "t", "ok": True}]},
         user_smoke=False)
     assert out["trainer_vs_rawstep"] == 0.934
+    # the overlap-proof metric rides the headline line when present
+    assert out["trainer_input_wait_frac"] == 0.012
     assert out["error"].startswith("watchdog")
     # probes are summarized as counts; timestamps live off-line
     assert out["probes"]["run"] == 1

@@ -1,0 +1,91 @@
+"""bench.py --smoke trainer-lane contract: the perf dict reaching bench must
+carry the device-prefetch observability keys (input_wait_frac,
+steps_per_sec), and bench must refuse to report without them. The tier-1
+test locks the contract with a stubbed Trainer (cheap); the slow-marked test
+runs the real fit end to end on the CPU mesh."""
+
+import argparse
+import importlib.util
+import os
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load_bench(name):
+    spec = importlib.util.spec_from_file_location(
+        name, os.path.join(ROOT, "bench.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[name] = mod
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class _StubTrainer:
+    """Captures the cfg bench builds and returns a canned perf dict."""
+
+    result = {}
+
+    def __init__(self, cfg):
+        type(self).last_cfg = cfg
+
+    def fit(self):
+        return dict(type(self).result)
+
+
+@pytest.fixture()
+def stubbed(monkeypatch):
+    import pytorchvideo_accelerate_tpu.trainer.loop as loop_mod
+
+    monkeypatch.setattr(loop_mod, "Trainer", _StubTrainer)
+    return _load_bench("bench_smoke_stub")
+
+
+def test_bench_trainer_smoke_propagates_input_wait(stubbed):
+    _StubTrainer.result = {
+        "steps": 8, "epoch_train_times": [2.0, 1.0], "train_loss": 0.5,
+        "steps_per_sec": 4.0, "clips_per_sec": 64.0,
+        "input_wait_s": 0.02, "input_wait_frac": 0.02, "mfu": 0.1,
+    }
+    res = stubbed.bench_trainer(argparse.Namespace(smoke=True))
+    assert res["smoke"] is True
+    assert res["input_wait_frac"] == 0.02
+    assert res["trainer_cps_chip"] > 0.0
+    # and the smoke geometry really was requested (CPU-sized shapes)
+    assert _StubTrainer.last_cfg.data.crop_size == stubbed.SMOKE_TRAINER_SHAPE[1]
+
+
+def test_bench_trainer_smoke_asserts_perf_keys(stubbed):
+    """A fit() that silently loses the observability keys must FAIL the
+    bench, not produce a line without the metric."""
+    _StubTrainer.result = {
+        "steps": 8, "epoch_train_times": [2.0, 1.0], "train_loss": 0.5,
+        "steps_per_sec": 4.0,  # input_wait_frac missing
+    }
+    with pytest.raises(AssertionError, match="input_wait_frac"):
+        stubbed.bench_trainer(argparse.Namespace(smoke=True))
+
+
+@pytest.mark.slow
+def test_bench_trainer_smoke_real_fit(monkeypatch, tmp_path):
+    """The real thing, tiny: bench's own --smoke trainer lane end to end
+    under JAX_PLATFORMS=cpu (full-size SlowFast swapped for a tiny-depth
+    variant — the contract under test is plumbing, not conv throughput)."""
+    from pytorchvideo_accelerate_tpu import models
+    from pytorchvideo_accelerate_tpu.models.slowfast import SlowFast
+
+    def tiny_slowfast(cfg, dtype, mesh=None):
+        return SlowFast(num_classes=cfg.num_classes, depths=(1, 1, 1, 1),
+                        alpha=cfg.slowfast_alpha, stem_features=8,
+                        dropout_rate=0.0, dtype=dtype)
+
+    monkeypatch.setitem(models._REGISTRY, "slowfast_r50", tiny_slowfast)
+    monkeypatch.chdir(tmp_path)  # checkpoints/logs land in the tmp dir
+    bench = _load_bench("bench_smoke_real")
+    monkeypatch.setattr(bench, "SMOKE_TRAINER_SHAPE", (4, 32, 1))
+    res = bench.bench_trainer(argparse.Namespace(smoke=True))
+    assert res["smoke"] is True
+    assert res["trainer_cps_chip"] > 0.0
+    assert 0.0 <= res["input_wait_frac"] <= 1.0

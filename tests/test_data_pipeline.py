@@ -194,6 +194,62 @@ def test_loader_mid_epoch_resume():
     assert loader2.state.epoch == 1 and loader2.state.position == 0
     loader.close(); loader2.close()
 
+def test_epoch_items_yields_state_without_mutating():
+    """The device-prefetch contract: epoch_items never touches self.state,
+    pairs every batch with its post-consumption position, and ends with a
+    (None, rollover) marker."""
+    loader = _loader(n_videos=16, bs=8)
+    items = list(loader.epoch_items(0))
+    assert loader.state == LoaderState(epoch=0, position=0)  # untouched
+    assert [s.to_dict() for _, s in items] == [
+        {"epoch": 0, "position": 1}, {"epoch": 0, "position": 2},
+        {"epoch": 1, "position": 0}]
+    assert items[-1][0] is None  # rollover marker carries no batch
+    # and epoch() (the state-assigning wrapper) yields the same batches
+    loader2 = _loader(n_videos=16, bs=8)
+    batches = list(loader2.epoch(0))
+    assert len(batches) == len(items) - 1
+    for (a, _), b in zip(items[:-1], batches):
+        np.testing.assert_array_equal(a["label"], b["label"])
+    assert loader2.state == LoaderState(epoch=1, position=0)
+    loader.close(); loader2.close()
+
+
+def test_early_break_cancels_pending_decode_work():
+    """Closing an epoch generator early (limit_train_batches) must cancel
+    queued fetch_batch futures — not leave them decoding whole batches into
+    a dead queue."""
+    import threading
+
+    calls = []
+    gate = threading.Event()
+
+    class SlowSource(SyntheticClipSource):
+        def get(self, index, epoch):
+            calls.append(index)
+            gate.wait(0.05)  # slow enough that prefetch stays queued
+            return super().get(index, epoch)
+
+    tf = make_transform(num_frames=4, training=False, crop_size=32,
+                        min_short_side_scale=32)
+    src = SlowSource(tf, num_videos=64, num_classes=4)
+    loader = ClipLoader(src, global_batch_size=8, num_workers=1,
+                        prefetch_batches=4)
+    it = loader.epoch(0)
+    next(it)
+    it.close()  # GeneratorExit -> cancel queued futures
+    gate.set()  # release any in-flight get() immediately
+    import time as _t
+    _t.sleep(0.2)  # let the (at most one) in-flight fetch_batch drain
+    # running fetch_batches may finish their batch; the queued ones must
+    # never start: well under the 64 gets a full epoch would issue
+    seen = len(calls)
+    _t.sleep(0.3)
+    assert len(calls) == seen, "decode work kept flowing after close"
+    assert len(calls) <= 24  # 1 consumed + <=2 in-flight batches of 8
+    loader.close()
+
+
 def test_loader_eval_from_start_after_early_break():
     """Eval contract (VERDICT r3 weak #6): an early-broken pass (e.g.
     limit_val_batches) leaves a mid-epoch position; the next eval pass over

@@ -66,6 +66,21 @@ def test_fit_trains_and_reports(tmp_path):
     assert result["steps"] == 4
     assert 0.0 <= result["val_accuracy"] <= 1.0
     assert np.isfinite(result["train_loss"])
+    # device-prefetch observability: the perf dict must report how long the
+    # step loop sat blocked on input (the overlap's proof metric)
+    assert 0.0 <= result["input_wait_frac"] <= 1.0
+    assert result["input_wait_s"] >= 0.0
+    assert result["steps_per_sec"] > 0.0
+
+
+def test_fit_with_device_prefetch_disabled_matches_contract(tmp_path):
+    """depth=0 (synchronous placement, the A/B baseline) trains identically
+    through the same interface and still reports input_wait_frac."""
+    cfg = _cfg(tmp_path, **{"data.device_prefetch_depth": 0})
+    result = Trainer(cfg).fit()
+    assert result["steps"] == 4
+    assert np.isfinite(result["train_loss"])
+    assert 0.0 <= result["input_wait_frac"] <= 1.0
 
 
 def test_eval_only_scores_a_checkpoint(tmp_path):

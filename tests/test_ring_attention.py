@@ -58,12 +58,13 @@ def test_inside_shard_map_directly(cp_mesh):
     """The in-shard_map entry point used by shard_map-authored models."""
     from jax.sharding import PartitionSpec as P
 
+    from pytorchvideo_accelerate_tpu.parallel.collectives import shard_map
+
     q, k, v = _qkv(N=64)
     spec = P(None, "context", None, None)
-    f = jax.shard_map(
+    f = shard_map(
         lambda q, k, v: ring_attention(q, k, v),
         mesh=cp_mesh, in_specs=(spec, spec, spec), out_specs=spec,
-        check_vma=False,
     )
     with cp_mesh:
         got = jax.jit(f)(q, k, v)
