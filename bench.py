@@ -388,6 +388,70 @@ def bench_data(args) -> dict:
         shutil.rmtree(tmp, ignore_errors=True)
 
 
+def bench_serving(args) -> dict:
+    """Serving-lane smoke (--serve-smoke): a tiny engine + micro-batcher
+    under a threaded synthetic client, measuring what the serving docs tell
+    operators to watch — p50/p99 request latency and the batcher fill
+    ratio. CPU-real numbers (tiny3d model, parent process is CPU-pinned):
+    they prove the queue->bucket->mask->futures machinery and its stats
+    plumbing, not chip throughput."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.serving import (
+        InferenceEngine, MicroBatcher, ServingStats,
+    )
+
+    frames, crop, n_requests = (4, 32, 32) if args.smoke else (8, 64, 96)
+    num_classes = 16
+    mcfg = ModelConfig(name="tiny3d", num_classes=num_classes,
+                       dropout_rate=0.0)
+    model = create_model(mcfg, "bf16")
+    variables = model.init(jax.random.key(0),
+                           np.zeros((1, frames, crop, crop, 3), np.float32))
+    mesh = make_mesh()
+    stats = ServingStats(window=256)
+    engine = InferenceEngine(
+        model, variables["params"], variables.get("batch_stats", {}), mesh,
+        num_classes=num_classes, max_batch_size=8, stats=stats)
+    batcher = MicroBatcher(engine, max_wait_ms=2.0, max_queue=512,
+                           stats=stats)
+    stats.queue_depth_fn = batcher.queue_depth
+    rng = np.random.default_rng(0)
+    clip = rng.standard_normal((frames, crop, crop, 3)).astype(np.float32)
+    try:
+        engine.warmup({"video": clip})  # compiles every bucket up front
+        t0 = time.perf_counter()
+        with ThreadPoolExecutor(max_workers=8) as pool:
+            futs = [pool.submit(lambda: batcher.submit({"video": clip})
+                                .result(timeout=120))
+                    for _ in range(n_requests)]
+            logits = [f.result(timeout=180) for f in futs]
+        dt = time.perf_counter() - t0
+        assert all(np.asarray(l).shape == (num_classes,) for l in logits)
+    finally:
+        batcher.close()
+    snap = stats.snapshot()
+    out = {
+        "serve_p50_ms": snap["p50_ms"],
+        "serve_p99_ms": snap["p99_ms"],
+        "serve_fill_ratio": snap["batch_fill_ratio"],
+        "serve_rps": round(n_requests / dt, 2),
+        "serve_batches": snap["batches"],
+        "serve_compiled_buckets": snap["compiled_buckets"],
+        "n_requests": n_requests,
+        "buckets": list(engine.buckets),
+        "smoke": bool(args.smoke),
+    }
+    log(f"[serving] {out}")
+    return out
+
+
 def bench_transport_crossover(args) -> dict:
     """Thread vs process worker pools on a transform-heavy (GIL-bound)
     workload — no video decode, pure numpy per-item work — at >=4 workers
@@ -560,6 +624,12 @@ def main():
                     help="host input-pipeline microbench (decode vs cache vs "
                          "loader clips/sec; CPU-real numbers regardless of "
                          "device-timing trustworthiness); --no-data skips")
+    ap.add_argument("--serve-smoke", dest="serve_smoke",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="serving-lane smoke: engine + micro-batcher under "
+                         "a synthetic client; p50/p99 request latency and "
+                         "batch-fill ratio on the headline line "
+                         "(--no-serve-smoke skips)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe shapes for harness verification")
     ap.add_argument("--per_model_timeout", type=int, default=900,
@@ -722,7 +792,35 @@ def main():
             dp["feed_projection"] = feed_projection(dp)
         flush_partial()
 
+    if args.serve_smoke:
+        # serving lane runs in the parent (CPU-pinned, tiny model) but
+        # bounded like the host benches: a wedged compile or stuck batcher
+        # thread must not break the one-JSON-line contract
+        from concurrent.futures import ThreadPoolExecutor as _TPE
+        from concurrent.futures import TimeoutError as _FutTimeout
+
+        _pool = _TPE(max_workers=1)
+        try:
+            extras["serving"] = _pool.submit(
+                bench_serving, args).result(timeout=600)
+        except _FutTimeout:
+            log("[serving] timed out after 600s")
+            extras["serving"] = {"error": "timeout after 600s"}
+        except Exception as e:
+            log(f"[serving] FAILED: {type(e).__name__}: {e}")
+            extras["serving"] = {"error": f"{type(e).__name__}: {e}"}
+        _pool.shutdown(wait=False)
+        flush_partial()
+
     headline = finalize(results, extras, user_smoke)
+    if user_smoke and args.serve_smoke:
+        # smoke mode doubles as the CI check that the serving lane's
+        # headline keys didn't silently fall out (same contract as the
+        # trainer lane's input_wait_frac assert)
+        for key in ("serve_p50_ms", "serve_p99_ms", "serve_fill_ratio"):
+            assert key in headline, (
+                f"serving smoke ran but headline misses {key!r}: "
+                f"{extras.get('serving')}")
     extras["headline"] = headline  # full record keeps the compact line too
     flush_partial()
     print(json.dumps(headline))
@@ -853,6 +951,14 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                 "trainer_input_wait_frac"):
         if key in extras:
             out[key] = extras[key]
+    # serving lane: request-latency percentiles + batcher fill ratio
+    serving = extras.get("serving", {})
+    if "error" in serving:
+        out["serve_error"] = str(serving["error"])[:120]
+    else:
+        for key in ("serve_p50_ms", "serve_p99_ms", "serve_fill_ratio"):
+            if key in serving:
+                out[key] = serving[key]
     # error strings can be whole tracebacks: truncate on entry, every one
     if "trainer_error" in extras:
         out["trainer_error"] = str(extras["trainer_error"])[:200]
@@ -890,7 +996,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         for k in ("error", "trainer_error"):
             if k in out:
                 out[k] = out[k][:120]
-    for k in ("probes", "trainer_error", "trainer_input_wait_frac",
+    for k in ("probes", "serve_error", "serve_fill_ratio", "serve_p99_ms",
+              "serve_p50_ms", "trainer_error", "trainer_input_wait_frac",
               "trainer_mfu", "trainer_cps_chip",
               "trainer_vs_rawstep", "detail", "step_ms_blocked",
               "tflops_per_sec", "models"):  # drop one by one until it fits

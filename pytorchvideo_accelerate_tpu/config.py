@@ -180,6 +180,38 @@ class CheckpointConfig:
 
 
 @dataclass
+class ServeConfig:
+    """Inference serving (serving/: engine + micro-batcher + HTTP endpoint).
+
+    No reference equivalent — the reference stack is training-only. The
+    engine restores a params-only artifact written by `export_inference`
+    (EMA-resolved), pins the weights to the mesh, and serves `/predict`
+    behind an adaptive micro-batcher; see docs/SERVING.md."""
+
+    # export_inference artifact directory (weights.npz + meta.json) —
+    # produce one with `--export_inference PATH` after/with a resume
+    checkpoint: str = ""
+    host: str = "127.0.0.1"
+    port: int = 8100
+    # batcher flush policy: a batch launches when `max_batch_size` requests
+    # are queued OR the oldest has waited `max_wait_ms` — the classic
+    # latency/throughput knob pair. The batch is then padded UP to the
+    # nearest compiled bucket (multiples of the mesh's data-shard count,
+    # doubling up to max_batch_size) with masked rows, so every batch shape
+    # hits a cached executable instead of recompiling.
+    max_batch_size: int = 8
+    max_wait_ms: float = 5.0
+    # bound on queued-but-unbatched requests; submissions beyond it are
+    # rejected (HTTP 503) instead of growing latency without limit
+    max_queue: int = 256
+    # rolling window (completed requests) for the latency percentiles and
+    # throughput reported by /stats
+    stats_window: int = 1024
+    # per-request wall-clock budget inside the server before a 504
+    request_timeout_s: float = 30.0
+
+
+@dataclass
 class TrackingConfig:
     """Metric logging (reference `run.py:227-231, 267-274, 306-315`)."""
 
@@ -199,8 +231,15 @@ class TrainConfig:
     optim: OptimConfig = field(default_factory=OptimConfig)
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
+    serve: ServeConfig = field(default_factory=ServeConfig)
 
     seed: int = 42  # run.py:138 set_seed(42); run.py:355 exposes --seed
+    # write a params-only (EMA-resolved) serving artifact to this path and
+    # exit without training — combine with --resume_from_checkpoint to
+    # export a finished run; serve it with
+    # `pva-tpu-serve --serve.checkpoint PATH` (trainer/checkpoint.py
+    # export_inference; docs/SERVING.md)
+    export_inference: str = ""
     # run the validation loop once and exit (score a resumed/converted
     # checkpoint); no reference equivalent — run.py always trains
     eval_only: bool = False
@@ -292,6 +331,19 @@ def _leaf_fields(cfg=None, prefix=""):
             yield prefix + f.name, v
 
 
+def _unknown_key_message(dotted: str, valid: set) -> str:
+    """Diagnosis for an unknown dotted key. When the key's block prefix IS a
+    known config block (`--serve.typo_key`), list that block's valid keys —
+    a typo under a real block must fail loudly and helpfully, never be
+    silently ignored or answered with a bare 'unknown'."""
+    block = dotted.split(".", 1)[0]
+    block_keys = sorted(k for k in valid if k.startswith(block + "."))
+    if "." in dotted and block_keys:
+        return (f"unknown key {dotted!r} under config block {block!r}; "
+                f"valid keys: " + ", ".join(block_keys))
+    return f"unknown key {dotted!r} (see --help for the full flag list)"
+
+
 def _coerce(value: str, default: Any):
     if isinstance(default, bool):
         if isinstance(value, bool):
@@ -324,19 +376,17 @@ def _set_dotted(cfg: TrainConfig, dotted: str, value: Any) -> None:
     setattr(obj, parts[-1], _coerce(value, current))
 
 
-def load_config_file(path: str, base: Optional[TrainConfig] = None) -> TrainConfig:
-    """Apply a JSON config file (flat or nested) onto a TrainConfig.
+def config_from_dict(data: dict, base: Optional[TrainConfig] = None,
+                     source: str = "<dict>") -> TrainConfig:
+    """Apply a (flat or nested) config dict onto a TrainConfig.
 
-    The `accelerate config` YAML tier's equivalent (SURVEY §5 "Config / flag
-    system"): persistent settings in a file, per-run overrides as flags.
     Accepts `{"optim": {"lr": 0.1}}` nesting, dotted keys ("optim.lr"), or
-    the flat reference aliases ("lr"); `TrainConfig.to_json()` output loads
-    back unchanged.
+    the flat reference aliases ("lr"); `TrainConfig.to_dict()` output loads
+    back unchanged. Shared by `--config file.json` and the serving engine's
+    artifact-embedded config (trainer/checkpoint.py meta.json).
     """
     cfg = base or TrainConfig()
     valid = {name for name, _ in _leaf_fields()}
-    with open(path) as f:
-        data = json.load(f)
 
     def apply(tree: dict, prefix: str) -> None:
         for k, v in tree.items():
@@ -346,11 +396,23 @@ def load_config_file(path: str, base: Optional[TrainConfig] = None) -> TrainConf
                 continue
             dotted = _REFERENCE_ALIASES.get(dotted, dotted)
             if dotted not in valid:
-                raise ValueError(f"unknown config key {dotted!r} in {path}")
+                raise ValueError(
+                    f"{_unknown_key_message(dotted, valid)} in {source}")
             _set_dotted(cfg, dotted, v)
 
     apply(data, "")
     return cfg
+
+
+def load_config_file(path: str, base: Optional[TrainConfig] = None) -> TrainConfig:
+    """Apply a JSON config file onto a TrainConfig (see `config_from_dict`).
+
+    The `accelerate config` YAML tier's equivalent (SURVEY §5 "Config / flag
+    system"): persistent settings in a file, per-run overrides as flags.
+    """
+    with open(path) as f:
+        data = json.load(f)
+    return config_from_dict(data, base=base, source=path)
 
 
 def parse_cli(argv: Optional[Sequence[str]] = None, base: Optional[TrainConfig] = None) -> TrainConfig:
@@ -419,7 +481,8 @@ def parse_cli(argv: Optional[Sequence[str]] = None, base: Optional[TrainConfig] 
             raise SystemExit(0)
         dotted = _REFERENCE_ALIASES.get(key, key)
         if dotted not in valid:
-            raise SystemExit(f"unknown flag --{key} (see --help)")
+            raise SystemExit(
+                f"unknown flag --{key}: {_unknown_key_message(dotted, valid)}")
         try:
             _set_dotted(cfg, dotted, value)
         except (TypeError, ValueError) as e:

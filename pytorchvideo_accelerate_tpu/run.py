@@ -60,6 +60,17 @@ def main(argv: Optional[Sequence[str]] = None) -> dict:
               f"(reuse with --config {write_to})")
         return {"config_written": write_to}
     trainer = Trainer(cfg)
+    if cfg.export_inference:
+        # checkpoint -> serving artifact, no training: resume (when
+        # configured) then write the params-only EMA-resolved export
+        try:
+            trainer._maybe_resume()
+            out = trainer.export_inference(cfg.export_inference)
+        finally:
+            trainer.close()
+        print(f"wrote inference artifact to {out} "
+              f"(serve with pva-tpu-serve --serve.checkpoint {out})")
+        return {"exported": out}
     if cfg.eval_only:
         return trainer.evaluate()
     return trainer.fit()

@@ -1,0 +1,26 @@
+"""Serving subsystem: checkpoint -> endpoint.
+
+The training stack ends at a `Checkpointer` artifact; this package turns one
+into a servable endpoint (the TPU-serving half of the Gemma-on-TPU recipe
+and the actor side of the Podracer actor/learner split — PAPERS.md):
+
+- `engine.InferenceEngine` — params pinned to the mesh (EMA-resolved), a
+  cache of jitted forward functions keyed by (batch bucket, view count),
+  and the SAME view-averaging protocol as `evaluate()`;
+- `batcher.MicroBatcher` — bounded request queue with adaptive
+  micro-batching (flush on size or deadline, pad to the nearest bucket,
+  masked padded rows) returning per-request futures;
+- `stats.ServingStats` — rolling latency percentiles, queue depth,
+  batch-fill ratio, throughput;
+- `server.InferenceServer` — stdlib HTTP front (`/predict`, `/healthz`,
+  `/stats`) and the `pva-tpu-serve` CLI.
+
+See docs/SERVING.md.
+"""
+
+from pytorchvideo_accelerate_tpu.serving.batcher import (  # noqa: F401
+    MicroBatcher,
+    QueueFullError,
+)
+from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine  # noqa: F401
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats  # noqa: F401

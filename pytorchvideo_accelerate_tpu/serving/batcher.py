@@ -1,0 +1,206 @@
+"""Adaptive micro-batching in front of the accelerator.
+
+One request is one clip; the chip wants full buckets. The batcher sits
+between them: a bounded queue feeding a single flush thread that launches a
+batch when `max_batch_size` requests are waiting OR the oldest has waited
+`max_wait_ms` — the standard serving latency/throughput dial (low wait =
+interactive latency, high wait = training-like fill ratios). Each launch is
+padded UP to the engine's nearest compiled bucket with zero rows + a mask
+(the eval path's masked-row convention), and per-request futures resolve
+with exactly their own row — padded rows are sliced away host-side and can
+never reach a response.
+
+Single flush thread by design: the accelerator executes one batch at a time
+anyway, requests stay strictly FIFO, and every stats observation happens on
+one thread. Callers block on `Future.result(timeout)`; a full queue raises
+`QueueFullError` at submit time (the HTTP front maps it to 503) instead of
+growing tail latency without bound.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, clip_key
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+
+class QueueFullError(RuntimeError):
+    """Request queue at serve.max_queue — shed load instead of buffering."""
+
+
+@dataclass
+class _Request:
+    clip: Dict[str, np.ndarray]
+    future: Future
+    t_enqueue: float
+    key: tuple  # clip geometry: only same-shaped requests batch together
+
+
+_STOP = object()
+
+
+class MicroBatcher:
+    """Bounded request queue + flush thread over an `InferenceEngine`."""
+
+    def __init__(self, engine, *, max_batch_size: Optional[int] = None,
+                 max_wait_ms: float = 5.0, max_queue: int = 256, stats=None):
+        self.engine = engine
+        # collection cap: the largest compiled bucket (so a full collection
+        # pads to fill ratio 1.0), optionally tightened by the caller
+        top = engine.buckets[-1]
+        self.max_batch_size = min(max_batch_size or top, top)
+        self.max_wait_s = max(max_wait_ms, 0.0) / 1e3
+        self.stats = stats
+        self._q: "queue.Queue" = queue.Queue(maxsize=max(max_queue, 1))
+        self._closed = threading.Event()
+        self._thread = threading.Thread(
+            target=self._loop, name="pva-serve-batcher", daemon=True)
+        self._thread.start()
+
+    # --- client side ------------------------------------------------------
+
+    def submit(self, clip: Dict[str, np.ndarray]) -> Future:
+        """Enqueue ONE clip — leaves (T, H, W, C) or (V, T, H, W, C) — and
+        get a Future resolving to its fp32 logits (num_classes,)."""
+        clips = {k: np.asarray(v) for k, v in clip.items() if k in CLIP_KEYS}
+        if not clips:
+            raise ValueError("request has neither 'video' nor 'slow'/'fast'")
+        for k, v in clips.items():
+            if v.ndim not in (4, 5):
+                raise ValueError(
+                    f"clip {k!r} must be (T,H,W,C) or (V,T,H,W,C), "
+                    f"got shape {v.shape}")
+        if self._closed.is_set():
+            raise RuntimeError("batcher is closed")
+        req = _Request(
+            clip=clips, future=Future(), t_enqueue=time.monotonic(),
+            key=clip_key(clips),
+        )
+        try:
+            self._q.put_nowait(req)
+        except queue.Full:
+            if self.stats is not None:
+                self.stats.observe_rejected()
+            raise QueueFullError(
+                f"request queue full ({self._q.maxsize}); retry later"
+            ) from None
+        if self._closed.is_set() and not req.future.done():
+            # close() may have drained the queue between our closed-check
+            # and the put: nothing will serve this request — fail it fast
+            # instead of leaving the caller to hit its own timeout
+            try:
+                req.future.set_exception(RuntimeError("batcher closed"))
+            except Exception:  # lost the race to the flush thread: resolved
+                pass
+        return req.future
+
+    def queue_depth(self) -> int:
+        return self._q.qsize()
+
+    def close(self) -> None:
+        """Stop the flush thread; pending requests are failed, not dropped
+        silently."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        try:
+            self._q.put_nowait(_STOP)  # wake a blocked get()
+        except queue.Full:
+            pass  # the loop's bounded get() re-checks _closed within 100 ms
+        self._thread.join(timeout=30.0)
+        leftovers: List[_Request] = []
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not _STOP:
+                leftovers.append(item)
+        for req in leftovers:
+            req.future.set_exception(RuntimeError("batcher closed"))
+
+    # --- flush thread -----------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._closed.is_set():
+            try:
+                first = self._q.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if first is _STOP:
+                break
+            batch = [first]
+            deadline = first.t_enqueue + self.max_wait_s
+            while len(batch) < self.max_batch_size:
+                wait = deadline - time.monotonic()
+                if wait <= 0:
+                    break
+                try:
+                    nxt = self._q.get(timeout=wait)
+                except queue.Empty:
+                    break
+                if nxt is _STOP:
+                    self._closed.set()
+                    break
+                batch.append(nxt)
+            self._flush(batch)
+        # drain-on-close happens in close(); anything arriving after the
+        # loop exits is failed there
+
+    def _flush(self, batch: List[_Request]) -> None:
+        # only identically-shaped requests share a forward (mixed view
+        # counts / geometries each get their own padded launch)
+        groups: Dict[tuple, List[_Request]] = {}
+        for req in batch:
+            groups.setdefault(req.key, []).append(req)
+        for reqs in groups.values():
+            try:
+                self._run(reqs)
+            except Exception as e:  # noqa: BLE001 - fail the requests, not the thread
+                logger.exception("serving batch failed")
+                for req in reqs:
+                    if not req.future.done():
+                        req.future.set_exception(e)
+
+    def _run(self, reqs: List[_Request]) -> None:
+        # claim each future before doing device work: a caller-cancelled
+        # future (the HTTP front's request-timeout path) drops out of the
+        # batch here, and a successful claim makes later cancel() attempts
+        # fail instead of racing set_result below
+        reqs = [r for r in reqs if r.future.set_running_or_notify_cancel()]
+        if not reqs:
+            return
+        n = len(reqs)
+        bucket = self.engine.bucket_for(n)
+        stacked: Dict[str, np.ndarray] = {}
+        for k in reqs[0].clip:
+            rows = np.stack([r.clip[k] for r in reqs])
+            if bucket > n:  # zero rows, masked out below
+                pad = np.zeros((bucket - n,) + rows.shape[1:], rows.dtype)
+                rows = np.concatenate([rows, pad], axis=0)
+            stacked[k] = rows
+        # the masked-row convention of the eval path: 1.0 = real request,
+        # 0.0 = padding. The engine's pure forward ignores it; it documents
+        # (and lets debug tooling assert) which rows are live.
+        stacked["mask"] = np.asarray(
+            [1.0] * n + [0.0] * (bucket - n), np.float32)
+        logits = self.engine.predict(stacked)
+        done = time.monotonic()
+        # padded rows are sliced away here — a response can only ever carry
+        # logits[i] for the request that submitted row i
+        latencies = []
+        for i, req in enumerate(reqs):
+            latencies.append(done - req.t_enqueue)
+            req.future.set_result(logits[i])
+        if self.stats is not None:
+            self.stats.observe_batch(n, bucket, latencies)

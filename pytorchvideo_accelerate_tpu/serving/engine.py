@@ -1,0 +1,235 @@
+"""The inference engine: pinned weights + bucketed compiled forwards.
+
+Checkpoint-to-endpoint, step 1 of 2 (the batcher is step 2): restore a
+params-only `export_inference` artifact (EMA-resolved at export — the same
+weights `evaluate()` scores), pin the params to the device mesh with the
+training stack's own `shard_params` rules, and serve batched forwards
+through a cache of jitted functions keyed by (batch bucket, view count).
+
+Why buckets: XLA compiles per shape. A serving batch of every possible size
+would compile on demand at request time (seconds of tail latency); instead
+the batcher pads every launch UP to the nearest bucket — multiples of the
+mesh's data-shard count, doubling up to `max_batch_size` — so steady-state
+traffic only ever hits already-compiled executables. Padded rows ride a
+mask (the eval path's masked-metrics convention) and are stripped by the
+batcher before responses resolve.
+
+Parity: the forward is `_constrain_batch` -> `device_normalize_batch` ->
+`multiview_logits` over the eval weights — the exact op sequence of
+`make_eval_step` (trainer/steps.py) minus the metric sums, so serving
+logits (and therefore top-1) match `evaluate()`.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.parallel.mesh import data_shard_count, make_mesh
+from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch, shard_params
+from pytorchvideo_accelerate_tpu.trainer.steps import (
+    _constrain_batch,
+    device_normalize_batch,
+    model_inputs,
+    multiview_logits,
+)
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+# the batch-dict clip leaves (single definition — batcher.py and server.py
+# import it, so request filtering can't diverge across the three layers)
+CLIP_KEYS = ("video", "slow", "fast")
+
+# compiled-executable cache bound: every distinct request geometry costs a
+# synchronous compile and permanent executable memory, so arbitrary client
+# shapes must hit a ceiling instead of growing the cache without limit
+MAX_COMPILED_KEYS = 64
+
+
+def clip_key(clips: Dict[str, Any]) -> tuple:
+    """Geometry key for a clip dict: ((name, shape), ...) sorted by name —
+    the unit of batch grouping (batcher) and forward-cache keying (engine)."""
+    return tuple((k, tuple(np.shape(clips[k]))) for k in sorted(clips))
+
+
+def _round_up(n: int, multiple: int) -> int:
+    return ((n + multiple - 1) // multiple) * multiple
+
+
+def compute_buckets(max_batch_size: int, shards: int) -> Tuple[int, ...]:
+    """Padding targets: shard-count multiples doubling up to max_batch_size.
+
+    Every bucket must divide over the mesh's batch axes (shard_batch places
+    the batch dim across `data*fsdp` devices), so the smallest bucket is the
+    shard count itself; doubling keeps the compiled-executable count
+    logarithmic in max_batch_size."""
+    top = _round_up(max(max_batch_size, 1), shards)
+    buckets = []
+    b = shards
+    while b < top:
+        buckets.append(b)
+        b *= 2
+    buckets.append(top)
+    return tuple(buckets)
+
+
+class InferenceEngine:
+    """Batched forward passes over mesh-pinned weights.
+
+    Construct directly from in-memory pieces (benchmarks, tests) or via
+    `from_artifact` (the serving path). `predict` takes a host batch dict —
+    clip leaves shaped (B, T, H, W, C) or (B, V, T, H, W, C) with an
+    optional "mask" — and returns fp32 logits (B, num_classes) for EVERY
+    row, padded ones included; the batcher is responsible for never
+    resolving a padded row into a response.
+    """
+
+    def __init__(self, model, params, batch_stats, mesh=None, *,
+                 num_classes: int, max_batch_size: int = 8,
+                 device_normalize=None, input_dtype: str = "float32",
+                 model_name: str = "", stats=None):
+        self.model = model
+        self.mesh = mesh if mesh is not None else make_mesh()
+        self.num_classes = int(num_classes)
+        self.model_name = model_name
+        self.input_dtype = input_dtype
+        self.stats = stats
+        self._device_normalize = device_normalize
+        self.shards = data_shard_count(self.mesh)
+        self.buckets = compute_buckets(max_batch_size, self.shards)
+        # pin the weights to the mesh once (replicated / fsdp-sharded per
+        # the training rules); every forward reuses the same pinned arrays
+        self.params = shard_params(self.mesh, params)
+        self.batch_stats = shard_params(self.mesh, batch_stats or {})
+        self._fns: Dict[tuple, Callable] = {}
+        self._lock = threading.Lock()
+        # set by from_artifact: the training run's resolved TrainConfig
+        # (clip geometry for warmup, provenance for /healthz debugging)
+        self.artifact_config = None
+
+    # --- construction -----------------------------------------------------
+
+    @classmethod
+    def from_artifact(cls, path: str, mesh=None, *,
+                      max_batch_size: Optional[int] = None, stats=None
+                      ) -> "InferenceEngine":
+        """Restore an `export_inference` artifact (trainer/checkpoint.py)
+        into a ready engine: rebuild the model from the artifact's resolved
+        config, load the EMA-resolved params, pin them to the mesh."""
+        from pytorchvideo_accelerate_tpu.config import TrainConfig, config_from_dict
+        from pytorchvideo_accelerate_tpu.models import create_model
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import load_inference
+
+        params, batch_stats, meta = load_inference(path)
+        cfg = (config_from_dict(meta["config"]) if meta.get("config")
+               else TrainConfig())
+        num_classes = int(meta.get("num_classes") or cfg.model.num_classes)
+        if not num_classes:
+            raise ValueError(
+                f"artifact {path} carries no num_classes (meta.json) and "
+                "its config has none — cannot size the classifier head")
+        cfg.model.num_classes = num_classes
+        mesh = mesh if mesh is not None else make_mesh()
+        model = create_model(cfg.model, cfg.mixed_precision, mesh=mesh)
+        # u8-trained runs ship raw uint8 clips and normalize in-graph
+        # (data.host_cast='u8'); serving must apply the identical affine
+        u8 = cfg.data.host_cast == "u8"
+        engine = cls(
+            model, params, batch_stats, mesh,
+            num_classes=num_classes,
+            max_batch_size=(max_batch_size if max_batch_size is not None
+                            else cfg.serve.max_batch_size),
+            device_normalize=((cfg.data.mean, cfg.data.std) if u8 else None),
+            input_dtype="uint8" if u8 else "float32",
+            model_name=meta.get("model") or cfg.model.name,
+            stats=stats,
+        )
+        engine.artifact_config = cfg
+        logger.info(
+            "engine: %s step %s, %d classes, ema_resolved=%s, buckets=%s "
+            "over %d-shard mesh",
+            engine.model_name, meta.get("step"), num_classes,
+            meta.get("ema_resolved"), engine.buckets, engine.shards)
+        return engine
+
+    # --- forward ----------------------------------------------------------
+
+    def bucket_for(self, n: int) -> int:
+        """Smallest compiled bucket holding `n` rows."""
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(
+            f"batch of {n} exceeds the largest bucket {self.buckets[-1]} "
+            f"(serve.max_batch_size)")
+
+    def _make_forward(self) -> Callable:
+        mesh, norm, model = self.mesh, self._device_normalize, self.model
+
+        def forward(params, batch_stats, batch):
+            batch = _constrain_batch(batch, mesh, leading_micro=False)
+            batch = device_normalize_batch(batch, norm)
+            logits = multiview_logits(
+                lambda x: model.apply(
+                    {"params": params, "batch_stats": batch_stats},
+                    x, train=False),
+                model_inputs(batch),
+            )
+            return logits.astype(jnp.float32)
+
+        return forward
+
+    def predict(self, batch: Dict[str, Any]) -> np.ndarray:
+        """fp32 logits (B, num_classes) for a host batch. B must be one of
+        `self.buckets` (the batcher guarantees this; direct callers pad
+        themselves). Non-clip keys ("mask", "label") are ignored — masking
+        is a host-side responsibility of the batcher."""
+        clips = {k: np.asarray(batch[k]) for k in CLIP_KEYS if k in batch}
+        if not clips:
+            raise ValueError("batch has neither 'video' nor 'slow'/'fast'")
+        n = next(iter(clips.values())).shape[0]
+        if n not in self.buckets:
+            raise ValueError(
+                f"batch size {n} is not a compiled bucket {self.buckets}; "
+                "pad to bucket_for(n) first")
+        key = clip_key(clips)
+        fn = self._fns.get(key)
+        if fn is None:
+            with self._lock:
+                fn = self._fns.get(key)
+                if fn is None:
+                    if len(self._fns) >= MAX_COMPILED_KEYS:
+                        raise ValueError(
+                            f"engine already compiled {len(self._fns)} "
+                            "distinct request geometries; refusing a new "
+                            "one (clients should send the serving "
+                            "geometry, see /healthz)")
+                    # one jit object per key: the cache maps every
+                    # (bucket, views, geometry) the service has seen to its
+                    # own compiled executable, and membership is the
+                    # "already compiled" signal for stats/warmup
+                    fn = jax.jit(self._make_forward())
+                    self._fns[key] = fn
+                    if self.stats is not None:
+                        self.stats.observe_compile()
+                    logger.info("engine: compiling forward for %s", key)
+        placed = shard_batch(self.mesh, clips)
+        return np.asarray(fn(self.params, self.batch_stats, placed))
+
+    def warmup(self, sample_clip: Dict[str, np.ndarray]) -> None:
+        """Pre-compile every bucket for one request geometry so first
+        requests never pay a compile: `sample_clip` is ONE request's clip
+        dict ((T,H,W,C) or (V,T,H,W,C) leaves)."""
+        for b in self.buckets:
+            batch = {k: np.broadcast_to(v, (b,) + tuple(np.shape(v))).copy()
+                     for k, v in sample_clip.items()}
+            self.predict(batch)
+
+    @property
+    def compiled_keys(self) -> tuple:
+        return tuple(self._fns)

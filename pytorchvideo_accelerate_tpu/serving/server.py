@@ -1,0 +1,246 @@
+"""Stdlib HTTP front + the `pva-tpu-serve` CLI.
+
+Endpoints:
+  POST /predict  — body {"video": nested-list clip} (or {"slow":…,"fast":…}
+                   for SlowFast), clip shaped (T,H,W,C) or (V,T,H,W,C);
+                   responds {"logits": […], "top1": k, "latency_ms": x}.
+  GET  /healthz  — liveness + model identity (load balancers poll this).
+  GET  /stats    — ServingStats.snapshot(): p50/p95/p99 latency, queue
+                   depth, batch-fill ratio, throughput, compile count.
+
+Deliberately stdlib (`http.server.ThreadingHTTPServer`): zero new
+dependencies, and the concurrency story is honest — handler threads only
+parse JSON and block on a batcher future; all accelerator work is
+serialized behind the MicroBatcher's single flush thread. Error mapping:
+bad request -> 400, queue full -> 503, request budget exceeded -> 504.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from concurrent.futures import TimeoutError as FutureTimeout
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional, Sequence
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.serving.batcher import MicroBatcher, QueueFullError
+from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, InferenceEngine
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "pva-tpu-serve/0.4"
+    protocol_version = "HTTP/1.1"
+
+    # route access logs to the package logger instead of stderr spam
+    def log_message(self, fmt, *args):  # noqa: D102
+        logger.debug("http: " + fmt, *args)
+
+    def _reply(self, code: int, payload: dict) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 - stdlib API
+        srv: "InferenceServer" = self.server.owner
+        if self.path == "/healthz":
+            eng = srv.engine
+            health = {
+                "status": "ok",
+                "model": eng.model_name,
+                "num_classes": eng.num_classes,
+                "input_dtype": eng.input_dtype,
+                "buckets": list(eng.buckets),
+                "platform": srv.platform,
+            }
+            if srv.expected_spec is not None:  # per-request (T, H, W, C)
+                health["clip_spec"] = {k: list(v[1:])
+                                       for k, v in srv.expected_spec.items()}
+            self._reply(200, health)
+        elif self.path == "/stats":
+            self._reply(200, srv.stats.snapshot())
+        else:
+            self._reply(404, {"error": f"no route {self.path}"})
+
+    def do_POST(self):  # noqa: N802 - stdlib API
+        srv: "InferenceServer" = self.server.owner
+        if self.path != "/predict":
+            self._reply(404, {"error": f"no route {self.path}"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            clip = {k: np.asarray(body[k], dtype=srv.engine.input_dtype)
+                    for k in CLIP_KEYS if k in body}
+            if not clip:
+                raise ValueError(
+                    "body needs 'video' (or 'slow'+'fast') nested lists")
+            srv.check_geometry(clip)
+        except (ValueError, TypeError, KeyError) as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        try:
+            future = srv.batcher.submit(clip)
+        except QueueFullError as e:
+            self._reply(503, {"error": str(e)})
+            return
+        except ValueError as e:
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        t0 = time.monotonic()
+        try:
+            logits = future.result(timeout=srv.request_timeout_s)
+        except FutureTimeout:
+            future.cancel()
+            self._reply(504, {
+                "error": f"request exceeded {srv.request_timeout_s}s budget"})
+            return
+        except Exception as e:  # noqa: BLE001 - batch failure surfaced per-request
+            self._reply(500, {"error": f"inference failed: {e}"})
+            return
+        self._reply(200, {
+            "logits": np.asarray(logits, np.float32).tolist(),
+            "top1": int(np.argmax(logits)),
+            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+        })
+
+
+class InferenceServer:
+    """ThreadingHTTPServer wrapper owning engine + batcher + stats."""
+
+    def __init__(self, engine: InferenceEngine, batcher: MicroBatcher,
+                 stats: ServingStats, host: str = "127.0.0.1", port: int = 0,
+                 request_timeout_s: float = 30.0,
+                 expected_spec: Optional[dict] = None):
+        import jax
+
+        self.engine = engine
+        self.batcher = batcher
+        self.stats = stats
+        self.request_timeout_s = request_timeout_s
+        # clip-name -> (1, T, H, W, C) from the artifact's config (None =
+        # accept any geometry; direct/bench construction)
+        self.expected_spec = expected_spec
+        self.platform = jax.devices()[0].platform
+        self.httpd = ThreadingHTTPServer((host, port), _Handler)
+        self.httpd.daemon_threads = True
+        self.httpd.owner = self  # handler back-reference
+        self._thread = None
+
+    @property
+    def address(self) -> tuple:
+        """Actual (host, port) bound — port 0 resolves here."""
+        return self.httpd.server_address[:2]
+
+    def check_geometry(self, clip: dict) -> None:
+        """400-guard: requests must carry the serving geometry. Every new
+        shape the engine sees costs a synchronous compile on the batch
+        thread (and a cached executable forever), so when the artifact
+        declared its clip spec, off-spec requests are rejected up front —
+        only the view count (leading axis of a rank-5 clip) is free."""
+        if self.expected_spec is None:
+            return
+        if sorted(clip) != sorted(self.expected_spec):
+            raise ValueError(
+                f"request clips {sorted(clip)} != served model's "
+                f"{sorted(self.expected_spec)}")
+        for k, v in clip.items():
+            want = tuple(self.expected_spec[k][1:])  # (T, H, W, C)
+            got = tuple(v.shape[-4:]) if v.ndim == 5 else tuple(v.shape)
+            if got != want:
+                raise ValueError(
+                    f"clip {k!r} geometry {tuple(v.shape)} does not match "
+                    f"the served model's (T,H,W,C)={want} "
+                    "(an optional leading view axis is allowed)")
+
+    def start(self) -> "InferenceServer":
+        """Serve on a background thread (tests / embedding)."""
+        import threading
+
+        self._thread = threading.Thread(
+            target=self.httpd.serve_forever, name="pva-serve-http",
+            daemon=True)
+        self._thread.start()
+        return self
+
+    def serve_forever(self) -> None:
+        """Serve on the calling thread (the CLI path)."""
+        try:
+            self.httpd.serve_forever()
+        except KeyboardInterrupt:
+            pass
+        finally:
+            self.close()
+
+    def close(self) -> None:
+        self.httpd.shutdown()
+        self.httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=10.0)
+            self._thread = None
+        self.batcher.close()
+
+
+def build_server(cfg) -> InferenceServer:
+    """serve.* config block -> a ready (not yet started) InferenceServer."""
+    import jax
+
+    s = cfg.serve
+    if not s.checkpoint:
+        raise SystemExit(
+            "serving needs --serve.checkpoint pointing at an "
+            "export_inference artifact (see docs/SERVING.md)")
+    if cfg.cpu:
+        jax.config.update("jax_platforms", "cpu")
+    stats = ServingStats(window=s.stats_window)
+    engine = InferenceEngine.from_artifact(
+        s.checkpoint, max_batch_size=s.max_batch_size, stats=stats)
+    spec = None
+    if engine.artifact_config is not None:
+        # pre-compile every bucket for the training run's clip geometry so
+        # the first requests never pay a compile (multi-view variants of
+        # the same geometry still compile on first arrival); the same spec
+        # then 400-guards /predict against off-geometry requests
+        from pytorchvideo_accelerate_tpu.models import model_input_spec
+
+        spec = model_input_spec(engine.artifact_config.model,
+                                engine.artifact_config.data)
+        sample = {k: np.zeros(shape[1:], engine.input_dtype)
+                  for k, shape in spec.items()}
+        logger.info("warmup: compiling buckets %s for %s",
+                    engine.buckets, {k: v.shape for k, v in sample.items()})
+        engine.warmup(sample)
+    batcher = MicroBatcher(
+        engine, max_wait_ms=s.max_wait_ms, max_queue=s.max_queue,
+        stats=stats)
+    stats.queue_depth_fn = batcher.queue_depth
+    return InferenceServer(engine, batcher, stats, host=s.host, port=s.port,
+                           request_timeout_s=s.request_timeout_s,
+                           expected_spec=spec)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    """`pva-tpu-serve --serve.checkpoint PATH [--serve.port N ...]`."""
+    from pytorchvideo_accelerate_tpu.config import parse_cli
+
+    cfg = parse_cli(argv)
+    server = build_server(cfg)
+    host, port = server.address
+    logger.info("serving %s on http://%s:%d (/predict /healthz /stats)",
+                server.engine.model_name, host, port)
+    print(f"pva-tpu-serve: http://{host}:{port}  model="
+          f"{server.engine.model_name} buckets={server.engine.buckets}",
+          flush=True)
+    server.serve_forever()
+
+
+if __name__ == "__main__":
+    main()

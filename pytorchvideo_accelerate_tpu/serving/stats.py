@@ -1,0 +1,104 @@
+"""Serving observability: rolling latency percentiles + batcher health.
+
+The serving-side sibling of the trainer's perf dict (input_wait_frac,
+steps_per_sec): `snapshot()` returns a flat {str: float} the trackers
+already know how to log (trainer/tracking.py TrackerHub.log) and the
+`/stats` endpoint returns verbatim. Everything is windowed (last N
+completed requests) so the numbers describe the *current* traffic, not the
+process lifetime; counters (requests/batches/compiles) are cumulative.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from typing import Callable, Dict, Optional, Sequence
+
+
+def _percentile(sorted_vals: Sequence[float], q: float) -> float:
+    """Nearest-rank percentile over an already-sorted sequence."""
+    if not sorted_vals:
+        return 0.0
+    idx = max(0, min(len(sorted_vals) - 1,
+                     int(round(q / 100.0 * len(sorted_vals) + 0.5)) - 1))
+    return float(sorted_vals[idx])
+
+
+class ServingStats:
+    """Thread-safe rolling serving metrics.
+
+    - latency percentiles (p50/p95/p99, ms) over the last `window`
+      completed requests, measured enqueue -> response (queue wait +
+      batching wait + device time — what the caller experiences);
+    - batch-fill ratio: real rows / padded bucket rows over the window —
+      1.0 means every launch was a full bucket, low values mean the
+      max_wait_ms deadline is flushing underfilled batches;
+    - throughput: completed requests/sec over the window span;
+    - queue depth: live gauge read from the batcher at snapshot time;
+    - cumulative counters: requests, batches, rejected, compiles (new
+      (bucket, views) shapes hitting the engine's jit cache).
+    """
+
+    def __init__(self, window: int = 1024,
+                 queue_depth_fn: Optional[Callable[[], int]] = None):
+        self._lock = threading.Lock()
+        self._lat = deque(maxlen=max(window, 1))     # (done_ts, latency_s)
+        self._fills = deque(maxlen=max(window, 1))   # (n_real, bucket)
+        self.queue_depth_fn = queue_depth_fn
+        self.requests = 0
+        self.batches = 0
+        self.rejected = 0
+        self.compiles = 0
+        self._started = time.monotonic()
+
+    def observe_batch(self, n_real: int, bucket: int,
+                      latencies_s: Sequence[float]) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self.requests += len(latencies_s)
+            self.batches += 1
+            self._fills.append((int(n_real), int(bucket)))
+            for lat in latencies_s:
+                self._lat.append((now, float(lat)))
+
+    def observe_rejected(self, n: int = 1) -> None:
+        with self._lock:
+            self.rejected += n
+
+    def observe_compile(self) -> None:
+        with self._lock:
+            self.compiles += 1
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            lat = list(self._lat)
+            fills = list(self._fills)
+            out: Dict[str, float] = {
+                "requests": float(self.requests),
+                "batches": float(self.batches),
+                "rejected": float(self.rejected),
+                "compiled_buckets": float(self.compiles),
+                "uptime_s": round(time.monotonic() - self._started, 3),
+            }
+        vals = sorted(v for _, v in lat)
+        out["p50_ms"] = round(_percentile(vals, 50) * 1e3, 3)
+        out["p95_ms"] = round(_percentile(vals, 95) * 1e3, 3)
+        out["p99_ms"] = round(_percentile(vals, 99) * 1e3, 3)
+        real = sum(n for n, _ in fills)
+        padded = sum(b for _, b in fills)
+        out["batch_fill_ratio"] = round(real / padded, 4) if padded else 0.0
+        # window-span throughput: requests completed per second between the
+        # oldest and newest entries still in the window (0 when the window
+        # holds fewer than 2 completions — no span to divide by)
+        if len(lat) >= 2 and lat[-1][0] > lat[0][0]:
+            out["throughput_rps"] = round(
+                (len(lat) - 1) / (lat[-1][0] - lat[0][0]), 3)
+        else:
+            out["throughput_rps"] = 0.0
+        if self.queue_depth_fn is not None:
+            try:
+                out["queue_depth"] = float(self.queue_depth_fn())
+            except Exception:  # a closing batcher must not break /stats
+                out["queue_depth"] = 0.0
+        return out

@@ -25,6 +25,7 @@ run.py:208-212.
 
 from __future__ import annotations
 
+import json
 import os
 from typing import Any, Optional, Tuple
 
@@ -34,6 +35,71 @@ import orbax.checkpoint as ocp
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 
 logger = get_logger("pva_tpu")
+
+# serving artifact layout (export_inference/load_inference): a directory of
+#   weights.npz  — flat {params/..., batch_stats/...} numpy arrays
+#   meta.json    — format tag, step, ema_resolved, num_classes, model name,
+#                  and the resolved TrainConfig dict
+INFERENCE_FORMAT = "pva-tpu-inference-v1"
+_WEIGHTS_FILE = "weights.npz"
+_META_FILE = "meta.json"
+
+
+def export_inference(path: str, state, config=None,
+                     meta: Optional[dict] = None) -> str:
+    """Write a params-only serving artifact: the checkpoint-to-endpoint
+    handoff (serving/engine.py `InferenceEngine.from_artifact`).
+
+    EMA-RESOLVED: when the state carries `ema_params` (--optim.ema_decay),
+    those are the weights exported — the same weights `evaluate()` scores —
+    so serving top-1 matches eval by construction. BN `batch_stats` ride
+    along; optimizer state does NOT (the engine never builds an optimizer,
+    and the artifact is a fraction of a full checkpoint's size). Plain-numpy
+    npz + JSON: loadable with no orbax and no training stack.
+    """
+    from pytorchvideo_accelerate_tpu.models.convert import save_converted
+
+    os.makedirs(path, exist_ok=True)
+    params = state.ema_params if state.ema_params is not None else state.params
+    tree = jax.device_get({"params": params,
+                           "batch_stats": state.batch_stats or {}})
+    save_converted(tree, os.path.join(path, _WEIGHTS_FILE))
+    info = {
+        "format": INFERENCE_FORMAT,
+        "step": int(jax.device_get(state.step)),
+        "ema_resolved": state.ema_params is not None,
+        **(meta or {}),
+    }
+    if config is not None:
+        info["config"] = config.to_dict()
+    with open(os.path.join(path, _META_FILE), "w") as f:
+        json.dump(info, f, indent=1, default=str)
+    logger.info("exported inference artifact to %s (step %d, ema=%s)",
+                path, info["step"], info["ema_resolved"])
+    return path
+
+
+def load_inference(path: str) -> Tuple[dict, dict, dict]:
+    """Load an `export_inference` artifact -> (params, batch_stats, meta)."""
+    from pytorchvideo_accelerate_tpu.models.convert import load_converted
+
+    meta_path = os.path.join(path, _META_FILE)
+    if not os.path.exists(meta_path):
+        raise FileNotFoundError(
+            f"{path} is not an inference artifact (no {_META_FILE}). "
+            "Produce one with Trainer.export_inference / "
+            "--export_inference PATH; a full training checkpoint dir "
+            "cannot be served directly."
+        )
+    with open(meta_path) as f:
+        meta = json.load(f)
+    if meta.get("format") != INFERENCE_FORMAT:
+        raise ValueError(
+            f"unknown inference artifact format {meta.get('format')!r} "
+            f"in {path} (expected {INFERENCE_FORMAT})"
+        )
+    tree = load_converted(os.path.join(path, _WEIGHTS_FILE))
+    return tree["params"], tree["batch_stats"], meta
 
 
 class Checkpointer:

@@ -488,6 +488,33 @@ class Trainer:
         self.train_loader.state = data_state
         return data_state.epoch
 
+    def export_inference(self, path: str) -> str:
+        """Write the params-only (EMA-resolved) serving artifact for the
+        CURRENT in-memory state — the checkpoint-to-endpoint handoff
+        (trainer/checkpoint.export_inference; serve it with
+        `pva-tpu-serve --serve.checkpoint PATH`)."""
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+            export_inference,
+        )
+
+        return export_inference(
+            path, self.state, config=self.cfg,
+            meta={"num_classes": self.num_classes,
+                  "model": self.cfg.model.name},
+        )
+
+    def close(self) -> None:
+        """Release loaders/checkpointer/trackers without running fit()
+        (export-only and aborted constructions)."""
+        if self.trackers:
+            self.trackers.finish()
+            self.trackers = None
+        if self.checkpointer is not None:
+            self.checkpointer.close()
+            self.checkpointer = None
+        self.train_loader.close()
+        self.val_loader.close()
+
     # --- fit ----------------------------------------------------------------
 
     def _save(self, kind: str, epoch: int) -> None:

@@ -378,6 +378,36 @@ def make_pretrain_eval_step(model, mesh) -> Callable:
     return jax.jit(eval_step)
 
 
+def fold_views(inputs):
+    """Fold the per-video view axis into the batch dim: clip leaves shaped
+    (B, V, T, H, W, C) become (B*V, T, H, W, C); single-view (rank-5) inputs
+    pass through. Returns `(inputs, num_views)`. Works on the single-pathway
+    tensor and the SlowFast (slow, fast) tuple alike."""
+    first = inputs[0] if isinstance(inputs, tuple) else inputs
+    num_views = first.shape[1] if first.ndim == 6 else 1
+    if num_views > 1:
+        inputs = jax.tree.map(
+            lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
+            inputs,
+        )
+    return inputs, num_views
+
+
+def multiview_logits(forward: Callable, inputs):
+    """The multi-view logit-averaging protocol (reference uniform-sampler
+    tiling, run.py:163), shared by `evaluate()` and the serving engine so
+    their top-1 agrees by construction: fold views into the batch (one big
+    MXU-friendly forward), then view-average the logits in fp32 before any
+    argmax. `forward(clips) -> logits` over view-folded clips."""
+    inputs, num_views = fold_views(inputs)
+    logits = forward(inputs)
+    if num_views > 1:
+        logits = logits.astype(jnp.float32).reshape(
+            -1, num_views, logits.shape[-1]
+        ).mean(axis=1)
+    return logits
+
+
 def make_eval_step(model, mesh, label_smoothing: float = 0.0,
                    device_normalize=None) -> Callable:
     """Build `eval_step(state, batch) -> {loss_sum, correct, count}` —
@@ -386,9 +416,9 @@ def make_eval_step(model, mesh, label_smoothing: float = 0.0,
 
     Multi-view eval (reference uniform-sampler tiling, run.py:163): when the
     clip leaves carry a view axis — (B, V, T, H, W, C) from a
-    `num_clips > 1` source — the views are folded into the batch for the
-    forward pass (one big MXU-friendly batch) and the logits are
-    view-averaged in-graph before the argmax."""
+    `num_clips > 1` source — `multiview_logits` folds the views into the
+    batch for the forward pass and view-averages the logits in-graph before
+    the argmax (the same helper the serving engine forwards through)."""
 
     def eval_step(state: TrainState, batch: dict) -> dict:
         batch = _constrain_batch(batch, mesh, leading_micro=False)
@@ -396,27 +426,18 @@ def make_eval_step(model, mesh, label_smoothing: float = 0.0,
         mask = batch.get("mask")
         if mask is None:
             mask = jnp.ones(batch["label"].shape, jnp.float32)
-        inputs = model_inputs(batch)
-        first = inputs[0] if isinstance(inputs, tuple) else inputs
-        num_views = first.shape[1] if first.ndim == 6 else 1
-        if num_views > 1:
-            inputs = jax.tree.map(
-                lambda x: x.reshape(x.shape[0] * x.shape[1], *x.shape[2:]),
-                inputs,
-            )
         # score the EMA weights when the state carries them (the recipes'
         # eval convention); BN stats stay the live ones
         eval_params = (state.ema_params if state.ema_params is not None
                        else state.params)
-        logits = model.apply(
-            {"params": eval_params, "batch_stats": state.batch_stats},
-            inputs,
-            train=False,
+        logits = multiview_logits(
+            lambda x: model.apply(
+                {"params": eval_params, "batch_stats": state.batch_stats},
+                x,
+                train=False,
+            ),
+            model_inputs(batch),
         )
-        if num_views > 1:
-            logits = logits.astype(jnp.float32).reshape(
-                -1, num_views, logits.shape[-1]
-            ).mean(axis=1)
         loss, correct, count = _loss_and_metrics(
             logits, batch["label"], mask, label_smoothing
         )

@@ -54,10 +54,14 @@ def step_memory_bytes(model_name: str, batch: int, frames: int, crop: int,
         "output_bytes": int(ma.output_size_in_bytes),
         "temp_bytes": int(ma.temp_size_in_bytes),
         "alias_bytes": int(ma.alias_size_in_bytes),
-        "peak_bytes": int(ma.peak_memory_in_bytes),
     }
     out["estimate_bytes"] = (out["argument_bytes"] + out["output_bytes"]
                              + out["temp_bytes"] - out["alias_bytes"])
+    # peak_memory_in_bytes is absent from the pinned jax 0.4.37
+    # CompiledMemoryStats (same vintage as the collectives shims); the
+    # sizing logic keys on estimate_bytes, so fall back to it
+    peak = getattr(ma, "peak_memory_in_bytes", None)
+    out["peak_bytes"] = int(peak) if peak is not None else out["estimate_bytes"]
     return out
 
 

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Serving recipe: checkpoint -> export artifact -> HTTP endpoint
+# (docs/SERVING.md). Mirrors the training recipes: override anything via
+# env vars or extra flags in "$@".
+#
+#   OUTPUT_DIR=outputs ./scripts/run_serve.sh            # export + serve
+#   ARTIFACT=outputs/artifact ./scripts/run_serve.sh     # serve existing
+set -euo pipefail
+
+OUTPUT_DIR="${OUTPUT_DIR:-outputs}"
+ARTIFACT="${ARTIFACT:-${OUTPUT_DIR}/artifact}"
+PORT="${PORT:-8100}"
+
+# 1. Export a params-only (EMA-resolved) serving artifact from the latest
+#    checkpoint, unless one already exists. Model/data flags must match the
+#    training run (or pass --config the run's resolved config).
+if [ ! -f "${ARTIFACT}/meta.json" ]; then
+  python -m pytorchvideo_accelerate_tpu.run \
+    --checkpoint.output_dir "${OUTPUT_DIR}" \
+    --resume_from_checkpoint auto \
+    --export_inference "${ARTIFACT}" \
+    "$@"
+fi
+
+# 2. Serve it. Interactive endpoints want small --serve.max_wait_ms (low
+#    latency); bulk scoring wants it large (high batch-fill ratio). Watch
+#    /stats: p50/p99 latency, queue_depth, batch_fill_ratio.
+exec python -m pytorchvideo_accelerate_tpu.serving.server \
+  --serve.checkpoint "${ARTIFACT}" \
+  --serve.host 0.0.0.0 \
+  --serve.port "${PORT}" \
+  --serve.max_batch_size 8 \
+  --serve.max_wait_ms 5

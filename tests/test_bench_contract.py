@@ -157,3 +157,21 @@ def test_finalize_line_fits_driver_capture():
     assert parsed["suspect"] is False
     # fallback/error variants are folded out of the compact models map
     assert set(parsed["models"]) == set(bench.WORKLOADS)
+
+
+def test_finalize_serving_lane_keys():
+    """The serving smoke's headline keys (p50/p99 latency + fill ratio)
+    plumb through finalize; a failed serving lane surfaces as serve_error
+    instead of vanishing."""
+    extras = {"serving": {"serve_p50_ms": 3.2, "serve_p99_ms": 9.8,
+                          "serve_fill_ratio": 0.75, "serve_rps": 120.0}}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["serve_p50_ms"] == 3.2
+    assert out["serve_p99_ms"] == 9.8
+    assert out["serve_fill_ratio"] == 0.75
+    assert "serve_rps" not in out  # detail stays in bench_partial.json
+
+    out = bench.finalize(_model(), {"serving": {"error": "boom"}},
+                         user_smoke=False)
+    assert out["serve_error"] == "boom"
+    assert "serve_p50_ms" not in out

@@ -144,3 +144,55 @@ class TestConfigFile:
         p.write_text(json.dumps({"optim": {"learning_rate_typo": 1}}))
         with pytest.raises(ValueError, match="learning_rate_typo"):
             load_config_file(str(p))
+
+    def test_unknown_key_under_known_block_lists_valid_keys(self, tmp_path):
+        """A typo under a real block must fail with the block's valid keys
+        in the message — never be silently ignored."""
+        import json
+
+        import pytest
+
+        from pytorchvideo_accelerate_tpu.config import load_config_file
+
+        p = tmp_path / "bad.json"
+        p.write_text(json.dumps({"serve": {"typo_key": 1}}))
+        with pytest.raises(ValueError) as ei:
+            load_config_file(str(p))
+        msg = str(ei.value)
+        assert "serve.typo_key" in msg
+        assert "valid keys" in msg
+        assert "serve.checkpoint" in msg and "serve.max_batch_size" in msg
+
+
+class TestServeBlock:
+    def test_serve_flags_parse(self):
+        cfg = parse_cli([
+            "--serve.checkpoint", "/tmp/art",
+            "--serve.port", "9001",
+            "--serve.max_wait_ms", "12.5",
+            "--serve.max_batch_size=16",
+        ])
+        assert cfg.serve.checkpoint == "/tmp/art"
+        assert cfg.serve.port == 9001
+        assert cfg.serve.max_wait_ms == 12.5
+        assert cfg.serve.max_batch_size == 16
+
+    def test_unknown_dotted_flag_under_known_block_lists_valid_keys(self):
+        import pytest
+
+        with pytest.raises(SystemExit) as ei:
+            parse_cli(["--serve.typo_key", "1"])
+        msg = str(ei.value)
+        assert "serve.typo_key" in msg
+        assert "valid keys" in msg and "serve.checkpoint" in msg
+
+    def test_unknown_block_still_gets_generic_error(self):
+        import pytest
+
+        with pytest.raises(SystemExit) as ei:
+            parse_cli(["--nosuchblock.key", "1"])
+        assert "nosuchblock.key" in str(ei.value)
+
+    def test_export_inference_flag_parses(self):
+        cfg = parse_cli(["--export_inference", "/tmp/art"])
+        assert cfg.export_inference == "/tmp/art"

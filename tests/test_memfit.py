@@ -5,6 +5,7 @@ and the CLI emits a parseable recommendation."""
 import json
 
 import numpy as np
+import pytest
 
 from pytorchvideo_accelerate_tpu.utils.memfit import (
     find_max_batch,
@@ -12,6 +13,10 @@ from pytorchvideo_accelerate_tpu.utils.memfit import (
 )
 
 
+# multi-compile tests (60-90s each: two sized compiles / a full bisection)
+# belong in the slow lane — the timeout-bound tier-1 run keeps the 20s
+# single-compile u8 test as its in-lane memory-accounting check
+@pytest.mark.slow
 def test_memory_grows_with_batch():
     a = step_memory_bytes("slow_r50", 1, frames=4, crop=32, num_classes=4,
                           overrides=None)
@@ -53,6 +58,7 @@ def test_non_power_of_two_cap_is_reached():
     assert best == 70
 
 
+@pytest.mark.slow
 def test_cli_emits_recommendation(capsys):
     from pytorchvideo_accelerate_tpu.utils import memfit
 

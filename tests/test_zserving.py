@@ -1,0 +1,372 @@
+"""Serving subsystem tests (engine + batcher + stats + export artifact).
+
+Named `test_zserving*` ON PURPOSE: the tier-1 suite is timeout-bound and
+runs alphabetically, so the serving additions sort LAST — a slow run kills
+these, never the pre-existing suite. Keep anything added here cheap (the
+HTTP round-trip tests live in test_zserving_http.py behind the `slow`
+marker).
+"""
+
+import threading
+import time
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.config import (
+    CheckpointConfig,
+    DataConfig,
+    ModelConfig,
+    OptimConfig,
+    TrainConfig,
+)
+from pytorchvideo_accelerate_tpu.serving.batcher import (
+    MicroBatcher,
+    QueueFullError,
+)
+from pytorchvideo_accelerate_tpu.serving.engine import (
+    InferenceEngine,
+    compute_buckets,
+)
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+
+
+# --- pure-host units (no compile) ------------------------------------------
+
+
+def test_compute_buckets_doubling_from_shard_count():
+    assert compute_buckets(8, 1) == (1, 2, 4, 8)
+    assert compute_buckets(8, 8) == (8,)
+    assert compute_buckets(6, 1) == (1, 2, 4, 6)
+    assert compute_buckets(1, 4) == (4,)  # bucket must divide over shards
+    assert compute_buckets(9, 2) == (2, 4, 8, 10)
+
+
+def test_multiview_logits_helper_matches_manual_mean():
+    """The extracted helper (shared by evaluate() and the engine) must be
+    the per-view mean of the folded forward."""
+    import jax.numpy as jnp
+
+    from pytorchvideo_accelerate_tpu.trainer.steps import multiview_logits
+
+    rng = np.random.default_rng(0)
+    views = rng.standard_normal((3, 2, 4, 8, 8, 3)).astype(np.float32)
+    w = rng.standard_normal((4 * 8 * 8 * 3, 5)).astype(np.float32)
+
+    def forward(x):  # toy classifier over folded (B*V, T, H, W, C)
+        return jnp.reshape(x, (x.shape[0], -1)) @ w
+
+    out = np.asarray(multiview_logits(forward, jnp.asarray(views)))
+    manual = np.stack(
+        [views[:, v].reshape(3, -1) @ w for v in range(2)], axis=1
+    ).mean(axis=1)
+    # tolerance: XLA vs numpy matmul reduction order differs in fp32
+    np.testing.assert_allclose(out, manual, rtol=1e-4, atol=1e-4)
+    # single-view passes through untouched (no view axis, no averaging)
+    single = jnp.asarray(views[:, 0])
+    np.testing.assert_allclose(
+        np.asarray(multiview_logits(forward, single)),
+        views[:, 0].reshape(3, -1) @ w, rtol=1e-4, atol=1e-4)
+
+
+def test_stats_percentiles_fill_and_window():
+    stats = ServingStats(window=8, queue_depth_fn=lambda: 3)
+    stats.observe_batch(4, 8, [0.010, 0.020, 0.030, 0.040])
+    stats.observe_batch(8, 8, [0.050] * 8)
+    stats.observe_rejected()
+    snap = stats.snapshot()
+    assert snap["requests"] == 12.0 and snap["batches"] == 2.0
+    assert snap["rejected"] == 1.0
+    assert snap["queue_depth"] == 3.0
+    # window=8 kept only the last 8 latencies (all 50 ms)
+    assert snap["p50_ms"] == 50.0 and snap["p99_ms"] == 50.0
+    assert snap["batch_fill_ratio"] == pytest.approx(12 / 16)
+    empty = ServingStats().snapshot()
+    assert empty["p50_ms"] == 0.0 and empty["batch_fill_ratio"] == 0.0
+
+
+class _FakeEngine:
+    """Row-identifying stand-in: logits[i] encodes the clip that fed row i,
+    so future/row mix-ups and padded-row leaks are detectable."""
+
+    buckets = (4,)
+    last_mask = None
+
+    def bucket_for(self, n):
+        assert n <= 4
+        return 4
+
+    def predict(self, batch):
+        type(self).last_mask = np.asarray(batch["mask"])
+        tags = batch["video"][:, 0, 0, 0, 0]  # per-row clip tag
+        return np.stack([tags + 0.0, tags + 100.0], axis=1)
+
+
+def _clip(tag: float) -> dict:
+    v = np.zeros((2, 4, 4, 3), np.float32)
+    v[0, 0, 0, 0] = tag
+    return {"video": v}
+
+
+def test_batcher_pads_masks_and_never_leaks_padded_rows():
+    stats = ServingStats()
+    # 200 ms window: all three near-instant submits land in ONE collection
+    b = MicroBatcher(_FakeEngine(), max_wait_ms=200.0, max_queue=16,
+                     stats=stats)
+    try:
+        futs = [b.submit(_clip(float(t))) for t in (7, 8, 9)]
+        out = [f.result(timeout=10) for f in futs]
+    finally:
+        b.close()
+    # each response is its own row — and only 3 responses exist for 4 rows
+    for t, logits in zip((7, 8, 9), out):
+        np.testing.assert_allclose(logits, [t, t + 100.0])
+    np.testing.assert_array_equal(_FakeEngine.last_mask, [1, 1, 1, 0])
+    snap = stats.snapshot()
+    assert snap["requests"] == 3.0
+    assert snap["batch_fill_ratio"] == pytest.approx(3 / 4)
+    assert snap["p50_ms"] > 0.0
+
+
+def test_batcher_queue_full_rejects_and_close_fails_pending():
+    release = threading.Event()
+
+    class Slow(_FakeEngine):
+        def predict(self, batch):
+            release.wait(10.0)
+            return super().predict(batch)
+
+    stats = ServingStats()
+    b = MicroBatcher(Slow(), max_wait_ms=0.0, max_queue=2, stats=stats)
+    try:
+        first = b.submit(_clip(1.0))
+        time.sleep(0.2)  # flush thread picks it up and blocks in predict
+        b.submit(_clip(2.0))
+        b.submit(_clip(3.0))
+        with pytest.raises(QueueFullError):
+            b.submit(_clip(4.0))
+        assert stats.snapshot()["rejected"] == 1.0
+        release.set()
+        assert first.result(timeout=10) is not None
+    finally:
+        release.set()
+        b.close()
+    with pytest.raises(RuntimeError):
+        b.submit(_clip(5.0))
+
+
+def test_batcher_rejects_malformed_requests():
+    b = MicroBatcher(_FakeEngine(), max_wait_ms=0.0)
+    try:
+        with pytest.raises(ValueError, match="video"):
+            b.submit({"label": np.zeros((1,), np.int32)})
+        with pytest.raises(ValueError, match="shape"):
+            b.submit({"video": np.zeros((4, 4, 3), np.float32)})
+    finally:
+        b.close()
+
+
+def test_batcher_groups_mixed_geometries_separately():
+    """Requests with different view counts can't share a forward: each
+    shape group gets its own padded launch, none are dropped."""
+
+    class ShapeAware(_FakeEngine):
+        def predict(self, batch):
+            type(self).last_mask = np.asarray(batch["mask"])
+            tags = batch["video"].reshape(batch["video"].shape[0], -1)[:, 0]
+            return np.stack([tags, tags + 100.0], axis=1)
+
+    b = MicroBatcher(ShapeAware(), max_wait_ms=200.0, max_queue=16)
+    try:
+        single = _clip(1.0)
+        multi = {"video": np.zeros((2, 2, 4, 4, 3), np.float32)}
+        multi["video"][0, 0, 0, 0, 0] = 2.0
+        f1 = b.submit(single)
+        f2 = b.submit(multi)
+        np.testing.assert_allclose(f1.result(timeout=10), [1.0, 101.0])
+        np.testing.assert_allclose(f2.result(timeout=10), [2.0, 102.0])
+    finally:
+        b.close()
+
+
+# --- export artifact + engine on the CPU mesh ------------------------------
+
+
+def _train_cfg(tmp_path, **over):
+    cfg = TrainConfig(
+        model=ModelConfig(name="tiny3d", num_classes=4, dropout_rate=0.0),
+        data=DataConfig(synthetic=True, synthetic_num_videos=16,
+                        num_frames=4, crop_size=32, min_short_side_scale=32,
+                        max_short_side_scale=40, batch_size=1, num_workers=2,
+                        eval_num_clips=2),
+        optim=OptimConfig(num_epochs=1, lr=0.01, weight_decay=0.0,
+                          ema_decay=0.9),
+        checkpoint=CheckpointConfig(output_dir=str(tmp_path),
+                                    checkpointing_steps="epoch",
+                                    async_checkpoint=False),
+    )
+    for k, v in over.items():
+        parts = k.split(".")
+        obj = cfg
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        setattr(obj, parts[-1], v)
+    return cfg
+
+
+def test_checkpoint_to_endpoint_end_to_end(tmp_path):
+    """The acceptance path: train a tiny model, export_inference, run the
+    engine in-process behind the batcher under concurrent requests, and
+    assert (a) predictions equal evaluate()'s view-averaged logits,
+    (b) padded rows never leak, (c) stats report non-zero p50/p99 and
+    batch-fill ratio. Also the export round trip: artifact-loaded logits
+    match the full-checkpoint restore's."""
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    cfg = _train_cfg(tmp_path)
+    tr = Trainer(cfg)
+    tr.fit()
+    art = tr.export_inference(str(tmp_path / "artifact"))
+
+    # 4 val videos, each a (2, T, H, W, C) two-view clip
+    n_videos = len(tr.val_source)
+    samples = [tr.val_source.get(i, 0) for i in range(n_videos)]
+    labels = np.asarray([int(s["label"]) for s in samples])
+    views = np.stack([s["video"] for s in samples])  # (N, 2, T, H, W, C)
+
+    # independent reference for the view-averaging protocol: per-view
+    # forward over the EMA weights (what evaluate() scores), fp32 mean
+    @jax.jit
+    def fwd(v):
+        return tr.model.apply(
+            {"params": tr.state.ema_params,
+             "batch_stats": tr.state.batch_stats}, v, train=False)
+
+    ref = np.stack([np.asarray(fwd(views[:, v]), np.float32)
+                    for v in range(views.shape[1])], axis=1).mean(axis=1)
+
+    stats = ServingStats()
+    engine = InferenceEngine.from_artifact(art, stats=stats)
+    assert engine.num_classes == 4 and engine.model_name == "tiny3d"
+    # 8-device CPU mesh -> every bucket is a multiple of the shard count
+    assert all(b % engine.shards == 0 for b in engine.buckets)
+    batcher = MicroBatcher(engine, max_wait_ms=50.0, stats=stats)
+    stats.queue_depth_fn = batcher.queue_depth
+    try:
+        with ThreadPoolExecutor(max_workers=n_videos) as pool:
+            futs = [pool.submit(
+                lambda c: batcher.submit({"video": c}).result(timeout=300),
+                samples[i]["video"]) for i in range(n_videos)]
+            logits = np.stack([f.result(timeout=300) for f in futs])
+    finally:
+        batcher.close()
+
+    # (a) serving logits == the eval protocol's view-averaged logits,
+    # row-matched per request (which also proves (b): the padded rows of
+    # the 8-bucket never surfaced in any response)
+    np.testing.assert_allclose(logits, ref, atol=1e-5, rtol=1e-4)
+    np.testing.assert_array_equal(logits.argmax(-1), ref.argmax(-1))
+    assert logits.shape == (n_videos, 4)
+
+    # (c) stats: non-zero latency percentiles and fill ratio; the 4
+    # requests were padded into 8-row buckets
+    snap = stats.snapshot()
+    assert snap["p50_ms"] > 0.0 and snap["p99_ms"] > 0.0
+    assert 0.0 < snap["batch_fill_ratio"] <= 1.0
+    assert snap["requests"] == float(n_videos)
+    assert snap["compiled_buckets"] >= 1.0
+
+    # round trip vs the FULL checkpoint restore: evaluate() on a resumed
+    # trainer scores the same weights the artifact carries
+    cfg2 = _train_cfg(tmp_path,
+                      **{"checkpoint.resume_from_checkpoint": "auto"})
+    tr2 = Trainer(cfg2)
+    ev = tr2.evaluate()
+    engine_acc = float((logits.argmax(-1) == labels).mean())
+    assert engine_acc == pytest.approx(ev["val_accuracy"], abs=1e-9)
+
+    @jax.jit
+    def fwd2(v):
+        return tr2.model.apply(
+            {"params": tr2.state.ema_params,
+             "batch_stats": tr2.state.batch_stats}, v, train=False)
+
+    ref2 = np.stack([np.asarray(fwd2(views[:, v]), np.float32)
+                     for v in range(views.shape[1])], axis=1).mean(axis=1)
+    np.testing.assert_allclose(logits, ref2, atol=1e-5, rtol=1e-4)
+
+
+def test_export_inference_resolves_ema_and_drops_optimizer(tmp_path):
+    """The artifact carries the EMA weights (the ones evaluate() scores),
+    BN stats, and NO optimizer state; load_inference round-trips it."""
+    from pytorchvideo_accelerate_tpu.trainer.checkpoint import load_inference
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    cfg = _train_cfg(tmp_path, **{"checkpoint.checkpointing_steps": ""})
+    tr = Trainer(cfg)
+    tr.fit()
+    art = tr.export_inference(str(tmp_path / "art"))
+    params, batch_stats, meta = load_inference(art)
+    assert meta["ema_resolved"] is True
+    assert meta["num_classes"] == 4 and meta["model"] == "tiny3d"
+    assert meta["step"] == 2
+    # exported leaves == the EMA tree, not the raw params
+    for exp, ema in zip(jax.tree.leaves(params),
+                        jax.tree.leaves(tr.state.ema_params)):
+        np.testing.assert_array_equal(np.asarray(exp), np.asarray(ema))
+    assert jax.tree.leaves(batch_stats), "BN stats missing from artifact"
+    import os
+
+    assert set(os.listdir(art)) == {"weights.npz", "meta.json"}
+
+
+def test_export_without_ema_uses_raw_params(tmp_path):
+    from pytorchvideo_accelerate_tpu.trainer.checkpoint import load_inference
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    cfg = _train_cfg(tmp_path, **{"optim.ema_decay": 0.0,
+                                  "checkpoint.checkpointing_steps": "",
+                                  "data.limit_train_batches": 1})
+    tr = Trainer(cfg)
+    tr.fit()
+    art = tr.export_inference(str(tmp_path / "art"))
+    params, _, meta = load_inference(art)
+    assert meta["ema_resolved"] is False
+    for exp, live in zip(jax.tree.leaves(params),
+                         jax.tree.leaves(tr.state.params)):
+        np.testing.assert_array_equal(np.asarray(exp), np.asarray(live))
+
+
+def test_load_inference_rejects_non_artifacts(tmp_path):
+    from pytorchvideo_accelerate_tpu.trainer.checkpoint import load_inference
+
+    with pytest.raises(FileNotFoundError, match="not an inference artifact"):
+        load_inference(str(tmp_path))
+
+
+def test_run_main_export_inference_flag(tmp_path):
+    """--export_inference: the CLI checkpoint->artifact handoff (resume a
+    finished run, write the artifact, never train)."""
+    from pytorchvideo_accelerate_tpu.run import main as run_main
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    cfg = _train_cfg(tmp_path)
+    Trainer(cfg).fit()
+    art = str(tmp_path / "cli_art")
+    res = run_main([
+        "--cpu", "--synthetic", "--data.synthetic_num_videos", "16",
+        "--data.num_frames", "4", "--data.crop_size", "32",
+        "--data.min_short_side_scale", "32",
+        "--data.max_short_side_scale", "40",
+        "--data.batch_size", "1", "--data.num_workers", "2",
+        "--model.name", "tiny3d", "--model.num_classes", "4",
+        "--optim.ema_decay", "0.9",
+        "--checkpoint.output_dir", str(tmp_path),
+        "--resume_from_checkpoint", "auto",
+        "--export_inference", art,
+    ])
+    assert res == {"exported": art}
+    engine = InferenceEngine.from_artifact(art)
+    assert engine.model_name == "tiny3d"

@@ -1,0 +1,132 @@
+"""HTTP endpoint round-trips (serving/server.py): real sockets, so the
+whole module is `slow`-marked — the tier-1 fast lane (-m 'not slow') covers
+the same engine/batcher machinery in-process via test_zserving.py."""
+
+import json
+import urllib.error
+import urllib.request
+
+import jax
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.config import ModelConfig
+from pytorchvideo_accelerate_tpu.models import create_model
+from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+from pytorchvideo_accelerate_tpu.serving import (
+    InferenceEngine,
+    MicroBatcher,
+    ServingStats,
+)
+from pytorchvideo_accelerate_tpu.serving.server import InferenceServer
+
+pytestmark = pytest.mark.slow
+
+FRAMES, CROP, CLASSES = 4, 16, 5
+
+
+@pytest.fixture()
+def server():
+    mcfg = ModelConfig(name="tiny3d", num_classes=CLASSES, dropout_rate=0.0)
+    model = create_model(mcfg, "bf16")
+    variables = model.init(
+        jax.random.key(0), np.zeros((1, FRAMES, CROP, CROP, 3), np.float32))
+    mesh = make_mesh()
+    stats = ServingStats()
+    engine = InferenceEngine(
+        model, variables["params"], variables.get("batch_stats", {}), mesh,
+        num_classes=CLASSES, max_batch_size=8, model_name="tiny3d",
+        stats=stats)
+    batcher = MicroBatcher(engine, max_wait_ms=2.0, stats=stats)
+    stats.queue_depth_fn = batcher.queue_depth
+    srv = InferenceServer(engine, batcher, stats, host="127.0.0.1", port=0,
+                          request_timeout_s=120.0).start()
+    try:
+        yield srv
+    finally:
+        srv.close()
+
+
+def _get(srv, path):
+    host, port = srv.address
+    with urllib.request.urlopen(f"http://{host}:{port}{path}", timeout=30) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post(srv, path, payload):
+    host, port = srv.address
+    req = urllib.request.Request(
+        f"http://{host}:{port}{path}",
+        data=json.dumps(payload).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=300) as r:
+        return r.status, json.loads(r.read())
+
+
+def test_healthz_predict_stats_round_trip(server):
+    code, health = _get(server, "/healthz")
+    assert code == 200
+    assert health["status"] == "ok" and health["model"] == "tiny3d"
+    assert health["num_classes"] == CLASSES
+
+    rng = np.random.default_rng(0)
+    clip = rng.standard_normal((FRAMES, CROP, CROP, 3)).astype(np.float32)
+    code, out = _post(server, "/predict", {"video": clip.tolist()})
+    assert code == 200
+    logits = np.asarray(out["logits"], np.float32)
+    assert logits.shape == (CLASSES,)
+    assert out["top1"] == int(logits.argmax())
+    assert out["latency_ms"] > 0.0
+
+    # the endpoint returns the engine's own logits for that clip
+    direct = server.engine.predict(
+        {"video": np.broadcast_to(
+            clip, (server.engine.buckets[0],) + clip.shape).copy()})[0]
+    np.testing.assert_allclose(logits, direct, atol=1e-5)
+
+    code, stats = _get(server, "/stats")
+    assert code == 200
+    assert stats["requests"] >= 1.0
+    assert stats["p50_ms"] > 0.0 and stats["p99_ms"] > 0.0
+    assert 0.0 < stats["batch_fill_ratio"] <= 1.0
+    assert "queue_depth" in stats
+
+
+def test_predict_rejects_bad_bodies(server):
+    host, port = server.address
+    req = urllib.request.Request(
+        f"http://{host}:{port}/predict", data=b"{not json",
+        headers={"Content-Type": "application/json"})
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 400
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server, "/predict", {"label": 3})
+    assert ei.value.code == 400
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server, "/predict", {"video": [[1.0, 2.0]]})  # bad rank
+    assert ei.value.code == 400
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _get(server, "/nope")
+    assert ei.value.code == 404
+
+
+def test_predict_rejects_off_spec_geometry(server):
+    """With an expected clip spec, off-geometry requests are 400-rejected
+    up front — every new shape would otherwise cost a synchronous compile
+    on the batch thread."""
+    server.expected_spec = {"video": (1, FRAMES, CROP, CROP, 3)}
+    wrong = np.zeros((FRAMES, CROP // 2, CROP // 2, 3), np.float32)
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server, "/predict", {"video": wrong.tolist()})
+    assert ei.value.code == 400
+    assert "geometry" in ei.value.read().decode()
+    # the served geometry (with or without a view axis) still passes
+    ok = np.zeros((2, FRAMES, CROP, CROP, 3), np.float32)
+    code, out = _post(server, "/predict", {"video": ok.tolist()})
+    assert code == 200 and len(out["logits"]) == CLASSES
+    code, health = _get(server, "/healthz")
+    assert health["clip_spec"] == {"video": [FRAMES, CROP, CROP, 3]}
