@@ -262,10 +262,12 @@ def bench_trainer(args) -> dict:
     )
     tr = Trainer(cfg)
     res = tr.fit()
-    # perf-dict contract: the device-prefetch observability keys must be
-    # present (the smoke run doubles as the CI check that the input-wait
-    # instrumentation didn't silently fall out of fit())
-    for key in ("input_wait_frac", "steps_per_sec"):
+    # perf-dict contract: the span-sourced obs keys (obs/ telemetry spine,
+    # default-on) and the legacy prefetch keys must be present — the smoke
+    # run doubles as the CI check that neither instrumentation silently
+    # fell out of fit()
+    for key in ("input_wait_frac", "steps_per_sec", "obs_step_s",
+                "obs_input_wait_frac", "obs_h2d_s"):
         assert key in res, f"fit() perf dict missing {key!r}: {sorted(res)}"
     # steady-state: train-section wall time of the post-compile epoch only
     # (excludes compile, eval, checkpointing — the quantity the raw-step
@@ -279,6 +281,9 @@ def bench_trainer(args) -> dict:
         f"input_wait_frac {res['input_wait_frac']:.3f}")
     return {"trainer_cps_chip": cps_chip,
             "input_wait_frac": res["input_wait_frac"],
+            "obs_step_s": res["obs_step_s"],
+            "obs_input_wait_frac": res["obs_input_wait_frac"],
+            "obs_h2d_s": res["obs_h2d_s"],
             "mfu": res.get("mfu"), "smoke": bool(args.smoke)}
 
 
@@ -745,6 +750,12 @@ def main():
                     tr["input_wait_frac"], 4)
             if tr.get("mfu") is not None:
                 extras["trainer_mfu"] = round(tr["mfu"], 4)
+            # registry-sourced step-time breakdown (obs/): per-step wall
+            # time, input-blocked fraction, and H2D copy time — the
+            # telemetry-spine successors of the ad-hoc perf dict
+            for key in ("obs_step_s", "obs_input_wait_frac", "obs_h2d_s"):
+                if tr.get(key) is not None:
+                    extras[key] = round(tr[key], 6)
             raw = (results.get("slowfast_r50") or {}).get(
                 "clips_per_sec_per_chip")
             # only a same-mode comparison is meaningful
@@ -813,6 +824,17 @@ def main():
         flush_partial()
 
     headline = finalize(results, extras, user_smoke)
+    if user_smoke and args.trainer:
+        # CI contract (same spirit as the serving lane below): the obs
+        # step-time breakdown must come out of the trainer lane. Asserted
+        # on extras, not the headline — finalize() may legitimately shed
+        # these keys to fit the driver's line budget, and a successful run
+        # must not fail over size shedding (test_bench_contract covers the
+        # passthrough itself).
+        for key in ("obs_step_s", "obs_input_wait_frac", "obs_h2d_s"):
+            assert key in extras, (
+                f"trainer smoke ran but produced no {key!r}: "
+                f"{extras.get('trainer_error') or sorted(extras)}")
     if user_smoke and args.serve_smoke:
         # smoke mode doubles as the CI check that the serving lane's
         # headline keys didn't silently fall out (same contract as the
@@ -948,7 +970,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         "detail": "bench_partial.json",
     }
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
-                "trainer_input_wait_frac"):
+                "trainer_input_wait_frac", "obs_step_s",
+                "obs_input_wait_frac", "obs_h2d_s"):
         if key in extras:
             out[key] = extras[key]
     # serving lane: request-latency percentiles + batcher fill ratio
@@ -997,7 +1020,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
             if k in out:
                 out[k] = out[k][:120]
     for k in ("probes", "serve_error", "serve_fill_ratio", "serve_p99_ms",
-              "serve_p50_ms", "trainer_error", "trainer_input_wait_frac",
+              "serve_p50_ms", "obs_h2d_s", "obs_input_wait_frac",
+              "obs_step_s", "trainer_error", "trainer_input_wait_frac",
               "trainer_mfu", "trainer_cps_chip",
               "trainer_vs_rawstep", "detail", "step_ms_blocked",
               "tflops_per_sec", "models"):  # drop one by one until it fits

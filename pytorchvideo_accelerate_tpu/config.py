@@ -212,6 +212,26 @@ class ServeConfig:
 
 
 @dataclass
+class ObsConfig:
+    """Telemetry spine (obs/): spans, flight recorder, watchdog, registry.
+
+    `enabled` gates the whole layer: spans become no-ops, the compiled
+    train step drops its health gauges, and the logged metric keys revert
+    exactly to the pre-obs set. The watchdog is opt-in on top (a
+    no-progress deadline only the operator can pick); see
+    docs/OBSERVABILITY.md for the runbook."""
+
+    enabled: bool = True
+    # no-progress deadline (seconds) before the watchdog dumps all-thread
+    # stacks + the flight record to stderr/output_dir — evidence BEFORE an
+    # external timeout kills the process blind. 0 = watchdog off.
+    watchdog_timeout_s: float = 0.0
+    # bounded in-memory event ring (spans/metrics/warnings) dumped to
+    # <output_dir>/flight_record.json on exception, SIGTERM, or stall
+    flight_recorder_events: int = 512
+
+
+@dataclass
 class TrackingConfig:
     """Metric logging (reference `run.py:227-231, 267-274, 306-315`)."""
 
@@ -232,6 +252,7 @@ class TrainConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    obs: ObsConfig = field(default_factory=ObsConfig)
 
     seed: int = 42  # run.py:138 set_seed(42); run.py:355 exposes --seed
     # write a params-only (EMA-resolved) serving artifact to this path and

@@ -49,6 +49,7 @@ import threading
 import time
 from typing import Any, Iterator, Optional
 
+from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader
 from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
 
@@ -70,6 +71,10 @@ class DevicePrefetcher:
         mesh: Any,
         depth: int = 2,
         micro_dim: bool = False,
+        watchdog: Optional[Any] = None,
+        watchdog_name: str = "prefetch",
+        wait_name: str = "input_wait",
+        h2d_name: str = "h2d",
     ):
         if depth < 0:
             raise ValueError(f"device prefetch depth must be >= 0, got {depth}")
@@ -78,6 +83,18 @@ class DevicePrefetcher:
         self.depth = depth
         self.micro_dim = micro_dim
         self.wait_s = 0.0  # consumer time blocked on the next device batch
+        # telemetry spine (obs/): the consumer wait doubles as the
+        # `wait_name` span ("input_wait" train / "eval_input_wait" val — the
+        # latter nests inside the "eval" span, so it is background-classed
+        # to keep window sums single-counted); worker-side placement is the
+        # `h2d_name` span ("h2d" train / "eval_h2d" val, kept apart so the
+        # per-train-step obs_h2d_s never counts eval placements); the
+        # worker pings the watchdog per placed batch and deregisters when
+        # the epoch generator closes (idle != stalled).
+        self.watchdog = watchdog
+        self.watchdog_name = watchdog_name
+        self.wait_name = wait_name
+        self.h2d_name = h2d_name
         self._lock = threading.Lock()
         self._resident = 0  # placed-but-unconsumed device batches
         self.max_resident = 0  # high-water mark (tests; monotonic per run)
@@ -93,7 +110,8 @@ class DevicePrefetcher:
     # --- placement --------------------------------------------------------
 
     def _place(self, batch: dict) -> Any:
-        return shard_batch(self.mesh, batch, micro_dim=self.micro_dim)
+        with obs.span(self.h2d_name):
+            return shard_batch(self.mesh, batch, micro_dim=self.micro_dim)
 
     # --- iteration --------------------------------------------------------
 
@@ -119,7 +137,9 @@ class DevicePrefetcher:
             while True:
                 t0 = time.perf_counter()
                 kind, payload, state = q.get()
-                self.wait_s += time.perf_counter() - t0
+                dt = time.perf_counter() - t0
+                self.wait_s += dt
+                obs.observe(self.wait_name, dt)
                 if kind == "batch":
                     with self._lock:
                         self._resident -= 1
@@ -150,15 +170,25 @@ class DevicePrefetcher:
         metric keeps its meaning — time the step loop spends blocked getting
         the next batch onto the device — so input_wait_frac stays comparable
         across modes."""
-        for batch, state in self.loader.epoch_items(epoch, from_start):
-            if batch is None:
+        try:
+            for batch, state in self.loader.epoch_items(epoch, from_start):
+                if batch is None:
+                    self.loader.state = state
+                    continue
+                t0 = time.perf_counter()
+                placed = self._place(batch)
+                dt = time.perf_counter() - t0
+                self.wait_s += dt
+                obs.observe(self.wait_name, dt)
+                if self.watchdog is not None:
+                    self.watchdog.heartbeat(self.watchdog_name)
                 self.loader.state = state
-                continue
-            t0 = time.perf_counter()
-            placed = self._place(batch)
-            self.wait_s += time.perf_counter() - t0
-            self.loader.state = state
-            yield placed
+                yield placed
+        finally:
+            # mirror the threaded worker: a finished epoch is idle, not
+            # stalled — a stale beat would false-fire every inter-epoch gap
+            if self.watchdog is not None:
+                self.watchdog.clear(self.watchdog_name)
 
     def _worker(self, items: Iterator[tuple], q: "queue.Queue[tuple]",
                 stop: threading.Event, slots: threading.Semaphore) -> None:
@@ -171,6 +201,8 @@ class DevicePrefetcher:
         executing"."""
         try:
             for batch, state in items:
+                if self.watchdog is not None:
+                    self.watchdog.heartbeat(self.watchdog_name)
                 if batch is None:  # exhaustion marker: no slot, no placement
                     q.put(("state", None, state))
                     continue
@@ -191,4 +223,8 @@ class DevicePrefetcher:
         else:
             q.put(("done", None, None))
         finally:
+            # a finished/closed worker is idle, not stalled — stop the
+            # watchdog from treating its silence as a hang
+            if self.watchdog is not None:
+                self.watchdog.clear(self.watchdog_name)
             items.close()

@@ -35,6 +35,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.data import decode as decode_mod
 from pytorchvideo_accelerate_tpu.data.manifest import Manifest
 from pytorchvideo_accelerate_tpu.data.samplers import random_clip, uniform_clips
@@ -424,11 +425,16 @@ class ClipLoader:
                 epoch, start_state.position, indices, n_batches)
             return
 
+        def fetch_one(i) -> Dict[str, np.ndarray]:
+            # obs "decode" span: per-sample decode+transform wall time on
+            # the worker threads (background-classed — it overlaps the
+            # consumer loop, so it informs, never sums into, window wall)
+            with obs.span("decode"):
+                return self.source.get(int(i), epoch)
+
         def fetch_batch(b: int) -> dict:
             chunk = indices[b * spy : (b + 1) * spy]
-            samples = list(
-                self._pool.map(lambda i: self.source.get(int(i), epoch), chunk)
-            )
+            samples = list(self._pool.map(fetch_one, chunk))
             return self._assemble(samples, spy)
 
         start = start_state.position

@@ -1,0 +1,144 @@
+"""Flight recorder: a bounded in-memory ring of recent telemetry events,
+dumped to `<output_dir>/flight_record.json` when the process dies.
+
+The black box for the four asynchronous layers (decode pool, device
+prefetcher, train loop, serving batcher): spans, sampled metrics, warnings,
+watchdog stalls and exceptions all append here cheaply (one deque append
+under a lock; the deque's maxlen bounds memory forever). Three dump paths:
+
+- **exception**: `Trainer.fit()` dumps explicitly on any raising epoch loop
+  (complementing the partial-profile flush in trainer/loop.py), and
+  `install()` chains `sys.excepthook` for crashes outside fit;
+- **SIGTERM**: `install()` chains a handler so an external kill (the tier-1
+  870s timeout's `timeout -k`) leaves evidence behind instead of dying
+  blind;
+- **watchdog**: `obs/watchdog.py` dumps when progress stalls, BEFORE any
+  external timeout fires.
+
+The dumped file is what `pva-tpu-doctor`'s obs snapshot reads from a second
+shell (utils/device_doctor.obs_snapshot) — the wedge's evidence file.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import sys
+import threading
+import time
+from collections import deque
+from typing import List, Optional
+
+_MIN_CAPACITY = 16
+
+
+class FlightRecorder:
+    """Thread-safe bounded event ring + crash-dump plumbing."""
+
+    def __init__(self, capacity: int = 512):
+        # RLock, not Lock: the SIGTERM handler runs ON the main thread and
+        # calls record()/dump() — if the signal interrupted that same
+        # thread inside record(), a plain lock would deadlock and the
+        # process would die to SIGKILL with no flight record (the exact
+        # failure this file exists to prevent)
+        self._lock = threading.RLock()
+        self._events: deque = deque(maxlen=max(capacity, _MIN_CAPACITY))
+        self._output_dir = ""
+        self._installed = False
+
+    # --- recording --------------------------------------------------------
+
+    def record(self, kind: str, name: str, **fields) -> None:
+        evt = {"ts": round(time.time(), 6),
+               "thread": threading.current_thread().name,
+               "kind": kind, "name": str(name)}
+        if fields:
+            evt.update(fields)
+        with self._lock:
+            self._events.append(evt)
+
+    def warn(self, message: str, **fields) -> None:
+        self.record("warning", message, **fields)
+
+    def set_capacity(self, capacity: int) -> None:
+        with self._lock:
+            self._events = deque(self._events,
+                                 maxlen=max(capacity, _MIN_CAPACITY))
+
+    def snapshot(self, last: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            events = list(self._events)
+        return events[-last:] if last else events
+
+    # --- dumping ----------------------------------------------------------
+
+    def default_path(self) -> Optional[str]:
+        if not self._output_dir:
+            return None
+        return os.path.join(self._output_dir, "flight_record.json")
+
+    def dump(self, path: Optional[str] = None) -> Optional[str]:
+        """Write the ring to `path` (default: the installed output dir).
+        Returns the written path, or None when there is nowhere to write or
+        the write failed — a dying process must not die twice over its own
+        black box."""
+        path = path or self.default_path()
+        if not path:
+            return None
+        payload = {"dumped_at": round(time.time(), 6), "pid": os.getpid(),
+                   "events": self.snapshot()}
+        try:
+            os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+            with open(path, "w") as f:
+                json.dump(payload, f, indent=1, default=str)
+        except OSError:
+            return None
+        return path
+
+    # --- crash hooks ------------------------------------------------------
+
+    def install(self, output_dir: str) -> None:
+        """Point dumps at `output_dir` and (once per process) chain
+        sys.excepthook + SIGTERM so an uncaught crash or an external kill
+        flushes the ring. Re-installs just update the output dir."""
+        if output_dir:
+            self._output_dir = output_dir
+        if self._installed:
+            return
+        self._installed = True
+
+        prev_hook = sys.excepthook
+
+        def hook(exc_type, exc, tb):
+            self.record("exception", exc_type.__name__,
+                        message=str(exc)[:500])
+            self.dump()
+            prev_hook(exc_type, exc, tb)
+
+        sys.excepthook = hook
+
+        try:
+            prev = signal.getsignal(signal.SIGTERM)
+
+            def on_term(signum, frame):
+                self.record("signal", "SIGTERM")
+                self.dump()
+                if callable(prev):
+                    prev(signum, frame)
+                elif prev is signal.SIG_IGN:
+                    return  # preserve an ignore disposition: dump, survive
+                else:  # default disposition: re-raise the default death
+                    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGTERM)
+
+            signal.signal(signal.SIGTERM, on_term)
+        except (ValueError, OSError):  # not the main thread: hooks only
+            pass
+
+
+_DEFAULT = FlightRecorder()
+
+
+def get_recorder() -> FlightRecorder:
+    return _DEFAULT

@@ -1,0 +1,262 @@
+"""Process-wide metric registry with Prometheus text exposition.
+
+The single source of numeric truth for counters/gauges/histograms across
+data -> train -> serve: the serving server's `/metrics` endpoint renders a
+`Registry` verbatim, `/stats` reads the SAME counter objects (so the two
+surfaces cannot drift), and the trainer publishes its on-device health
+gauges (grad/param norm, update ratio, non-finite-loss counter) here.
+
+Deliberately tiny and stdlib-only — no prometheus_client dependency (the
+container doesn't ship it), just the text exposition format v0.0.4 that
+every scraper parses:
+
+    # HELP name help text
+    # TYPE name counter
+    name{label="value"} 42
+    hist_bucket{le="0.05"} 3 ... hist_sum 0.2 / hist_count 9
+
+Thread-safety: one lock per metric; the registry itself locks only
+creation/lookup. `inc`/`set`/`observe` on the hot path are a dict update
+under a lock — nanoseconds against a network request or a train step.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample value: integers without a trailing .0, floats via
+    repr (full precision), special-cased non-finites."""
+    f = float(v)
+    if math.isnan(f):
+        return "NaN"
+    if math.isinf(f):
+        return "+Inf" if f > 0 else "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _escape_label(v: str) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r"\"").replace("\n", r"\n")
+
+
+def _label_str(names: Sequence[str], values: Sequence[str]) -> str:
+    if not names:
+        return ""
+    inner = ",".join(f'{n}="{_escape_label(v)}"'
+                     for n, v in zip(names, values))
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        self.name = name
+        self.help = help or name
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+
+    def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"metric {self.name!r} takes labels {self.labelnames}, "
+                f"got {tuple(labels)}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def header(self) -> str:
+        return (f"# HELP {self.name} {self.help}\n"
+                f"# TYPE {self.name} {self.kind}\n")
+
+    def render(self) -> str:  # pragma: no cover - interface
+        raise NotImplementedError
+
+
+class Counter(_Metric):
+    """Monotonic counter, optionally labeled (e.g. rejected{cause="503"})."""
+
+    kind = "counter"
+
+    def __init__(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+
+    def inc(self, amount: float = 1.0, **labels: str) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name!r} cannot decrease")
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._values.get(key, 0.0)
+
+    def total(self) -> float:
+        """Sum over every label combination (the `/stats` aggregate view)."""
+        with self._lock:
+            return sum(self._values.values()) if self._values else 0.0
+
+    def samples(self) -> Iterable[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            items = sorted(self._values.items())
+        for key, v in items:
+            yield dict(zip(self.labelnames, key)), v
+
+    def render(self) -> str:
+        with self._lock:
+            items = sorted(self._values.items())
+        if not items:
+            if self.labelnames:  # no label combination seen yet: header only
+                return self.header()
+            items = [((), 0.0)]  # unlabeled counters render an explicit 0
+        lines = [self.header()]
+        for key, v in items:
+            lines.append(
+                f"{self.name}{_label_str(self.labelnames, key)} {_fmt(v)}\n")
+        return "".join(lines)
+
+
+class Gauge(_Metric):
+    """Point-in-time value; `set_function` registers a live callback read at
+    render/value time (queue depth, uptime)."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help: str = ""):
+        super().__init__(name, help)
+        self._value = 0.0
+        self._fn: Optional[Callable[[], float]] = None
+
+    def set(self, value: float) -> None:
+        with self._lock:
+            self._value = float(value)
+
+    def set_function(self, fn: Callable[[], float]) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+            if fn is None:
+                return self._value
+        try:
+            return float(fn())
+        except Exception:  # a dying callback must not break the scrape
+            return float("nan")
+
+    def render(self) -> str:
+        return self.header() + f"{self.name} {_fmt(self.value())}\n"
+
+
+DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
+                   0.5, 1.0, 2.5, 5.0, 10.0)
+
+
+class Histogram(_Metric):
+    """Cumulative-bucket histogram (Prometheus convention: each `le` bucket
+    counts every observation <= its bound; `+Inf` == `_count`)."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+        super().__init__(name, help)
+        self.buckets = tuple(sorted(float(b) for b in buckets))
+        if not self.buckets:
+            raise ValueError("histogram needs at least one finite bucket")
+        self._counts = [0] * (len(self.buckets) + 1)  # + the +Inf bucket
+        self._sum = 0.0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = len(self.buckets)
+        for j, b in enumerate(self.buckets):
+            if v <= b:
+                i = j
+                break
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return sum(self._counts)
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def render(self) -> str:
+        with self._lock:
+            counts = list(self._counts)
+            total_sum = self._sum
+        lines = [self.header()]
+        cum = 0
+        for b, c in zip(self.buckets, counts):
+            cum += c
+            lines.append(
+                f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}\n')
+        cum += counts[-1]
+        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}\n')
+        lines.append(f"{self.name}_sum {_fmt(total_sum)}\n")
+        lines.append(f"{self.name}_count {cum}\n")
+        return "".join(lines)
+
+
+class Registry:
+    """Named metric store; `counter`/`gauge`/`histogram` are get-or-create
+    (a re-request returns the SAME object, so every surface that reads a
+    name reads the same numbers)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: Dict[str, _Metric] = {}
+
+    def _get_or_create(self, cls, name: str, *args, **kwargs):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = cls(name, *args, **kwargs)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}")
+            return m
+
+    def counter(self, name: str, help: str = "",
+                labelnames: Sequence[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labelnames)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets)
+
+    def get(self, name: str) -> Optional[_Metric]:
+        with self._lock:
+            return self._metrics.get(name)
+
+    def render(self) -> str:
+        """Prometheus text exposition v0.0.4 of every registered metric."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        return "".join(m.render() for m in metrics)
+
+
+_DEFAULT = Registry()
+
+
+def get_registry() -> Registry:
+    """The process-default registry (trainer health gauges live here)."""
+    return _DEFAULT

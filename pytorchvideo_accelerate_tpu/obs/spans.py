@@ -1,0 +1,179 @@
+"""Near-zero-overhead wall-time spans: `with span("decode"): ...`.
+
+The shared timing primitive of the telemetry spine. Every asynchronous
+layer (decode pool, device prefetcher, train loop, serving flush thread)
+wraps its blocking sections in named spans; the trainer drains the
+aggregated window every `log_every` steps into a per-step wall-time
+breakdown (`obs/input_wait_s`, `obs/h2d_s`, `obs/step_s`, ...) that flows
+through the TrackerHub, and each completed span is also appended to the
+flight recorder ring so a crash dump carries the recent timeline.
+
+Design constraints, in order:
+
+- **Overhead.** Disabled: `span()` returns a shared no-op context manager
+  (two attribute loads, no allocation). Enabled: two `perf_counter` calls
+  and one dict update under a lock — nanoseconds against a decode or a
+  train step; the <1%-of-step-time budget holds either way.
+- **Per-thread nesting.** Each thread keeps its own stack (threading.local)
+  so concurrent producers/consumers never interleave; `current_stacks()`
+  exposes every thread's open spans for the watchdog/doctor ("where is
+  everyone stuck RIGHT NOW").
+- **Consumer vs background attribution.** Spans recorded on worker threads
+  (`h2d`, `decode`, ...) overlap the step loop's wall time; summing them
+  with consumer-side spans would double-count. `BACKGROUND` names the
+  worker-side set so the per-window sum check uses consumer spans only.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+
+class _Noop:
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP = _Noop()
+
+
+class _Span:
+    __slots__ = ("_c", "name", "_t0")
+
+    def __init__(self, collector: "SpanCollector", name: str):
+        self._c = collector
+        self.name = name
+        self._t0 = 0.0
+
+    def __enter__(self):
+        self._c._push(self.name)
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        dt = time.perf_counter() - self._t0
+        self._c._pop(self.name)
+        self._c.observe(self.name, dt, error=exc_type is not None)
+        return False
+
+
+# span names recorded on worker threads: they run CONCURRENTLY with the
+# step loop, so the per-window "components sum to wall time" check must
+# exclude them (they are reported, just not summed)
+BACKGROUND = frozenset({"h2d", "decode", "serve_flush",
+                        "eval_input_wait", "eval_h2d"})
+
+# per-SAMPLE spans are too chatty for the flight ring: one big batch would
+# evict the step/warning/watchdog timeline a crash dump exists to preserve.
+# They still aggregate into the window (and the per-window breakdown).
+RECORDER_EXCLUDE = frozenset({"decode"})
+
+
+class SpanCollector:
+    """Thread-safe span aggregator: per-name (total_s, count) windows plus
+    per-thread open-span stacks."""
+
+    def __init__(self, enabled: bool = True, recorder=None):
+        self.enabled = enabled
+        self.recorder = recorder  # FlightRecorder or None
+        self._lock = threading.Lock()
+        self._window: Dict[str, list] = {}
+        self._tls = threading.local()
+        # thread ident -> (thread name, live stack list); stacks are the
+        # SAME list objects the threading.local holds, so reads see live
+        # nesting without any per-span registration cost
+        self._stacks: Dict[int, tuple] = {}
+
+    # --- recording --------------------------------------------------------
+
+    def span(self, name: str):
+        """Context manager timing a named section (no-op when disabled)."""
+        if not self.enabled:
+            return _NOOP
+        return _Span(self, name)
+
+    def observe(self, name: str, dur_s: float, error: bool = False) -> None:
+        """Record an externally-timed duration (the prefetcher measures its
+        queue wait once and feeds both its own wait_s and this window)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            entry = self._window.setdefault(name, [0.0, 0])
+            entry[0] += dur_s
+            entry[1] += 1
+        rec = self.recorder
+        if rec is not None and name not in RECORDER_EXCLUDE:
+            if error:
+                rec.record("span", name, dur_s=round(dur_s, 6), error=True)
+            else:
+                rec.record("span", name, dur_s=round(dur_s, 6))
+
+    # --- nesting stacks ---------------------------------------------------
+
+    def _stack(self) -> list:
+        st = getattr(self._tls, "stack", None)
+        if st is None:
+            st = self._tls.stack = []
+            t = threading.current_thread()
+            with self._lock:
+                if len(self._stacks) > 32:  # prune dead threads' leftovers
+                    alive = {th.ident for th in threading.enumerate()}
+                    for ident in [i for i, (_, s) in self._stacks.items()
+                                  if not s and i not in alive]:
+                        del self._stacks[ident]
+                self._stacks[t.ident] = (t.name, st)
+        return st
+
+    def _push(self, name: str) -> None:
+        self._stack().append(name)
+
+    def _pop(self, name: str) -> None:
+        st = self._stack()
+        if st and st[-1] == name:
+            st.pop()
+
+    def current_stacks(self) -> Dict[str, list]:
+        """{"thread_name-ident": [outer, ..., inner]} for every thread with
+        an open span — the "where is everyone" view for watchdog/doctor
+        dumps. Keys carry the ident because thread NAMES collide (both
+        prefetchers run a "device-prefetch" worker), and a stall dump must
+        never shadow the wedged thread's stack with a healthy namesake's."""
+        with self._lock:
+            return {f"{name}-{ident}": list(st)
+                    for ident, (name, st) in self._stacks.items() if st}
+
+    # --- draining ---------------------------------------------------------
+
+    def pop_window(self) -> Dict[str, Tuple[float, int]]:
+        """Drain and return {name: (total_s, count)} accumulated since the
+        last drain (the per-`log_every` breakdown window)."""
+        with self._lock:
+            window, self._window = self._window, {}
+        return {k: (v[0], v[1]) for k, v in window.items()}
+
+
+_DEFAULT = SpanCollector()
+
+
+def get_collector() -> SpanCollector:
+    return _DEFAULT
+
+
+def span(name: str):
+    """`with span("decode"): ...` against the process-default collector."""
+    return _DEFAULT.span(name)
+
+
+def observe(name: str, dur_s: float) -> None:
+    _DEFAULT.observe(name, dur_s)
+
+
+def current_stacks() -> Dict[str, list]:
+    return _DEFAULT.current_stacks()

@@ -1,0 +1,152 @@
+"""Heartbeat hang watchdog: dump evidence BEFORE the external kill.
+
+The failure mode this exists for (PROBES_r05.md, tier-1's 870s cap): a
+wedged PJRT handshake, a stuck H2D copy, or a deadlocked queue leaves the
+process silently idle until an external `timeout -k` kills it blind — no
+stack, no timeline, nothing to diagnose. Each asynchronous component (train
+loop, device prefetcher, serving batcher) pings `heartbeat(name)` whenever
+it makes progress; a daemon poll thread checks ages, and the FIRST
+component to exceed `timeout_s` triggers one stall dump:
+
+- all-thread Python stacks (sys._current_frames) to stderr,
+- the open span stacks (who was inside what when it froze),
+- the flight-recorder ring to `<output_dir>/flight_record.json`.
+
+The watchdog NEVER kills: it is a diagnoser, not an executioner — a false
+positive (a legitimately long compile) costs one noisy dump, nothing more.
+Per-component one-shot arming: a stalled name fires once, then re-arms on
+its next heartbeat, so a wedged-then-recovered component can report again
+while a permanently wedged one doesn't spam a dump per poll tick.
+Components that finish cleanly call `clear(name)` so an idle-but-healthy
+phase (between epochs, a drained prefetcher) is not a stall.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+import traceback
+from typing import Callable, List, Optional
+
+
+class Watchdog:
+    """No-progress detector over named heartbeats."""
+
+    def __init__(self, timeout_s: float, output_dir: str = "",
+                 recorder=None, collector=None,
+                 on_stall: Optional[Callable[[List[str]], None]] = None,
+                 poll_s: Optional[float] = None):
+        if timeout_s <= 0:
+            raise ValueError(f"watchdog timeout must be > 0, got {timeout_s}")
+        self.timeout_s = float(timeout_s)
+        self.output_dir = output_dir
+        self.recorder = recorder      # FlightRecorder or None
+        self.collector = collector    # SpanCollector or None (open spans)
+        self.on_stall = on_stall      # test/ops hook, called after the dump
+        self._poll_s = poll_s or min(max(self.timeout_s / 4.0, 0.02), 5.0)
+        self._lock = threading.Lock()
+        self._beats = {}   # name -> last monotonic heartbeat
+        self._fired = set()  # names already dumped for the current stall
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.stall_count = 0
+        self.last_stalled: List[str] = []
+
+    # --- component side ---------------------------------------------------
+
+    def heartbeat(self, name: str = "main") -> None:
+        """Progress ping; the first ping registers the component."""
+        with self._lock:
+            self._beats[name] = time.monotonic()
+            self._fired.discard(name)
+
+    def beat_fn(self, name: str) -> Callable[[], None]:
+        """Bound zero-arg pinger for components that take a plain callable."""
+        return lambda: self.heartbeat(name)
+
+    def clear(self, name: str) -> None:
+        """Deregister a component that finished cleanly (no longer expected
+        to make progress — not a stall)."""
+        with self._lock:
+            self._beats.pop(name, None)
+            self._fired.discard(name)
+
+    # --- lifecycle --------------------------------------------------------
+
+    def start(self) -> "Watchdog":
+        thread = self._thread
+        if thread is not None and thread.is_alive():
+            # already polling — or a stopped poller still draining a slow
+            # stall dump: never spawn a second one (duplicate dumps)
+            return self
+        self._stop.clear()  # a stopped watchdog can be restarted
+        self._thread = threading.Thread(
+            target=self._run, name="pva-watchdog", daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self._poll_s * 4 + 1.0)
+            if not thread.is_alive():
+                self._thread = None
+            # else: keep the handle so start() can see the straggler
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            self.check()
+
+    # --- detection --------------------------------------------------------
+
+    def check(self, now: Optional[float] = None) -> List[str]:
+        """One poll: returns (and dumps for) newly-stalled components.
+        Public so tests can drive detection deterministically."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            stalled = sorted(
+                name for name, t in self._beats.items()
+                if name not in self._fired and now - t > self.timeout_s)
+            self._fired.update(stalled)
+        if stalled:
+            self._fire(stalled)
+        return stalled
+
+    def _fire(self, stalled: List[str]) -> None:
+        self.stall_count += 1
+        self.last_stalled = list(stalled)
+        lines = [
+            f"[watchdog] NO PROGRESS from {', '.join(stalled)} for "
+            f"> {self.timeout_s:g}s — dumping all-thread stacks + flight "
+            "record before an external timeout kills the process blind",
+        ]
+        if self.collector is not None:
+            open_spans = self.collector.current_stacks()
+            if open_spans:
+                lines.append(f"[watchdog] open spans: {open_spans}")
+        names = {t.ident: t.name for t in threading.enumerate()}
+        for ident, frame in sys._current_frames().items():
+            lines.append(f"--- thread {names.get(ident, '?')} ({ident}) ---")
+            lines.append("".join(traceback.format_stack(frame)).rstrip())
+        print("\n".join(lines), file=sys.stderr, flush=True)
+        if self.recorder is not None:
+            self.recorder.record("watchdog", "stall", stalled=list(stalled),
+                                 timeout_s=self.timeout_s)
+            path = None
+            if self.output_dir:
+                import os
+
+                path = self.recorder.dump(
+                    os.path.join(self.output_dir, "flight_record.json"))
+            else:
+                path = self.recorder.dump()
+            if path:
+                print(f"[watchdog] flight record dumped to {path}",
+                      file=sys.stderr, flush=True)
+        if self.on_stall is not None:
+            try:
+                self.on_stall(list(stalled))
+            except Exception:  # the hook must not kill the poll thread
+                pass

@@ -28,6 +28,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, clip_key
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 
@@ -53,8 +54,13 @@ class MicroBatcher:
     """Bounded request queue + flush thread over an `InferenceEngine`."""
 
     def __init__(self, engine, *, max_batch_size: Optional[int] = None,
-                 max_wait_ms: float = 5.0, max_queue: int = 256, stats=None):
+                 max_wait_ms: float = 5.0, max_queue: int = 256, stats=None,
+                 heartbeat=None):
         self.engine = engine
+        # obs watchdog pinger: called once per flush-loop iteration (idle
+        # included), so a wedged flush thread — where EVERY request stalls
+        # — is detected; a closed batcher goes silent by design
+        self._heartbeat = heartbeat
         # collection cap: the largest compiled bucket (so a full collection
         # pads to fill ratio 1.0), optionally tightened by the caller
         top = engine.buckets[-1]
@@ -133,6 +139,8 @@ class MicroBatcher:
 
     def _loop(self) -> None:
         while not self._closed.is_set():
+            if self._heartbeat is not None:
+                self._heartbeat()
             try:
                 first = self._q.get(timeout=0.1)
             except queue.Empty:
@@ -153,7 +161,10 @@ class MicroBatcher:
                     self._closed.set()
                     break
                 batch.append(nxt)
-            self._flush(batch)
+            # "serve_flush" span: group + pad + forward + resolve — the
+            # whole accelerator-side path of the serving flush thread
+            with obs.span("serve_flush"):
+                self._flush(batch)
         # drain-on-close happens in close(); anything arriving after the
         # loop exits is failed there
 

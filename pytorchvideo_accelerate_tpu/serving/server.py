@@ -6,7 +6,12 @@ Endpoints:
                    responds {"logits": […], "top1": k, "latency_ms": x}.
   GET  /healthz  — liveness + model identity (load balancers poll this).
   GET  /stats    — ServingStats.snapshot(): p50/p95/p99 latency, queue
-                   depth, batch-fill ratio, throughput, compile count.
+                   depth, batch-fill ratio, throughput, compile count,
+                   uptime, rejected split by cause (400/503/504).
+  GET  /metrics  — Prometheus text exposition of the same registry the
+                   /stats counters read from (obs/registry.py): request/
+                   batch/rejection counters, latency histogram, queue
+                   depth + uptime gauges. Point a scraper here.
 
 Deliberately stdlib (`http.server.ThreadingHTTPServer`): zero new
 dependencies, and the concurrency story is honest — handler threads only
@@ -25,6 +30,7 @@ from typing import Optional, Sequence
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.serving.batcher import MicroBatcher, QueueFullError
 from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, InferenceEngine
 from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
@@ -67,6 +73,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, health)
         elif self.path == "/stats":
             self._reply(200, srv.stats.snapshot())
+        elif self.path == "/metrics":
+            body = srv.stats.registry.render().encode()
+            self.send_response(200)
+            self.send_header("Content-Type",
+                             "text/plain; version=0.0.4; charset=utf-8")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -85,25 +99,39 @@ class _Handler(BaseHTTPRequestHandler):
                     "body needs 'video' (or 'slow'+'fast') nested lists")
             srv.check_geometry(clip)
         except (ValueError, TypeError, KeyError) as e:
+            srv.stats.observe_rejected("400")
             self._reply(400, {"error": f"bad request: {e}"})
             return
         try:
             future = srv.batcher.submit(clip)
         except QueueFullError as e:
+            # the batcher already counted this one (cause "503")
             self._reply(503, {"error": str(e)})
             return
         except ValueError as e:
+            srv.stats.observe_rejected("400")
             self._reply(400, {"error": f"bad request: {e}"})
             return
         t0 = time.monotonic()
         try:
             logits = future.result(timeout=srv.request_timeout_s)
         except FutureTimeout:
-            future.cancel()
+            if future.cancel():
+                # shed before the engine touched it: a true rejection
+                srv.stats.observe_rejected("504")
+            else:
+                # lost the cancel race: the flush thread already claimed
+                # the request and will count it as completed — counting a
+                # 504 too would double-book it across the requests/
+                # rejected partition. Record the budget miss separately.
+                obs.get_recorder().warn(
+                    "504 after engine claim (request completed but client "
+                    "timed out)", budget_s=srv.request_timeout_s)
             self._reply(504, {
                 "error": f"request exceeded {srv.request_timeout_s}s budget"})
             return
         except Exception as e:  # noqa: BLE001 - batch failure surfaced per-request
+            srv.stats.observe_error()
             self._reply(500, {"error": f"inference failed: {e}"})
             return
         self._reply(200, {
@@ -119,12 +147,14 @@ class InferenceServer:
     def __init__(self, engine: InferenceEngine, batcher: MicroBatcher,
                  stats: ServingStats, host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float = 30.0,
-                 expected_spec: Optional[dict] = None):
+                 expected_spec: Optional[dict] = None,
+                 watchdog=None):
         import jax
 
         self.engine = engine
         self.batcher = batcher
         self.stats = stats
+        self.watchdog = watchdog  # obs.Watchdog over the flush thread
         self.request_timeout_s = request_timeout_s
         # clip-name -> (1, T, H, W, C) from the artifact's config (None =
         # accept any geometry; direct/bench construction)
@@ -187,11 +217,16 @@ class InferenceServer:
             self._thread.join(timeout=10.0)
             self._thread = None
         self.batcher.close()
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
 
 
 def build_server(cfg) -> InferenceServer:
     """serve.* config block -> a ready (not yet started) InferenceServer."""
     import jax
+
+    from pytorchvideo_accelerate_tpu import obs
 
     s = cfg.serve
     if not s.checkpoint:
@@ -200,6 +235,24 @@ def build_server(cfg) -> InferenceServer:
             "export_inference artifact (see docs/SERVING.md)")
     if cfg.cpu:
         jax.config.update("jax_platforms", "cpu")
+    # telemetry spine: same config block as training (obs.*); the watchdog
+    # covers the single flush thread — a wedged compile or stuck H2D there
+    # stalls EVERY request, and without a heartbeat it stalls silently
+    obs.configure(enabled=cfg.obs.enabled,
+                  capacity=cfg.obs.flight_recorder_events)
+    watchdog = None
+    if cfg.obs.enabled:
+        # flight-record destination + SIGTERM/excepthook dump hooks for the
+        # serving process too (checkpoint.output_dir defaults to "."): a
+        # killed or wedged server leaves the same evidence file a training
+        # run does (pva-tpu-doctor --obs-dir reads it)
+        obs.get_recorder().install(cfg.checkpoint.output_dir)
+        if cfg.obs.watchdog_timeout_s > 0:
+            watchdog = obs.Watchdog(
+                cfg.obs.watchdog_timeout_s,
+                output_dir=cfg.checkpoint.output_dir,
+                recorder=obs.get_recorder(),
+                collector=obs.get_collector()).start()
     stats = ServingStats(window=s.stats_window)
     engine = InferenceEngine.from_artifact(
         s.checkpoint, max_batch_size=s.max_batch_size, stats=stats)
@@ -220,11 +273,12 @@ def build_server(cfg) -> InferenceServer:
         engine.warmup(sample)
     batcher = MicroBatcher(
         engine, max_wait_ms=s.max_wait_ms, max_queue=s.max_queue,
-        stats=stats)
+        stats=stats,
+        heartbeat=(watchdog.beat_fn("serve_batcher") if watchdog else None))
     stats.queue_depth_fn = batcher.queue_depth
     return InferenceServer(engine, batcher, stats, host=s.host, port=s.port,
                            request_timeout_s=s.request_timeout_s,
-                           expected_spec=spec)
+                           expected_spec=spec, watchdog=watchdog)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:

@@ -3,9 +3,16 @@
 The serving-side sibling of the trainer's perf dict (input_wait_frac,
 steps_per_sec): `snapshot()` returns a flat {str: float} the trackers
 already know how to log (trainer/tracking.py TrackerHub.log) and the
-`/stats` endpoint returns verbatim. Everything is windowed (last N
-completed requests) so the numbers describe the *current* traffic, not the
-process lifetime; counters (requests/batches/compiles) are cumulative.
+`/stats` endpoint returns verbatim. Windowed values (percentiles,
+fill ratio, throughput) describe the *current* traffic over the last N
+completed requests.
+
+Counters and the latency histogram live in an `obs.registry.Registry`
+owned by this object: the `/metrics` Prometheus endpoint renders that
+registry and `/stats` reads the SAME counter objects, so the two surfaces
+cannot drift. Rejections are labeled by cause — "400" (bad request),
+"503" (queue full), "504" (request budget exceeded) — and `/stats`
+exposes both the aggregate (`rejected`) and the per-cause split.
 """
 
 from __future__ import annotations
@@ -14,6 +21,13 @@ import threading
 import time
 from collections import deque
 from typing import Callable, Dict, Optional, Sequence
+
+from pytorchvideo_accelerate_tpu.obs.registry import DEFAULT_BUCKETS, Registry
+
+# request latencies are enqueue -> response: sub-ms (cache-hot tiny model)
+# through multi-second (cold compile, deep queue) — the shared bounds plus
+# a 30s tail for the request_timeout_s budget region
+LATENCY_BUCKETS = DEFAULT_BUCKETS + (30.0,)
 
 
 def _percentile(sorted_vals: Sequence[float], q: float) -> float:
@@ -36,51 +50,94 @@ class ServingStats:
       max_wait_ms deadline is flushing underfilled batches;
     - throughput: completed requests/sec over the window span;
     - queue depth: live gauge read from the batcher at snapshot time;
-    - cumulative counters: requests, batches, rejected, compiles (new
-      (bucket, views) shapes hitting the engine's jit cache).
+    - cumulative counters (registry-backed, shared with `/metrics`):
+      requests, batches, rejected{cause}, errors, compiles.
     """
 
     def __init__(self, window: int = 1024,
-                 queue_depth_fn: Optional[Callable[[], int]] = None):
+                 queue_depth_fn: Optional[Callable[[], int]] = None,
+                 registry: Optional[Registry] = None):
         self._lock = threading.Lock()
         self._lat = deque(maxlen=max(window, 1))     # (done_ts, latency_s)
         self._fills = deque(maxlen=max(window, 1))   # (n_real, bucket)
         self.queue_depth_fn = queue_depth_fn
-        self.requests = 0
-        self.batches = 0
-        self.rejected = 0
-        self.compiles = 0
         self._started = time.monotonic()
+        # registry-backed counters/histogram: the single source of truth
+        # for BOTH /stats and the /metrics Prometheus rendering. A private
+        # Registry per ServingStats: multiple engines in one process (the
+        # bench) must not share counters, and the queue-depth/uptime gauge
+        # callbacks are per-instance (a shared registry would keep only the
+        # last instance's callback). Aggregate across servers at the
+        # scraper, not here.
+        self.registry = registry or Registry()
+        self._c_requests = self.registry.counter(
+            "pva_serving_requests_total", "requests completed successfully")
+        self._c_batches = self.registry.counter(
+            "pva_serving_batches_total", "batches launched on the engine")
+        self._c_rejected = self.registry.counter(
+            "pva_serving_rejected_total",
+            "requests rejected before completion, by HTTP cause",
+            labelnames=("cause",))
+        self._c_errors = self.registry.counter(
+            "pva_serving_errors_total",
+            "requests failed by an engine/batch error (HTTP 500)")
+        self._c_compiles = self.registry.counter(
+            "pva_serving_compiled_buckets_total",
+            "new (bucket, views) shapes compiled by the engine")
+        self._h_latency = self.registry.histogram(
+            "pva_serving_request_latency_seconds",
+            "enqueue-to-response latency of completed requests",
+            buckets=LATENCY_BUCKETS)
+        self.registry.gauge(
+            "pva_serving_queue_depth",
+            "requests queued but not yet batched").set_function(
+                lambda: float(self.queue_depth_fn())
+                if self.queue_depth_fn is not None else 0.0)
+        self.registry.gauge(
+            "pva_serving_uptime_seconds",
+            "seconds since this ServingStats was created").set_function(
+                lambda: time.monotonic() - self._started)
 
     def observe_batch(self, n_real: int, bucket: int,
                       latencies_s: Sequence[float]) -> None:
         now = time.monotonic()
+        self._c_requests.inc(len(latencies_s))
+        self._c_batches.inc()
+        for lat in latencies_s:
+            self._h_latency.observe(lat)
         with self._lock:
-            self.requests += len(latencies_s)
-            self.batches += 1
             self._fills.append((int(n_real), int(bucket)))
             for lat in latencies_s:
                 self._lat.append((now, float(lat)))
 
-    def observe_rejected(self, n: int = 1) -> None:
-        with self._lock:
-            self.rejected += n
+    def observe_rejected(self, cause: str = "503", n: int = 1) -> None:
+        """A request shed before completion; `cause` is the HTTP status the
+        caller saw: "400" bad request, "503" queue full, "504" budget."""
+        self._c_rejected.inc(n, cause=str(cause))
+
+    def observe_error(self, n: int = 1) -> None:
+        """A request failed by an engine/batch exception (HTTP 500)."""
+        self._c_errors.inc(n)
 
     def observe_compile(self) -> None:
-        with self._lock:
-            self.compiles += 1
+        self._c_compiles.inc()
 
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             lat = list(self._lat)
             fills = list(self._fills)
-            out: Dict[str, float] = {
-                "requests": float(self.requests),
-                "batches": float(self.batches),
-                "rejected": float(self.rejected),
-                "compiled_buckets": float(self.compiles),
-                "uptime_s": round(time.monotonic() - self._started, 3),
-            }
+        out: Dict[str, float] = {
+            "requests": self._c_requests.total(),
+            "batches": self._c_batches.total(),
+            "rejected": self._c_rejected.total(),
+            "errors": self._c_errors.total(),
+            "compiled_buckets": self._c_compiles.total(),
+            "uptime_s": round(time.monotonic() - self._started, 3),
+        }
+        # per-cause rejection split, sourced from the same labeled counter
+        # /metrics renders — the two surfaces cannot disagree
+        for cause in ("400", "503", "504"):
+            out[f"rejected_{cause}"] = self._c_rejected.value(cause=cause)
         vals = sorted(v for _, v in lat)
         out["p50_ms"] = round(_percentile(vals, 50) * 1e3, 3)
         out["p95_ms"] = round(_percentile(vals, 95) * 1e3, 3)

@@ -16,6 +16,7 @@ from typing import Dict, Optional
 import jax
 import numpy as np
 
+from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.config import TrainConfig
 from pytorchvideo_accelerate_tpu.data.manifest import from_list, scan_directory
 from pytorchvideo_accelerate_tpu.data.pipeline import (
@@ -84,6 +85,23 @@ class Trainer:
         self.checkpointing_steps = _parse_checkpointing_steps(
             cfg.checkpoint.checkpointing_steps
         )
+        # telemetry spine (obs/): configured FIRST so construction-time
+        # spans land in the window and the flight recorder catches
+        # init-time crashes; the watchdog (opt-in deadline) is created
+        # before the data stack so the prefetchers can ping it
+        self.obs_on = cfg.obs.enabled
+        obs.configure(enabled=cfg.obs.enabled,
+                      capacity=cfg.obs.flight_recorder_events)
+        self.watchdog: Optional[obs.Watchdog] = None
+        if self.obs_on:
+            obs.get_recorder().install(cfg.checkpoint.output_dir)
+            if cfg.obs.watchdog_timeout_s > 0:
+                self.watchdog = obs.Watchdog(
+                    cfg.obs.watchdog_timeout_s,
+                    output_dir=cfg.checkpoint.output_dir,
+                    recorder=obs.get_recorder(),
+                    collector=obs.get_collector(),
+                ).start()
         if cfg.cpu:
             jax.config.update("jax_platforms", "cpu")
         if cfg.device_init_timeout > 0 and not cfg.cpu:
@@ -295,9 +313,14 @@ class Trainer:
         self.train_prefetch = DevicePrefetcher(
             self.train_loader, self.mesh, depth=d.device_prefetch_depth,
             micro_dim=cfg.optim.gradient_accumulation_steps > 1,
+            watchdog=self.watchdog, watchdog_name="prefetch_train",
         )
+        # the val prefetcher's consumer wait nests inside the "eval" span,
+        # so it gets a background-classed name (no double count in sums)
         self.val_prefetch = DevicePrefetcher(
             self.val_loader, self.mesh, depth=d.device_prefetch_depth,
+            watchdog=self.watchdog, watchdog_name="prefetch_val",
+            wait_name="eval_input_wait", h2d_name="eval_h2d",
         )
 
     def _build_model_and_steps(self) -> None:
@@ -398,6 +421,7 @@ class Trainer:
                 lr_schedule=self.lr_schedule,
                 debug_asserts=cfg.debug_asserts,
                 ema_decay=cfg.optim.ema_decay,
+                health_metrics=self.obs_on,
             )
             self.eval_step = make_pretrain_eval_step(self.model, self.mesh)
         else:
@@ -411,6 +435,7 @@ class Trainer:
                 mixup_alpha=cfg.optim.mixup_alpha,
                 cutmix_alpha=cfg.optim.cutmix_alpha,
                 ema_decay=cfg.optim.ema_decay,
+                health_metrics=self.obs_on,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh,
@@ -512,6 +537,9 @@ class Trainer:
         if self.checkpointer is not None:
             self.checkpointer.close()
             self.checkpointer = None
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
         self.train_loader.close()
         self.val_loader.close()
 
@@ -520,6 +548,10 @@ class Trainer:
     def _save(self, kind: str, epoch: int) -> None:
         if self.checkpointer is None:
             return
+        with obs.span("ckpt"):
+            self._save_inner(kind, epoch)
+
+    def _save_inner(self, kind: str, epoch: int) -> None:
         self.checkpointer.save(
             int(self.state.step),
             self.state,
@@ -543,11 +575,14 @@ class Trainer:
         # limit_val_batches) must not make this one resume mid-epoch.
         # Batches arrive pre-placed on the mesh (device prefetch), so the
         # eval H2D transfers overlap eval compute the same way training's do.
-        for step_in_epoch, batch in enumerate(
-                self.val_prefetch.epoch(epoch, from_start=True)):
-            val.update(self.eval_step(self.state, batch))
-            if 0 <= self.cfg.data.limit_val_batches <= step_in_epoch + 1:
-                break
+        with obs.span("eval"):
+            for step_in_epoch, batch in enumerate(
+                    self.val_prefetch.epoch(epoch, from_start=True)):
+                if self.watchdog is not None:
+                    self.watchdog.heartbeat("train")
+                val.update(self.eval_step(self.state, batch))
+                if 0 <= self.cfg.data.limit_val_batches <= step_in_epoch + 1:
+                    break
         return val.accuracy(), val.accuracy_top5(), val.mean_loss()
 
     def evaluate(self) -> dict:
@@ -564,6 +599,9 @@ class Trainer:
                 "--model.pretrained_path: scoring freshly-initialized "
                 "random weights — the result is meaningless.")
         try:
+            if self.watchdog is not None:
+                self.watchdog.start()  # re-arm after a prior fit()
+                self.watchdog.heartbeat("train")
             self._maybe_resume()
             acc, acc5, loss = self._run_eval(epoch=0)
             if self.is_pretraining:
@@ -581,8 +619,41 @@ class Trainer:
                 self.trackers.finish()
             if self.checkpointer is not None:
                 self.checkpointer.close()
+            if self.watchdog is not None:
+                self.watchdog.stop()
             self.train_loader.close()
             self.val_loader.close()
+
+    def _obs_on_flush(self):
+        """DeferredStepLogger hook mirroring the logged step metrics into
+        the metric registry (obs/registry): grad/param-norm and
+        update-ratio gauges plus a non-finite-loss counter. Sampled at
+        log_every — a per-step host check would sync the async pipeline."""
+        if not self.obs_on:
+            return None
+        reg = obs.get_registry()
+        g_grad = reg.gauge("pva_train_grad_norm",
+                           "global gradient norm (sampled at log_every)")
+        g_param = reg.gauge("pva_train_param_norm",
+                            "global parameter norm (sampled at log_every)")
+        g_ratio = reg.gauge("pva_train_update_ratio",
+                            "update-norm / param-norm (sampled at log_every)")
+        c_nonfinite = reg.counter(
+            "pva_train_nonfinite_loss_total",
+            "non-finite loss values observed at log_every sampling")
+
+        def on_flush(vals: Dict[str, float], step: int) -> None:
+            if "grad_norm" in vals:
+                g_grad.set(vals["grad_norm"])
+            if "obs/param_norm" in vals:
+                g_param.set(vals["obs/param_norm"])
+            if "obs/update_ratio" in vals:
+                g_ratio.set(vals["obs/update_ratio"])
+            if vals.get("obs/nonfinite"):
+                c_nonfinite.inc()
+                obs.get_recorder().warn("non-finite loss", step=step)
+
+        return on_flush
 
     def fit(self) -> dict:
         cfg = self.cfg
@@ -615,7 +686,43 @@ class Trainer:
         # NEXT step has been dispatched, so logging never syncs the step
         # just dispatched (the old float(metrics["loss"]) blocked dispatch
         # at every log_every boundary)
-        deferred = DeferredStepLogger(self.trackers) if self.trackers else None
+        deferred = (DeferredStepLogger(self.trackers,
+                                       on_flush=self._obs_on_flush())
+                    if self.trackers else None)
+        # obs window accounting: the collector aggregates named spans; every
+        # log_every boundary drains them into a per-window step-time
+        # breakdown (obs/step_s, obs/input_wait_s, ...) logged through the
+        # trackers, and epoch_spans carries the epoch totals for the perf
+        # dict (obs_step_s / obs_input_wait_frac / obs_h2d_s — the numbers
+        # bench.py reports on its headline line)
+        collector = obs.get_collector() if self.obs_on else None
+        epoch_spans: Dict[str, float] = {}
+
+        def drain_spans(log_step=None, window_wall=None):
+            if collector is None:
+                return
+            window = collector.pop_window()
+            for name, (total, _count) in window.items():
+                epoch_spans[name] = epoch_spans.get(name, 0.0) + total
+            if log_step is None or not self.trackers or not window:
+                return
+            vals = {f"obs/{n}_s": t for n, (t, _c) in window.items()}
+            if window_wall is not None:
+                # consumer-side spans account the step loop's wall time;
+                # background spans (h2d/decode) overlap it on worker
+                # threads and are reported, not summed
+                consumer = sum(t for n, (t, _c) in window.items()
+                               if n not in obs.BACKGROUND_SPANS)
+                vals["obs/window_wall_s"] = window_wall
+                vals["obs/unattributed_s"] = window_wall - consumer
+            self.trackers.log(vals, step=log_step)
+
+        if collector is not None:
+            collector.pop_window()  # init/resume spans: not this window's
+        if self.watchdog is not None:
+            self.watchdog.start()  # re-arm after a prior fit/evaluate
+            self.watchdog.heartbeat("train")
+        window_t0 = time.perf_counter()
         try:
             for epoch in range(starting_epoch, cfg.optim.num_epochs):
                 if use_tqdm:
@@ -624,31 +731,48 @@ class Trainer:
                 t_epoch = time.time()
                 train_steps_this_epoch = 0
                 self.train_prefetch.pop_wait()  # epoch-scoped accounting
+                # discard inter-epoch spans (epoch-end ckpt save, teardown):
+                # they precede this epoch's first window and would otherwise
+                # surface as a negative obs/unattributed_s in it
+                drain_spans()
+                epoch_spans.clear()
+                window_t0 = time.perf_counter()
 
                 # batches arrive pre-placed on the mesh: the device prefetch
                 # thread overlaps the H2D copy of batch N+1 with compute of
                 # batch N, so steady-state steps never block on the host link
                 for step_in_epoch, global_batch in enumerate(
                         self.train_prefetch.epoch(epoch)):
+                    if self.watchdog is not None:
+                        self.watchdog.heartbeat("train")
                     if (cfg.profile and not profiling
                             and gstep - run_start_step == 2):
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling = True
-                    with jax.profiler.StepTraceAnnotation("train", step_num=gstep):
-                        self.state, metrics = self.train_step(
-                            self.state, global_batch, self.rng.step_key(gstep)
-                        )
+                    # "step" span = dispatch time; under async dispatch it
+                    # absorbs compute only when the dispatch queue pushes
+                    # back (or at compile), which is exactly the reading
+                    # that matters for the per-window breakdown
+                    with obs.span("step"):
+                        with jax.profiler.StepTraceAnnotation(
+                                "train", step_num=gstep):
+                            self.state, metrics = self.train_step(
+                                self.state, global_batch,
+                                self.rng.step_key(gstep)
+                            )
                     gstep += 1
                     train_steps_this_epoch += 1
                     if deferred is not None:
                         # previous boundary's metrics: their step has retired
                         # behind the one just dispatched, so this fetch
                         # doesn't stall the pipeline
-                        deferred.flush()
+                        with obs.span("log"):
+                            deferred.flush()
                     if self._flops_per_step is None:
                         # unconditional (not tracking-gated): fit()'s return
                         # dict and the bench harness both need FLOPs/step
-                        self._capture_step_flops(global_batch, gstep)
+                        with obs.span("compile_probe"):
+                            self._capture_step_flops(global_batch, gstep)
                     if profiling and gstep - run_start_step >= 6:
                         jax.profiler.stop_trace()
                         profiling = False
@@ -660,12 +784,21 @@ class Trainer:
                     # (MeanLoss.mean) or at the deferred log_every fetch
                     epoch_loss.update_async(metrics["loss"])
                     if deferred is not None and gstep % cfg.tracking.log_every == 0:
-                        deferred.defer(
-                            {"train_loss_step": metrics["loss"],
-                             "lr": metrics["lr"],
-                             "grad_norm": metrics["grad_norm"]},
-                            step=gstep,
-                        )
+                        vals = {"train_loss_step": metrics["loss"],
+                                "lr": metrics["lr"],
+                                "grad_norm": metrics["grad_norm"]}
+                        if self.obs_on:
+                            # on-device health gauges ride the same
+                            # deferred fetch (steps.py health_metrics)
+                            vals["obs/param_norm"] = metrics["param_norm"]
+                            vals["obs/update_ratio"] = metrics["update_ratio"]
+                            vals["obs/nonfinite"] = metrics["nonfinite"]
+                        deferred.defer(vals, step=gstep)
+                    if self.obs_on and gstep % cfg.tracking.log_every == 0:
+                        now = time.perf_counter()
+                        drain_spans(log_step=gstep,
+                                    window_wall=now - window_t0)
+                        window_t0 = now
                     if (isinstance(self.checkpointing_steps, int)
                             and gstep % self.checkpointing_steps == 0):
                         self._save("step", epoch)
@@ -676,18 +809,33 @@ class Trainer:
                     # value-fetch sync, never block_until_ready (acked
                     # early by forwarding backends — would end the epoch
                     # timer with work still queued; bench_setup.fetch_loss)
-                    fetch_loss(metrics)
+                    with obs.span("sync"):
+                        fetch_loss(metrics)
                 if deferred is not None:
-                    deferred.flush()
+                    with obs.span("log"):
+                        deferred.flush()
                 epoch_train_times.append(time.time() - t_epoch)
                 # time the step loop spent blocked waiting for the next
                 # device batch — the number that proves (or disproves) the
                 # transfer/compute overlap (input_wait_frac << 1)
                 train_wait_s = self.train_prefetch.pop_wait()
+                if self.obs_on:
+                    # close the train section's residual window before eval
+                    # so train windows stay pure (the sum-to-wall property
+                    # only holds for windows without overlapping eval spans)
+                    now = time.perf_counter()
+                    drain_spans(log_step=gstep, window_wall=now - window_t0)
+                    window_t0 = now
 
                 # Evaluation (reference run.py:287-304, in-graph metric sums)
                 last_val_acc, last_val_acc5, last_val_loss = \
                     self._run_eval(epoch)
+                if self.obs_on:
+                    # eval window: logged for the timeline (obs/eval_s), no
+                    # sum contract (eval nests its own input waits)
+                    now = time.perf_counter()
+                    drain_spans(log_step=gstep)
+                    window_t0 = now
                 last_train_loss = epoch_loss.mean()
                 val_str = (
                     f"val_recon_loss={last_val_loss:.4f}" if self.is_pretraining
@@ -720,6 +868,16 @@ class Trainer:
                         "input_wait_s": train_wait_s,
                         "input_wait_frac": min(train_wait_s / t_train, 1.0),
                     }
+                    if self.obs_on:
+                        # the generalized, span-sourced successors of PR 1's
+                        # one-off input_wait plumbing — the keys bench.py
+                        # reports on its headline line
+                        last_perf["obs_step_s"] = (
+                            epoch_spans.get("step", 0.0) / steps_done)
+                        last_perf["obs_input_wait_frac"] = min(
+                            epoch_spans.get("input_wait", 0.0) / t_train, 1.0)
+                        last_perf["obs_h2d_s"] = (
+                            epoch_spans.get("h2d", 0.0) / steps_done)
                     if self._flops_per_step:
                         from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
 
@@ -751,12 +909,25 @@ class Trainer:
                 if self.checkpointing_steps == "epoch":
                     self._save("epoch", epoch)
 
+        except BaseException as e:
+            # the flight recorder's whole purpose: the recent span/metric
+            # timeline survives the crash as <output_dir>/flight_record.json
+            # (complementing the partial-profile flush below)
+            if self.obs_on:
+                recorder = obs.get_recorder()
+                recorder.record("exception", type(e).__name__,
+                                message=str(e)[:500], step=gstep)
+                recorder.dump()  # install(output_dir) set the destination
+            raise
         finally:
             # flush a partial trace even when the run dies mid-window —
             # that trace is most valuable exactly when diagnosing a crash
             if profiling:
                 jax.profiler.stop_trace()
                 main_print(f"profile trace written to {cfg.profile_dir}")
+            if self.watchdog is not None:
+                self.watchdog.clear("train")
+                self.watchdog.stop()
 
         if self.trackers:
             self.trackers.finish()

@@ -137,6 +137,7 @@ def _make_update_step(
     with_accuracy: bool,
     debug_asserts: bool = False,
     ema_decay: float = 0.0,
+    health_metrics: bool = False,
 ) -> Callable:
     """Shared machinery of the supervised and self-supervised steps.
 
@@ -191,6 +192,20 @@ def _make_update_step(
             ema_params=new_ema,
         )
         metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        if health_metrics:
+            # training-health gauges computed IN-GRAPH (obs/: a few extra
+            # reductions XLA fuses into the update — cheap on device, and
+            # they ride the same async metrics fetch as loss/grad_norm):
+            # global param norm, update/param ratio (the "is the LR sane"
+            # signal — healthy runs sit around 1e-3, a spike means the
+            # update is rewriting the weights), and a non-finite-loss flag
+            # the host accumulates into a counter.
+            param_norm = optax.global_norm(new_params)
+            metrics["param_norm"] = param_norm
+            metrics["update_ratio"] = (
+                optax.global_norm(updates) / jnp.maximum(param_norm, 1e-12))
+            metrics["nonfinite"] = 1.0 - jnp.isfinite(loss).astype(
+                jnp.float32)
         if with_accuracy:
             metrics["accuracy"] = correct / jnp.maximum(count, 1.0)
         if lr_schedule is not None:
@@ -212,6 +227,7 @@ def make_train_step(
     mixup_alpha: float = 0.0,
     cutmix_alpha: float = 0.0,
     ema_decay: float = 0.0,
+    health_metrics: bool = False,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
     (state, metrics)` (see `_make_update_step`). `device_normalize`:
@@ -317,7 +333,8 @@ def make_train_step(
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
                              with_accuracy=True, debug_asserts=debug_asserts,
-                             ema_decay=ema_decay)
+                             ema_decay=ema_decay,
+                             health_metrics=health_metrics)
 
 
 def make_pretrain_step(
@@ -328,6 +345,7 @@ def make_pretrain_step(
     lr_schedule: Optional[Callable] = None,
     debug_asserts: bool = False,
     ema_decay: float = 0.0,
+    health_metrics: bool = False,
 ) -> Callable:
     """Build the VideoMAE self-supervised step: `step(state, batch, key) ->
     (state, metrics)`. No labels; batch_stats pass through unchanged (pure-LN
@@ -346,7 +364,8 @@ def make_pretrain_step(
     grad_fn = jax.value_and_grad(forward_loss, has_aux=True)
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
                              with_accuracy=False, debug_asserts=debug_asserts,
-                             ema_decay=ema_decay)
+                             ema_decay=ema_decay,
+                             health_metrics=health_metrics)
 
 
 def make_pretrain_eval_step(model, mesh) -> Callable:

@@ -146,22 +146,45 @@ def resolve_trackers(spec: str, logging_dir: str) -> List[Tracker]:
 
 class TrackerHub:
     """Fan-out facade: `init_trackers`/`log`/`end_training` equivalents
-    (reference run.py:231,274,323). Construct on the main process only."""
+    (reference run.py:231,274,323). Construct on the main process only.
+
+    Fan-out is NON-FATAL: a raising tracker (broken tensorboard install,
+    wandb network hiccup, full disk under the jsonl file) is warned about
+    once and disabled — a logging failure must never kill a training step.
+    The surviving trackers keep logging."""
 
     def __init__(self, spec: str, logging_dir: str):
         self.trackers = resolve_trackers(spec, logging_dir)
 
+    def _fanout(self, op: str, fn) -> None:
+        for t in list(self.trackers):
+            try:
+                fn(t)
+            except Exception as e:  # noqa: BLE001 - any tracker bug qualifies
+                logger.warning(
+                    "tracker %r raised in %s (%s: %s); disabling it — "
+                    "a logging failure must never kill a training step",
+                    t.name, op, type(e).__name__, e)
+                try:
+                    self.trackers.remove(t)
+                except ValueError:  # pragma: no cover - already gone
+                    pass
+                try:
+                    from pytorchvideo_accelerate_tpu.obs import get_recorder
+
+                    get_recorder().warn(f"tracker {t.name} disabled",
+                                        op=op, error=str(e)[:200])
+                except Exception:  # pragma: no cover - obs must stay optional
+                    pass
+
     def start(self, run_name: str, config: dict) -> None:
-        for t in self.trackers:
-            t.start(run_name, config)
+        self._fanout("start", lambda t: t.start(run_name, config))
 
     def log(self, values: Dict[str, float], step: int) -> None:
-        for t in self.trackers:
-            t.log(values, step)
+        self._fanout("log", lambda t: t.log(values, step))
 
     def finish(self) -> None:
-        for t in self.trackers:
-            t.finish()
+        self._fanout("finish", lambda t: t.finish())
 
 
 class DeferredStepLogger:
@@ -182,8 +205,12 @@ class DeferredStepLogger:
     `defer()` before `flush()` flushes the first (never silently drops it).
     """
 
-    def __init__(self, hub: TrackerHub):
+    def __init__(self, hub: TrackerHub, on_flush=None):
         self.hub = hub
+        # optional observer of the flushed floats (the obs layer mirrors
+        # grad/param-norm gauges + the non-finite counter into the metric
+        # registry here, off the dispatch critical path)
+        self.on_flush = on_flush
         self._pending: Optional[tuple] = None
 
     def defer(self, values: Dict[str, object], step: int) -> None:
@@ -205,4 +232,10 @@ class DeferredStepLogger:
             return
         values, step = self._pending
         self._pending = None
-        self.hub.log({k: float(v) for k, v in values.items()}, step=step)
+        floats = {k: float(v) for k, v in values.items()}
+        if self.on_flush is not None:
+            try:
+                self.on_flush(floats, step)
+            except Exception:  # observability must not kill the step loop
+                pass
+        self.hub.log(floats, step=step)

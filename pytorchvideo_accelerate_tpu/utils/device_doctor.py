@@ -230,14 +230,51 @@ def verbose_init_attempt(timeout_s: int = 120,
                     tail_bytes=tail_bytes)
 
 
+def obs_snapshot(output_dir: str = "", last: int = 30) -> dict:
+    """Telemetry snapshot for diagnosing a wedged run (obs/ spine):
+
+    - in-process: every thread's OPEN span stack (who is inside what right
+      now) + the last flight-recorder events — the live view when the
+      doctor runs inside the stuck process (the Trainer init guard path);
+    - cross-process: the tail of `<output_dir>/flight_record.json` — the
+      file the watchdog / excepthook / SIGTERM handler dumps, i.e. the
+      evidence a SECOND shell reads while (or after) the run is wedged:
+      `pva-tpu-doctor --obs-dir <output_dir> --skip-init`.
+    """
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu import obs
+
+        out["span_stacks"] = obs.current_stacks()
+        out["recent_events"] = obs.get_recorder().snapshot(last)
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    if output_dir:
+        path = os.path.join(output_dir, "flight_record.json")
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            out["flight_record_file"] = {
+                "path": path,
+                "dumped_at": data.get("dumped_at"),
+                "pid": data.get("pid"),
+                "events": data.get("events", [])[-last:],
+            }
+        except (OSError, ValueError) as e:
+            out["flight_record_file"] = {
+                "path": path, "error": f"{type(e).__name__}: {e}"}
+    return out
+
+
 def diagnose(timeout_s: int = 120, skip_init: bool = False,
-             variants: bool = False) -> dict:
+             variants: bool = False, obs_dir: str = "") -> dict:
     rec = {
         "probe": "diagnostics",
         "ts": _utcnow(),
         "env": env_snapshot(),
         "files": file_facts(),
         "loopback_listeners": loopback_listeners(),
+        "obs": obs_snapshot(obs_dir),
     }
     if not skip_init:
         rec["verbose_init"] = verbose_init_attempt(timeout_s)
@@ -263,11 +300,17 @@ def main(argv: Optional[list] = None) -> int:
     ap.add_argument("--variants", action="store_true",
                     help="also try alternative init paths (cpu-config "
                          "control, cpu-env, tpu-direct) to localize a hang")
+    ap.add_argument("--obs-dir", default="",
+                    help="training run's output_dir: include the tail of "
+                         "its dumped flight_record.json (watchdog/"
+                         "excepthook evidence) in the obs snapshot — the "
+                         "second-shell diagnosis path for a wedged run")
     ap.add_argument("--log", default="",
                     help="append the JSON record to this jsonl file")
     args = ap.parse_args(argv)
 
-    rec = diagnose(args.timeout, args.skip_init, args.variants)
+    rec = diagnose(args.timeout, args.skip_init, args.variants,
+                   obs_dir=args.obs_dir)
     print(json.dumps(rec, indent=1))
     if args.log:
         with open(args.log, "a") as f:

@@ -139,6 +139,8 @@ def test_finalize_line_fits_driver_capture():
         models[name + "__smoke_fallback"] = _model(name)[name]
     extras = {
         "trainer_vs_rawstep": 0.934, "trainer_mfu": 0.1234,
+        "obs_step_s": 0.012345, "obs_input_wait_frac": 0.0123,
+        "obs_h2d_s": 0.001234,
         "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
         "error": "watchdog fired: " + "y" * 3000,
         "probe_attempts": [
@@ -157,6 +159,18 @@ def test_finalize_line_fits_driver_capture():
     assert parsed["suspect"] is False
     # fallback/error variants are folded out of the compact models map
     assert set(parsed["models"]) == set(bench.WORKLOADS)
+
+
+def test_finalize_obs_keys_ride_the_headline():
+    """The telemetry-spine step-time breakdown (obs_step_s /
+    obs_input_wait_frac / obs_h2d_s, sourced from the span registry via
+    fit()'s perf dict) plumbs through finalize onto the headline line."""
+    extras = {"obs_step_s": 0.0123, "obs_input_wait_frac": 0.02,
+              "obs_h2d_s": 0.0011}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["obs_step_s"] == 0.0123
+    assert out["obs_input_wait_frac"] == 0.02
+    assert out["obs_h2d_s"] == 0.0011
 
 
 def test_finalize_serving_lane_keys():

@@ -48,10 +48,16 @@ def test_bench_trainer_smoke_propagates_input_wait(stubbed):
         "steps": 8, "epoch_train_times": [2.0, 1.0], "train_loss": 0.5,
         "steps_per_sec": 4.0, "clips_per_sec": 64.0,
         "input_wait_s": 0.02, "input_wait_frac": 0.02, "mfu": 0.1,
+        "obs_step_s": 0.25, "obs_input_wait_frac": 0.02,
+        "obs_h2d_s": 0.01,
     }
     res = stubbed.bench_trainer(argparse.Namespace(smoke=True))
     assert res["smoke"] is True
     assert res["input_wait_frac"] == 0.02
+    # the obs telemetry-spine keys ride along to the headline line
+    assert res["obs_step_s"] == 0.25
+    assert res["obs_input_wait_frac"] == 0.02
+    assert res["obs_h2d_s"] == 0.01
     assert res["trainer_cps_chip"] > 0.0
     # and the smoke geometry really was requested (CPU-sized shapes)
     assert _StubTrainer.last_cfg.data.crop_size == stubbed.SMOKE_TRAINER_SHAPE[1]
@@ -63,8 +69,18 @@ def test_bench_trainer_smoke_asserts_perf_keys(stubbed):
     _StubTrainer.result = {
         "steps": 8, "epoch_train_times": [2.0, 1.0], "train_loss": 0.5,
         "steps_per_sec": 4.0,  # input_wait_frac missing
+        "obs_step_s": 0.25, "obs_input_wait_frac": 0.02,
+        "obs_h2d_s": 0.01,
     }
     with pytest.raises(AssertionError, match="input_wait_frac"):
+        stubbed.bench_trainer(argparse.Namespace(smoke=True))
+    # same contract for the span-sourced keys (obs.enabled defaults true)
+    _StubTrainer.result = {
+        "steps": 8, "epoch_train_times": [2.0, 1.0], "train_loss": 0.5,
+        "steps_per_sec": 4.0, "input_wait_s": 0.02,
+        "input_wait_frac": 0.02,  # obs_step_s missing
+    }
+    with pytest.raises(AssertionError, match="obs_step_s"):
         stubbed.bench_trainer(argparse.Namespace(smoke=True))
 
 
@@ -89,3 +105,5 @@ def test_bench_trainer_smoke_real_fit(monkeypatch, tmp_path):
     assert res["smoke"] is True
     assert res["trainer_cps_chip"] > 0.0
     assert 0.0 <= res["input_wait_frac"] <= 1.0
+    assert res["obs_step_s"] > 0.0
+    assert 0.0 <= res["obs_input_wait_frac"] <= 1.0
