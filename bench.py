@@ -267,7 +267,7 @@ def bench_trainer(args) -> dict:
     # run doubles as the CI check that neither instrumentation silently
     # fell out of fit()
     for key in ("input_wait_frac", "steps_per_sec", "obs_step_s",
-                "obs_input_wait_frac", "obs_h2d_s"):
+                "obs_input_wait_frac", "obs_h2d_s", "train_recompiles"):
         assert key in res, f"fit() perf dict missing {key!r}: {sorted(res)}"
     # steady-state: train-section wall time of the post-compile epoch only
     # (excludes compile, eval, checkpointing — the quantity the raw-step
@@ -284,6 +284,10 @@ def bench_trainer(args) -> dict:
             "obs_step_s": res["obs_step_s"],
             "obs_input_wait_frac": res["obs_input_wait_frac"],
             "obs_h2d_s": res["obs_h2d_s"],
+            # steady-state jit-cache growth after warmup (the
+            # pva_train_recompiles gauge; analysis/recompile_guard) —
+            # anything but 0 means mid-training XLA compile stalls
+            "train_recompiles": res["train_recompiles"],
             "mfu": res.get("mfu"), "smoke": bool(args.smoke)}
 
 
@@ -653,6 +657,20 @@ def main():
     # children; the parent pins itself to CPU before any jax import can act.
     _setup_jax(smoke=True)
 
+    if args.smoke:
+        # bench-contract guarantee (fails fast, before any child spends
+        # minutes): the package tree must be pva-tpu-lint clean — the
+        # static half of the hazard contract whose runtime half is the
+        # train_recompiles == 0 assert below. docs/STATIC_ANALYSIS.md.
+        from pytorchvideo_accelerate_tpu.analysis import run_lint
+
+        lint_findings = run_lint(
+            [os.path.join(HERE, "pytorchvideo_accelerate_tpu")])
+        assert not lint_findings, (
+            "bench --smoke requires a lint-clean tree; pva-tpu-lint found:\n"
+            + "\n".join(f.format() for f in lint_findings[:20]))
+        log(f"[lint] pva-tpu-lint clean ({len(lint_findings)} findings)")
+
     user_smoke = args.smoke
     probe_attempts: list = []
     partial_path = os.path.join(HERE, "bench_partial.json")
@@ -756,6 +774,13 @@ def main():
             for key in ("obs_step_s", "obs_input_wait_frac", "obs_h2d_s"):
                 if tr.get(key) is not None:
                     extras[key] = round(tr[key], 6)
+            if "train_recompiles" in tr:
+                # steady-state recompiles seen by fit()'s hot loop —
+                # asserted zero in --smoke (the recompile-hazard
+                # contract); None = the jit cache probe is unavailable
+                # on this jax (reported as unknown, never a lying 0)
+                r = tr["train_recompiles"]
+                extras["train_recompiles"] = None if r is None else int(r)
             raw = (results.get("slowfast_r50") or {}).get(
                 "clips_per_sec_per_chip")
             # only a same-mode comparison is meaningful
@@ -831,10 +856,20 @@ def main():
         # these keys to fit the driver's line budget, and a successful run
         # must not fail over size shedding (test_bench_contract covers the
         # passthrough itself).
-        for key in ("obs_step_s", "obs_input_wait_frac", "obs_h2d_s"):
+        for key in ("obs_step_s", "obs_input_wait_frac", "obs_h2d_s",
+                    "train_recompiles"):
             assert key in extras, (
                 f"trainer smoke ran but produced no {key!r}: "
                 f"{extras.get('trainer_error') or sorted(extras)}")
+        # steady-state-zero recompile contract: after the first step's
+        # legitimate compile, the train step's jit cache must not grow
+        # (pva_train_recompiles gauge; the recompile rule's runtime
+        # teeth). None = probe unavailable on this jax — degrade to
+        # "unknown" rather than failing the bench over a missing API.
+        assert extras["train_recompiles"] in (0, None), (
+            f"steady-state recompiles detected: {extras['train_recompiles']} "
+            "jit cache entries compiled after warmup (see "
+            "docs/STATIC_ANALYSIS.md, rule `recompile`)")
     if user_smoke and args.serve_smoke:
         # smoke mode doubles as the CI check that the serving lane's
         # headline keys didn't silently fall out (same contract as the
@@ -971,7 +1006,7 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     }
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "trainer_input_wait_frac", "obs_step_s",
-                "obs_input_wait_frac", "obs_h2d_s"):
+                "obs_input_wait_frac", "obs_h2d_s", "train_recompiles"):
         if key in extras:
             out[key] = extras[key]
     # serving lane: request-latency percentiles + batcher fill ratio
@@ -1020,7 +1055,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
             if k in out:
                 out[k] = out[k][:120]
     for k in ("probes", "serve_error", "serve_fill_ratio", "serve_p99_ms",
-              "serve_p50_ms", "obs_h2d_s", "obs_input_wait_frac",
+              "serve_p50_ms", "train_recompiles", "obs_h2d_s",
+              "obs_input_wait_frac",
               "obs_step_s", "trainer_error", "trainer_input_wait_frac",
               "trainer_mfu", "trainer_cps_chip",
               "trainer_vs_rawstep", "detail", "step_ms_blocked",

@@ -1,0 +1,30 @@
+"""Static analysis + runtime guards for JAX/TPU hazards (`pva-tpu-lint`).
+
+The standing reviewer every PR must satisfy: a stdlib-`ast` pass over
+the package that catches the performance/correctness bugs that hide as
+legal Python in a jit+threads codebase — host-device syncs in the hot
+loop, recompile hazards, half-locked shared state, trace-time side
+effects, and discarded telemetry spans. `# pva: disable=<rule> -- why`
+suppresses a line, auditable via `pva-tpu-doctor`'s lint snapshot.
+Taxonomy and runbook: docs/STATIC_ANALYSIS.md.
+
+Stdlib-only on purpose: the linter runs in CI, in `bench.py --smoke`,
+and from the doctor without importing jax or the code under analysis.
+The one runtime piece (`RecompileGuard` -> `pva_train_recompiles`
+gauge) closes the loop the static `recompile` rule can only hint at.
+"""
+
+from __future__ import annotations
+
+from pytorchvideo_accelerate_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    Rule,
+    default_rules,
+    iter_suppressions,
+    lint_source,
+    run_lint,
+)
+from pytorchvideo_accelerate_tpu.analysis.recompile_guard import (  # noqa: F401
+    RecompileGuard,
+    cache_size,
+)

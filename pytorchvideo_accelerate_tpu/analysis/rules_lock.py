@@ -1,0 +1,171 @@
+"""Rule `lock-discipline`: attributes guarded somewhere, bare elsewhere.
+
+The thread layers this repo grew (decode pool, DevicePrefetcher worker,
+serving flush thread, watchdog poller) all share state through `self.X`
+attributes guarded by a `self._lock`. The discipline that keeps that
+sound is all-or-nothing: an attribute written under the lock in ONE
+method and written bare in ANOTHER is exactly the half-guarded state
+where a reader sees a torn update — and it reads as perfectly normal
+Python, so review misses it.
+
+Mechanics, per class:
+
+- lock attributes = anything assigned `threading.Lock()`/`RLock()`, or
+  any `self.*lock*` used as a `with` context;
+- a *write* is an attribute assignment (`self.x = ...`, `self.x += ...`),
+  a subscript store (`self.x[k] = ...`, `del self.x[k]`), or a mutating
+  method call (`self.x.append(...)`, `.update(...)`, ...) — mutation is
+  how deques/dicts/sets change, so assignment-only tracking would miss
+  most real writes;
+- `__init__` (and `__new__`) writes are exempt: the object is not shared
+  yet (and requiring a lock there would be cargo cult);
+- any attribute with >= 1 locked write outside those constructors becomes
+  *guarded*; every bare write to it elsewhere is flagged.
+
+Out of scope (by design, not oversight): `self._lock.acquire()` pairs
+(use `with`), cross-object writes (`other.x = ...`), and reads — a
+locked-read/bare-write imbalance shows up as the write flag already.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+)
+
+_CTOR_METHODS = ("__init__", "__new__")
+_MUTATORS = frozenset({
+    "append", "appendleft", "extend", "extendleft", "insert",
+    "add", "update", "pop", "popleft", "popitem", "remove", "discard",
+    "clear", "setdefault", "sort", "reverse",
+})
+
+
+def _self_attr(node: ast.AST) -> str:
+    """"x" for `self.x`, "" otherwise."""
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return ""
+
+
+class _MethodScan(ast.NodeVisitor):
+    """Collect (attr, node, locked?) writes within one method body,
+    tracking `with self.<lock>` nesting. Nested functions are scanned as
+    part of the method (they run on the same thread discipline)."""
+
+    def __init__(self, lock_attrs: Set[str]):
+        self.lock_attrs = lock_attrs
+        self.depth = 0
+        self.writes: List[Tuple[str, ast.AST, bool]] = []
+
+    def _record(self, attr: str, node: ast.AST) -> None:
+        if attr and attr not in self.lock_attrs:
+            self.writes.append((attr, node, self.depth > 0))
+
+    def _target_attr(self, tgt: ast.AST) -> str:
+        if isinstance(tgt, ast.Subscript):  # self.x[k] = ...
+            return _self_attr(tgt.value)
+        return _self_attr(tgt)
+
+    def visit_With(self, node: ast.With) -> None:
+        locked = any(_self_attr(item.context_expr) in self.lock_attrs
+                     for item in node.items)
+        self.depth += 1 if locked else 0
+        self.generic_visit(node)
+        self.depth -= 1 if locked else 0
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        for tgt in node.targets:
+            elts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else [tgt]
+            for e in elts:
+                self._record(self._target_attr(e), node)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._record(self._target_attr(node.target), node)
+        self.generic_visit(node)
+
+    def visit_AnnAssign(self, node: ast.AnnAssign) -> None:
+        if node.value is not None:
+            self._record(self._target_attr(node.target), node)
+        self.generic_visit(node)
+
+    def visit_Delete(self, node: ast.Delete) -> None:
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):  # del self.x[k]
+                self._record(_self_attr(tgt.value), node)
+        self.generic_visit(node)
+
+    def visit_Call(self, node: ast.Call) -> None:
+        f = node.func
+        if (isinstance(f, ast.Attribute) and f.attr in _MUTATORS):
+            self._record(_self_attr(f.value), node)
+        self.generic_visit(node)
+
+
+def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
+    """Attributes of `self` that hold (or are used as) locks."""
+    attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
+            tail = call_name(node.value).rsplit(".", 1)[-1]
+            if tail in ("Lock", "RLock", "Condition", "Semaphore",
+                        "BoundedSemaphore"):
+                for tgt in node.targets:
+                    a = _self_attr(tgt)
+                    if a:
+                        attrs.add(a)
+        elif isinstance(node, ast.With):
+            for item in node.items:
+                a = _self_attr(item.context_expr)
+                if a and "lock" in a.lower():
+                    attrs.add(a)
+    return attrs
+
+
+class LockDisciplineRule(Rule):
+    name = "lock-discipline"
+    description = ("attribute written under `with self._lock` in one "
+                   "method and bare in another")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for cls in ast.walk(module.tree):
+            if not isinstance(cls, ast.ClassDef):
+                continue
+            locks = _lock_attrs(cls)
+            if not locks:
+                continue
+            # method -> writes; only direct methods (nested classes get
+            # their own ClassDef visit)
+            per_attr: Dict[str, List[Tuple[str, ast.AST, bool]]] = {}
+            for item in cls.body:
+                if not isinstance(item, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef)):
+                    continue
+                scan = _MethodScan(locks)
+                for stmt in item.body:
+                    scan.visit(stmt)
+                for attr, node, locked in scan.writes:
+                    per_attr.setdefault(attr, []).append(
+                        (item.name, node, locked))
+            for attr, writes in per_attr.items():
+                guarded = any(locked for m, _, locked in writes
+                              if m not in _CTOR_METHODS)
+                if not guarded:
+                    continue
+                for method, node, locked in writes:
+                    if locked or method in _CTOR_METHODS:
+                        continue
+                    yield self.finding(
+                        module, node,
+                        f"`{cls.name}.{attr}` is written under "
+                        "`with self._lock` elsewhere but bare in "
+                        f"`{method}` — take the lock or suppress with "
+                        "the reason this write cannot race")

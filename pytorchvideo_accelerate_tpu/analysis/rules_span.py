@@ -1,0 +1,43 @@
+"""Rule `span-discipline`: `obs.span(...)` must be a `with` context.
+
+`obs.span("name")` RETURNS a context manager; it times nothing until
+entered. A bare statement call — `obs.span("step")` on its own line,
+usually a refactor leftover where the `with` got lost — silently records
+zero spans while reading like instrumentation, which then skews the
+per-window breakdown (`obs/unattributed_s` grows and nobody knows why).
+
+Flagged: expression statements whose value is a call to a bare or
+dotted `span(...)` (the result is discarded on the spot). Returning,
+assigning, or entering the span are all legitimate and untouched
+(`obs/spans.py`'s own `span()` facade returns one).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+)
+
+
+class SpanDisciplineRule(Rule):
+    name = "span-discipline"
+    description = "span(...) call whose context manager is discarded unused"
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        for node in ast.walk(module.tree):
+            if not (isinstance(node, ast.Expr)
+                    and isinstance(node.value, ast.Call)):
+                continue
+            name = call_name(node.value)
+            if name.rsplit(".", 1)[-1] == "span":
+                yield self.finding(
+                    module, node,
+                    f"`{name}(...)` returns a context manager that is "
+                    "discarded here — it times nothing until entered; "
+                    "write `with " + (name or "span") + "(...):`")

@@ -1,0 +1,187 @@
+"""Rule `tracer-leak`: side effects inside traced (jit/pjit/shard_map) code.
+
+A function handed to `jax.jit` runs as PYTHON exactly once per cache
+entry — at trace time. Any side effect in its body (`self.step_count +=
+1`, `global LAST_LOSS`, appending to a closed-over list) either
+vanishes on cached calls or, worse, stores a *tracer* object that
+explodes much later with the infamous leaked-tracer error, far from the
+line that caused it. State must flow through arguments and return values
+(the `TrainState` convention every step in trainer/steps.py follows).
+
+What counts as "traced" here (module-local, heuristic by design):
+
+- functions decorated with `jit`/`pjit`/`shard_map` (bare or dotted,
+  including `partial(jax.jit, ...)` decorators);
+- named functions passed as the first argument to a `jit`/`pjit`/
+  `shard_map` call anywhere in the module (`return jax.jit(step)` — the
+  factory pattern trainer/steps.py uses).
+
+Inside a traced function (nested defs included, with their own locals):
+
+- stores/augments to `self.*` or to attributes of closure variables;
+- `global` / `nonlocal` declarations (declaring one is only ever done
+  to write it);
+- mutating method calls (`.append` etc.) on closure variables.
+
+Reads of closures are fine — closing over the model/mesh is the whole
+factory pattern. Local mutation is fine — locals die at trace end.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterable, List, Set
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+    walk_pruned,
+)
+
+_FUNCS = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_TRACE_WRAPPERS = ("jit", "pjit", "shard_map")
+_MUTATORS = frozenset({"append", "appendleft", "extend", "extendleft",
+                       "insert", "add", "update", "pop", "remove",
+                       "discard", "clear", "setdefault"})
+
+
+def _is_trace_wrapper(name: str) -> bool:
+    return name.rsplit(".", 1)[-1] in _TRACE_WRAPPERS
+
+
+def _decorated_traced(fn: ast.AST) -> bool:
+    for dec in getattr(fn, "decorator_list", []):
+        if _is_trace_wrapper(ast.unparse(dec).split("(")[0].strip()):
+            return True
+        # functools.partial(jax.jit, static_argnums=...) decorators
+        if (isinstance(dec, ast.Call)
+                and call_name(dec).rsplit(".", 1)[-1] == "partial"
+                and dec.args and _is_trace_wrapper(
+                    ast.unparse(dec.args[0]).strip())):
+            return True
+    return False
+
+
+def _local_names(fn: ast.AST) -> Set[str]:
+    """Parameters + every Name ever stored in this function's own body
+    (nested defs excluded — they get their own scope when recursed)."""
+    names: Set[str] = set()
+    args = fn.args
+    for a in (list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+              + ([args.vararg] if args.vararg else [])
+              + ([args.kwarg] if args.kwarg else [])):
+        names.add(a.arg)
+    for node in walk_pruned(fn, prune=_FUNCS):
+        if isinstance(node, _FUNCS):
+            names.add(node.name)  # the nested def's NAME is a local binding
+        elif isinstance(node, ast.Name) and isinstance(
+                node.ctx, (ast.Store, ast.Del)):
+            names.add(node.id)
+    return names
+
+
+class TracerLeakRule(Rule):
+    name = "tracer-leak"
+    description = ("assignment to self/globals/closures inside a function "
+                   "traced by jit/pjit/shard_map")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        # names passed (first positional) to a trace wrapper anywhere
+        traced_names: Set[str] = set()
+        for node in ast.walk(module.tree):
+            if (isinstance(node, ast.Call) and _is_trace_wrapper(
+                    call_name(node)) and node.args
+                    and isinstance(node.args[0], ast.Name)):
+                traced_names.add(node.args[0].id)
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if node.name in traced_names or _decorated_traced(node):
+                yield from self._scan(module, node, _local_names(node))
+
+    def _scan(self, module: ModuleInfo, fn: ast.AST,
+              locals_: Set[str]) -> Iterable[Finding]:
+        """Flag non-local side effects in `fn`'s body; recurse into nested
+        defs with their locals unioned in (an inner def sees the outer
+        trace's variables as closures either way)."""
+        body: List[ast.stmt] = fn.body
+        for stmt in body:
+            nodes = ([stmt] if isinstance(stmt, _FUNCS)
+                     else [stmt, *walk_pruned(stmt, prune=_FUNCS)])
+            for node in nodes:
+                if isinstance(node, _FUNCS):
+                    yield from self._scan(module, node,
+                                          locals_ | _local_names(node))
+                    continue
+                if isinstance(node, (ast.Global, ast.Nonlocal)):
+                    kind = ("global" if isinstance(node, ast.Global)
+                            else "nonlocal")
+                    yield self.finding(
+                        module, node,
+                        f"`{kind} {', '.join(node.names)}` inside a traced "
+                        "function: the write happens at trace time only "
+                        "(or leaks a tracer) — thread state through "
+                        "arguments/returns instead")
+                elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                       ast.AnnAssign)):
+                    targets = (node.targets if isinstance(node, ast.Assign)
+                               else [node.target])
+                    for tgt in targets:
+                        yield from self._flag_target(module, node, tgt,
+                                                     locals_)
+                elif (isinstance(node, ast.Expr)
+                        and isinstance(node.value, ast.Call)):
+                    # statement-position call with the result discarded:
+                    # the only shape where a mutator call IS the point.
+                    # (`updates, _ = tx.update(...)` binds the result —
+                    # that's optax's pure update, not dict mutation.)
+                    f = node.value.func
+                    if (isinstance(f, ast.Attribute)
+                            and f.attr in _MUTATORS
+                            and isinstance(f.value, ast.Name)
+                            and f.value.id not in locals_):
+                        yield self.finding(
+                            module, node,
+                            f"`{f.value.id}.{f.attr}(...)` mutates a "
+                            "closure inside a traced function — the "
+                            "mutation runs at trace time only and can "
+                            "store a leaked tracer")
+
+    def _flag_target(self, module: ModuleInfo, node: ast.AST, tgt: ast.AST,
+                     locals_: Set[str]) -> Iterable[Finding]:
+        if isinstance(tgt, (ast.Tuple, ast.List)):
+            for e in tgt.elts:
+                yield from self._flag_target(module, node, e, locals_)
+            return
+        if isinstance(tgt, ast.Subscript):
+            tgt = tgt.value  # x[k] = ... writes through x
+        root = tgt
+        while isinstance(root, ast.Attribute):
+            root = root.value
+        if not isinstance(root, ast.Name):
+            return
+        if isinstance(tgt, ast.Attribute):
+            if root.id == "self":
+                yield self.finding(
+                    module, node,
+                    f"`self.{tgt.attr} = ...` inside a traced function: "
+                    "the store runs at trace time only (or leaks a "
+                    "tracer) — return the value instead")
+            elif root.id not in locals_:
+                yield self.finding(
+                    module, node,
+                    f"attribute store on closure `{root.id}` inside a "
+                    "traced function — side effects don't survive "
+                    "tracing; thread state through arguments/returns")
+        # bare-Name stores to non-locals are impossible without
+        # global/nonlocal (already flagged); subscript stores through a
+        # closure name:
+        elif isinstance(tgt, ast.Name) and tgt.id not in locals_:
+            yield self.finding(
+                module, node,
+                f"subscript store through closure `{tgt.id}` inside a "
+                "traced function — trace-time-only side effect")

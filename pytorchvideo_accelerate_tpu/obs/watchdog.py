@@ -115,8 +115,11 @@ class Watchdog:
         return stalled
 
     def _fire(self, stalled: List[str]) -> None:
-        self.stall_count += 1
-        self.last_stalled = list(stalled)
+        # written on the poll thread, read by tests/operators from others —
+        # same lock as the beat table (pva-tpu-lint lock-discipline)
+        with self._lock:
+            self.stall_count += 1
+            self.last_stalled = list(stalled)
         lines = [
             f"[watchdog] NO PROGRESS from {', '.join(stalled)} for "
             f"> {self.timeout_s:g}s — dumping all-thread stacks + flight "

@@ -176,3 +176,21 @@ def state_sharding_like(mesh: Mesh, state: Any, min_size: int = 2**16) -> Any:
     """Sharding pytree for an arbitrary train-state pytree (params + opt
     state + scalars): scalars/small leaves replicated, big leaves fsdp-ruled."""
     return param_sharding(mesh, state, min_size)
+
+
+def shard_state(mesh: Mesh, state: Any, min_size: int = 2**16) -> Any:
+    """Place a WHOLE train-state pytree (params + opt state + step scalar +
+    EMA) on the mesh with committed NamedShardings.
+
+    Why every leaf and not just params: a freshly-built state mixes
+    shard_params-placed params with leaves optax/TrainState created eagerly
+    (step counter, schedule counts, momentum on some paths) that sit as
+    *uncommitted single-device* arrays. The first jitted step returns every
+    leaf committed to mesh-wide NamedShardings, so the SECOND call sees
+    different input layouts and pays a full XLA recompile — one silent
+    extra compile (minutes at production model sizes) per training run.
+    Settling the layouts here makes call 2 hit call 1's executable; the
+    `pva_train_recompiles` gauge (analysis/recompile_guard.py) is the
+    regression tripwire."""
+    shardings = state_sharding_like(mesh, state, min_size)
+    return jax.tree.map(jax.device_put, state, shardings)

@@ -78,7 +78,7 @@ class MicroBatcher:
     def submit(self, clip: Dict[str, np.ndarray]) -> Future:
         """Enqueue ONE clip — leaves (T, H, W, C) or (V, T, H, W, C) — and
         get a Future resolving to its fp32 logits (num_classes,)."""
-        clips = {k: np.asarray(v) for k, v in clip.items() if k in CLIP_KEYS}
+        clips = {k: np.asarray(v) for k, v in clip.items() if k in CLIP_KEYS}  # pva: disable=host-sync -- request payload is host-side (JSON/numpy), no device value
         if not clips:
             raise ValueError("request has neither 'video' nor 'slow'/'fast'")
         for k, v in clips.items():
@@ -203,7 +203,7 @@ class MicroBatcher:
         # the masked-row convention of the eval path: 1.0 = real request,
         # 0.0 = padding. The engine's pure forward ignores it; it documents
         # (and lets debug tooling assert) which rows are live.
-        stacked["mask"] = np.asarray(
+        stacked["mask"] = np.asarray(  # pva: disable=host-sync -- builds the mask from a Python list, host-side by construction
             [1.0] * n + [0.0] * (bucket - n), np.float32)
         logits = self.engine.predict(stacked)
         done = time.monotonic()

@@ -129,15 +129,21 @@ class ServingStats:
         out: Dict[str, float] = {
             "requests": self._c_requests.total(),
             "batches": self._c_batches.total(),
-            "rejected": self._c_rejected.total(),
             "errors": self._c_errors.total(),
             "compiled_buckets": self._c_compiles.total(),
             "uptime_s": round(time.monotonic() - self._started, 3),
         }
         # per-cause rejection split, sourced from the same labeled counter
-        # /metrics renders — the two surfaces cannot disagree
+        # /metrics renders — the two surfaces cannot disagree. ONE locked
+        # read (samples()) feeds both the split and the aggregate: the old
+        # total() + three value() calls took the counter lock four times,
+        # so a rejection landing mid-snapshot could make `rejected` differ
+        # from the sum of its own split (pva-tpu-lint window-read review).
+        rejected = {labels.get("cause"): v
+                    for labels, v in self._c_rejected.samples()}
+        out["rejected"] = float(sum(rejected.values()))
         for cause in ("400", "503", "504"):
-            out[f"rejected_{cause}"] = self._c_rejected.value(cause=cause)
+            out[f"rejected_{cause}"] = float(rejected.get(cause, 0.0))
         vals = sorted(v for _, v in lat)
         out["p50_ms"] = round(_percentile(vals, 50) * 1e3, 3)
         out["p95_ms"] = round(_percentile(vals, 95) * 1e3, 3)

@@ -17,6 +17,7 @@ import jax
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.analysis.recompile_guard import RecompileGuard
 from pytorchvideo_accelerate_tpu.config import TrainConfig
 from pytorchvideo_accelerate_tpu.data.manifest import from_list, scan_directory
 from pytorchvideo_accelerate_tpu.data.pipeline import (
@@ -34,7 +35,7 @@ from pytorchvideo_accelerate_tpu.parallel.distributed import (
     main_print,
 )
 from pytorchvideo_accelerate_tpu.parallel.mesh import data_shard_count, make_mesh
-from pytorchvideo_accelerate_tpu.parallel.sharding import shard_params
+from pytorchvideo_accelerate_tpu.parallel.sharding import shard_params, shard_state
 from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
     Checkpointer,
     resolve_resume_path,
@@ -359,6 +360,13 @@ class Trainer:
                 "init weights while eval keeps scoring them)")
         self.state = TrainState.create(params, batch_stats, self.tx,
                                        ema=cfg.optim.ema_decay > 0)
+        # settle EVERY leaf's layout (step counter, optax counts/momentum)
+        # to committed mesh shardings, not just the shard_params-placed
+        # params: otherwise the first step returns a differently-placed
+        # state and the SECOND step pays a full silent XLA recompile
+        # (found by the pva_train_recompiles guard; parallel/sharding.py
+        # shard_state)
+        self.state = shard_state(self.mesh, self.state)
 
         if cfg.model.pretrained and not cfg.model.pretrained_path:
             # unlike the reference there is no runtime hub fetch (zero
@@ -489,7 +497,7 @@ class Trainer:
                 host_broadcast,
             )
 
-            latest = int(host_broadcast(
+            latest = int(host_broadcast(  # pva: disable=host-sync -- resume-time host collective, once per run before the step loop
                 np.int64(-1 if latest is None else latest)))
             latest = None if latest < 0 else latest
         if latest is None:
@@ -553,7 +561,7 @@ class Trainer:
 
     def _save_inner(self, kind: str, epoch: int) -> None:
         self.checkpointer.save(
-            int(self.state.step),
+            int(self.state.step),  # pva: disable=host-sync -- checkpoint save is a deliberate sync point (inside the "ckpt" span)
             self.state,
             {
                 "kind": kind,
@@ -612,7 +620,7 @@ class Trainer:
                           "val_loss": loss}
                 main_print(f"evaluate: val_acc={acc:.4f} val_acc5={acc5:.4f}")
             if self.trackers:
-                self.trackers.log(result, step=int(self.state.step))
+                self.trackers.log(result, step=int(self.state.step))  # pva: disable=host-sync -- evaluate() exit; metrics already drained this value's step
             return result
         finally:
             if self.trackers:
@@ -659,12 +667,19 @@ class Trainer:
         cfg = self.cfg
         starting_epoch = self._maybe_resume()
         steps_per_epoch = self.train_loader.steps_per_epoch()
+        # host-side mirror of state.step: reading the device scalar
+        # (int/float) every step would block on the step's result before
+        # dispatching the next one, killing async-dispatch pipelining
+        # (VERDICT r2 weak #4) — metrics are only fetched every `log_every`.
+        # The ONE fetch here (fit() start, pre-loop) also seeds the progress
+        # bar; it used to be fetched twice (pva-tpu-lint host-sync).
+        gstep = int(self.state.step)  # pva: disable=host-sync -- one fetch at fit() start, before the step loop exists
         use_tqdm = is_main_process()
         if use_tqdm:
             from tqdm.auto import tqdm
 
             progress = tqdm(total=cfg.optim.num_epochs * steps_per_epoch,
-                            initial=int(self.state.step))
+                            initial=gstep)
         last_val_acc, last_train_loss = 0.0, float("nan")
         last_val_acc5, last_val_loss = 0.0, float("nan")
         last_perf: Dict[str, float] = {}
@@ -673,11 +688,6 @@ class Trainer:
         epoch_train_times = []
 
         profiling = False
-        # host-side mirror of state.step: reading the device scalar
-        # (int/float) every step would block on the step's result before
-        # dispatching the next one, killing async-dispatch pipelining
-        # (VERDICT r2 weak #4) — metrics are only fetched every `log_every`
-        gstep = int(self.state.step)
         # profile window is relative to THIS run's first step, so resumed
         # runs (gstep >> 0) still capture a trace
         run_start_step = gstep
@@ -689,6 +699,14 @@ class Trainer:
         deferred = (DeferredStepLogger(self.trackers,
                                        on_flush=self._obs_on_flush())
                     if self.trackers else None)
+        # steady-state recompile guard (analysis/recompile_guard): armed
+        # after the first step of THIS run (the legitimate compile), then
+        # any jit-cache growth is a mid-training XLA compile stall. Sampled
+        # at every log_every boundary + epoch end into the
+        # `pva_train_recompiles` gauge; fit() reports the count as
+        # `train_recompiles` and bench.py --smoke asserts it stays 0 —
+        # the runtime teeth behind pva-tpu-lint's static `recompile` rule.
+        recompile_guard = RecompileGuard(self.train_step)
         # obs window accounting: the collector aggregates named spans; every
         # log_every boundary drains them into a per-window step-time
         # breakdown (obs/step_s, obs/input_wait_s, ...) logged through the
@@ -762,6 +780,11 @@ class Trainer:
                             )
                     gstep += 1
                     train_steps_this_epoch += 1
+                    if not recompile_guard.armed:
+                        # the first dispatch has returned, so its trace +
+                        # compile are done: everything past this baseline
+                        # is a steady-state recompile
+                        recompile_guard.arm()
                     if deferred is not None:
                         # previous boundary's metrics: their step has retired
                         # behind the one just dispatched, so this fetch
@@ -799,6 +822,7 @@ class Trainer:
                         drain_spans(log_step=gstep,
                                     window_wall=now - window_t0)
                         window_t0 = now
+                        recompile_guard.sample()  # refresh the gauge
                     if (isinstance(self.checkpointing_steps, int)
                             and gstep % self.checkpointing_steps == 0):
                         self._save("step", epoch)
@@ -868,6 +892,12 @@ class Trainer:
                         "input_wait_s": train_wait_s,
                         "input_wait_frac": min(train_wait_s / t_train, 1.0),
                     }
+                    # jit-cache growth since the post-first-step baseline;
+                    # 0 is the only healthy steady-state reading. None =
+                    # probe unavailable (future jax without _cache_size):
+                    # the key stays present so consumers see "unknown"
+                    # instead of a missing-key failure, and never a lying 0
+                    last_perf["train_recompiles"] = recompile_guard.sample()
                     if self.obs_on:
                         # the generalized, span-sourced successors of PR 1's
                         # one-off input_wait plumbing — the keys bench.py
@@ -904,7 +934,7 @@ class Trainer:
                         check_desync,
                     )
 
-                    check_desync(float(optax.global_norm(self.state.params)),
+                    check_desync(float(optax.global_norm(self.state.params)),  # pva: disable=host-sync -- opt-in --debug_desync path, once per epoch end
                                  name=f"params@epoch{epoch}")
                 if self.checkpointing_steps == "epoch":
                     self._save("epoch", epoch)
@@ -939,7 +969,7 @@ class Trainer:
             progress.close()
         self.train_loader.close()
         self.val_loader.close()
-        result = {"train_loss": last_train_loss, "steps": int(self.state.step),
+        result = {"train_loss": last_train_loss, "steps": int(self.state.step),  # pva: disable=host-sync -- fit() exit: training is over, the sync is free
                   "epoch_train_times": epoch_train_times,
                   "flops_per_step": self._flops_per_step, **last_perf}
         if self.is_pretraining:

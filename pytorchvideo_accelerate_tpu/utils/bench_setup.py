@@ -63,7 +63,10 @@ def build_step_setup(
     )
     from pytorchvideo_accelerate_tpu.models import create_model
     from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
-    from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+    from pytorchvideo_accelerate_tpu.parallel.sharding import (
+        shard_batch,
+        shard_state,
+    )
     from pytorchvideo_accelerate_tpu.trainer import (
         TrainState, build_optimizer, make_pretrain_step, make_train_step,
     )
@@ -127,8 +130,11 @@ def build_step_setup(
         sample = jnp.zeros((1, frames, crop, crop, 3))
     variables = model.init(jax.random.key(0), sample)
     tx = build_optimizer(OptimConfig(), total_steps=total_steps)
-    state = TrainState.create(variables["params"],
-                              variables.get("batch_stats", {}), tx)
+    # shard_state, not raw create: uncommitted single-device leaves would
+    # make the measured step's SECOND call recompile (layout settling),
+    # corrupting the warmup accounting — same fix as Trainer's
+    state = shard_state(mesh, TrainState.create(
+        variables["params"], variables.get("batch_stats", {}), tx))
     if pretrain:
         step = make_pretrain_step(model, tx, mesh, accum_steps=accum)
     else:

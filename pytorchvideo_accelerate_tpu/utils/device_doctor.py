@@ -266,6 +266,50 @@ def obs_snapshot(output_dir: str = "", last: int = 30) -> dict:
     return out
 
 
+def lint_snapshot(root: str = "", max_items: int = 40) -> dict:
+    """Static-analysis health of the installed package (analysis/ —
+    docs/STATIC_ANALYSIS.md): a fresh `pva-tpu-lint` pass (finding count
+    + heads) and every outstanding `# pva: disable=... -- reason`
+    suppression with its file, rules, and reason. Suppressions are
+    DEBT the linter is carrying on purpose; surfacing them here keeps
+    them auditable instead of letting reasons rot in comments."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.analysis import (
+            iter_suppressions,
+            lint_source,
+        )
+        from pytorchvideo_accelerate_tpu.analysis.core import iter_py_files
+
+        if not root:
+            root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # one read per file feeds BOTH the lint pass and the suppression
+        # audit (run_lint would re-read the tree this loop already reads)
+        findings, sups = [], []
+        for fp in iter_py_files([root]):
+            try:
+                with open(fp, encoding="utf-8") as f:
+                    source = f.read()
+            except OSError:
+                continue
+            findings.extend(lint_source(source, path=fp))
+            rel = os.path.relpath(fp, os.path.dirname(root))
+            for s in iter_suppressions(source):
+                sups.append({"file": rel, "line": s.line,
+                             "rules": list(s.rules), "reason": s.reason})
+        out["findings"] = len(findings)
+        out["finding_heads"] = [f.format() for f in findings[:max_items]]
+        out["suppressions"] = len(sups)
+        out["suppression_list"] = sups[:max_items]
+        # a suppression without a reason defeats the audit trail — count
+        # them so the doctor's reader sees the debt explicitly
+        out["suppressions_without_reason"] = sum(
+            1 for s in sups if not s["reason"])
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def diagnose(timeout_s: int = 120, skip_init: bool = False,
              variants: bool = False, obs_dir: str = "") -> dict:
     rec = {
@@ -275,6 +319,7 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "files": file_facts(),
         "loopback_listeners": loopback_listeners(),
         "obs": obs_snapshot(obs_dir),
+        "lint": lint_snapshot(),
     }
     if not skip_init:
         rec["verbose_init"] = verbose_init_attempt(timeout_s)

@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# pva-tpu-lint over the package tree (docs/STATIC_ANALYSIS.md): the
+# standing reviewer every PR must satisfy. Exit codes: 0 clean, 1
+# findings, 2 usage error — CI gates on nonzero. Extra args pass
+# through (e.g. `scripts/lint.sh --select host-sync tests/fixture.py`);
+# the caller's cwd is preserved so relative paths mean what they say.
+set -euo pipefail
+ROOT="$(cd "$(dirname "$0")/.." && pwd)"
+
+if [ $# -eq 0 ]; then
+  set -- "${ROOT}/pytorchvideo_accelerate_tpu"
+fi
+exec env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  python -m pytorchvideo_accelerate_tpu.analysis.cli "$@"

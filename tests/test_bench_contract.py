@@ -140,7 +140,7 @@ def test_finalize_line_fits_driver_capture():
     extras = {
         "trainer_vs_rawstep": 0.934, "trainer_mfu": 0.1234,
         "obs_step_s": 0.012345, "obs_input_wait_frac": 0.0123,
-        "obs_h2d_s": 0.001234,
+        "obs_h2d_s": 0.001234, "train_recompiles": 0,
         "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
         "error": "watchdog fired: " + "y" * 3000,
         "probe_attempts": [
@@ -171,6 +171,16 @@ def test_finalize_obs_keys_ride_the_headline():
     assert out["obs_step_s"] == 0.0123
     assert out["obs_input_wait_frac"] == 0.02
     assert out["obs_h2d_s"] == 0.0011
+
+
+def test_finalize_train_recompiles_rides_the_headline():
+    """The steady-state recompile count (pva_train_recompiles gauge via
+    fit()'s perf dict; analysis/recompile_guard.py) plumbs through
+    finalize onto the headline line — the number `--smoke` asserts 0."""
+    out = bench.finalize(_model(), {"train_recompiles": 0}, user_smoke=False)
+    assert out["train_recompiles"] == 0
+    out = bench.finalize(_model(), {"train_recompiles": 3}, user_smoke=False)
+    assert out["train_recompiles"] == 3
 
 
 def test_finalize_serving_lane_keys():

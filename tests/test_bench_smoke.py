@@ -49,7 +49,7 @@ def test_bench_trainer_smoke_propagates_input_wait(stubbed):
         "steps_per_sec": 4.0, "clips_per_sec": 64.0,
         "input_wait_s": 0.02, "input_wait_frac": 0.02, "mfu": 0.1,
         "obs_step_s": 0.25, "obs_input_wait_frac": 0.02,
-        "obs_h2d_s": 0.01,
+        "obs_h2d_s": 0.01, "train_recompiles": 0,
     }
     res = stubbed.bench_trainer(argparse.Namespace(smoke=True))
     assert res["smoke"] is True
@@ -58,6 +58,8 @@ def test_bench_trainer_smoke_propagates_input_wait(stubbed):
     assert res["obs_step_s"] == 0.25
     assert res["obs_input_wait_frac"] == 0.02
     assert res["obs_h2d_s"] == 0.01
+    # the steady-state recompile count (analysis/recompile_guard) too
+    assert res["train_recompiles"] == 0
     assert res["trainer_cps_chip"] > 0.0
     # and the smoke geometry really was requested (CPU-sized shapes)
     assert _StubTrainer.last_cfg.data.crop_size == stubbed.SMOKE_TRAINER_SHAPE[1]
@@ -81,6 +83,16 @@ def test_bench_trainer_smoke_asserts_perf_keys(stubbed):
         "input_wait_frac": 0.02,  # obs_step_s missing
     }
     with pytest.raises(AssertionError, match="obs_step_s"):
+        stubbed.bench_trainer(argparse.Namespace(smoke=True))
+    # and for the recompile-guard count (the runtime recompile contract)
+    _StubTrainer.result = {
+        "steps": 8, "epoch_train_times": [2.0, 1.0], "train_loss": 0.5,
+        "steps_per_sec": 4.0, "input_wait_s": 0.02,
+        "input_wait_frac": 0.02, "obs_step_s": 0.25,
+        "obs_input_wait_frac": 0.02, "obs_h2d_s": 0.01,
+        # train_recompiles missing
+    }
+    with pytest.raises(AssertionError, match="train_recompiles"):
         stubbed.bench_trainer(argparse.Namespace(smoke=True))
 
 
@@ -107,3 +119,6 @@ def test_bench_trainer_smoke_real_fit(monkeypatch, tmp_path):
     assert 0.0 <= res["input_wait_frac"] <= 1.0
     assert res["obs_step_s"] > 0.0
     assert 0.0 <= res["obs_input_wait_frac"] <= 1.0
+    # the steady-state-zero recompile contract on a REAL fit: after the
+    # first step's compile, the train step's jit cache must not grow
+    assert res["train_recompiles"] == 0

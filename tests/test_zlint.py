@@ -1,0 +1,405 @@
+"""pva-tpu-lint (analysis/): one failing fixture per rule family proving it
+fires, the suppressed twin proving `# pva: disable=` works, the clean
+full-tree run over the package (the CI/bench gate), the CLI exit-code
+contract, the runtime RecompileGuard, and the doctor's lint snapshot.
+
+Late-alphabet name on purpose: tier-1 is timeout-bound and kills
+mid-suite — cheap early-alphabet tests protect the DOTS count, and this
+file needs jax only for the guard tests at the bottom.
+"""
+
+import os
+
+import pytest
+
+import pytorchvideo_accelerate_tpu
+from pytorchvideo_accelerate_tpu.analysis import (
+    RecompileGuard,
+    iter_suppressions,
+    lint_source,
+    run_lint,
+)
+from pytorchvideo_accelerate_tpu.analysis.cli import main as lint_main
+
+PKG_DIR = os.path.dirname(os.path.abspath(pytorchvideo_accelerate_tpu.__file__))
+HOT = "pytorchvideo_accelerate_tpu/trainer/loop.py"  # any declared-hot path
+COLD = "pytorchvideo_accelerate_tpu/data/manifest.py"
+
+
+def rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# --- host-sync --------------------------------------------------------------
+
+def test_host_sync_fires_on_hot_module():
+    src = (
+        "import numpy as np\n"
+        "def loop(metrics, arr):\n"
+        "    a = float(metrics['loss'])\n"
+        "    b = arr.item()\n"
+        "    c = arr.block_until_ready()\n"
+        "    d = np.asarray(arr)\n"
+        "    e = jax.device_get(arr)\n"
+    )
+    found = lint_source(src, HOT)
+    assert rules_of(found) == ["host-sync"] * 5
+    assert [f.line for f in found] == [3, 4, 5, 6, 7]
+
+
+def test_host_sync_ignores_plain_names_and_cold_modules():
+    # float/int on a bare Name is config parsing, not a device fetch
+    assert lint_source("def f(v):\n    return int(v)\n", HOT) == []
+    # cold modules fetch values freely — that is what values are for
+    src = "def f(m):\n    return float(m['loss'])\n"
+    assert lint_source(src, COLD) == []
+
+
+def test_host_sync_suppression_and_reason():
+    src = ("def loop(metrics):\n"
+           "    a = float(metrics['loss'])  "
+           "# pva: disable=host-sync -- deliberate epoch-end fetch\n")
+    assert lint_source(src, HOT) == []
+    sups = list(iter_suppressions(src))
+    assert len(sups) == 1
+    assert sups[0].rules == ("host-sync",)
+    assert sups[0].reason == "deliberate epoch-end fetch"
+
+
+def test_suppression_on_first_line_covers_the_whole_statement():
+    # findings anchor at sub-nodes (a wrapped call arg lands on a
+    # continuation line); the documented first-line placement must still
+    # silence them
+    src = ("import jax\n"
+           "f = jax.jit(lambda x, n: x * n)\n"
+           "def run(batch):\n"
+           "    f(batch,  # pva: disable=recompile -- n is fixed\n"
+           "      3)\n")
+    assert lint_source(src, "m.py") == []
+    # and without the comment the finding anchors on the arg's line
+    bare = src.replace("  # pva: disable=recompile -- n is fixed", "")
+    assert [(x.line, x.rule) for x in lint_source(bare, "m.py")] == \
+        [(5, "recompile")]
+
+
+def test_suppression_on_block_header_does_not_cover_the_body():
+    # line-scoped means line-scoped: a disable on a def/for/with opener
+    # must NOT silently disable the rule for the whole block body
+    src = ("def loop(metrics, arr):  # pva: disable=host-sync -- header only\n"
+           "    a = float(metrics['loss'])\n"
+           "    b = arr.item()\n")
+    assert [x.line for x in lint_source(src, HOT)] == [2, 3]
+
+
+def test_host_sync_marker_inside_string_is_not_a_suppression():
+    # tokenize-based parsing: the marker in a string literal must not
+    # silence the finding on that line
+    src = ("def loop(metrics):\n"
+           "    a = (float(metrics['loss']), "
+           "'# pva: disable=host-sync')\n")
+    assert rules_of(lint_source(src, HOT)) == ["host-sync"]
+
+
+def test_host_sync_allowlisted_fetch_point():
+    # Trainer._capture_step_flops is a designed sync site (rule allowlist)
+    src = ("class Trainer:\n"
+           "    def _capture_step_flops(self, ca):\n"
+           "        self.f = float(ca.get('flops', 0.0))\n"
+           "    def fit(self, ca):\n"
+           "        return float(ca.get('flops', 0.0))\n")
+    found = lint_source(src, HOT)
+    assert [f.line for f in found] == [5]  # only the non-allowlisted one
+
+
+# --- recompile --------------------------------------------------------------
+
+def test_recompile_fires_on_unmarked_static_args():
+    src = (
+        "import jax\n"
+        "f = jax.jit(lambda x, n: x * n)\n"
+        "def run(batch):\n"
+        "    f(batch, 3)\n"
+        "    f(batch, len(batch))\n"
+        "    f(batch, batch.shape[0])\n"
+    )
+    found = lint_source(src, "m.py")
+    assert rules_of(found) == ["recompile"] * 3
+    assert [f.line for f in found] == [4, 5, 6]
+
+
+def test_recompile_respects_static_argnums_and_suppression():
+    src = ("import jax\n"
+           "f = jax.jit(lambda x, n: x * n, static_argnums=(1,))\n"
+           "def run(batch):\n"
+           "    f(batch, 3)\n")
+    assert lint_source(src, "m.py") == []
+    src = ("import jax\n"
+           "f = jax.jit(lambda x, n: x * n)\n"
+           "def run(batch):\n"
+           "    f(batch, 3)  # pva: disable=recompile -- n is fixed\n")
+    assert lint_source(src, "m.py") == []
+
+
+def test_recompile_fires_on_jit_in_loop():
+    src = ("import jax\n"
+           "def serve(batches):\n"
+           "    for b in batches:\n"
+           "        g = jax.jit(lambda x: x + 1)\n"
+           "        g(b)\n")
+    assert rules_of(lint_source(src, "m.py")) == ["recompile"]
+    # a def inside the loop runs per CALL, not per iteration: the cached
+    # jit-factory pattern (engine._make_forward) must NOT fire
+    src = ("import jax\n"
+           "def serve(batches):\n"
+           "    for b in batches:\n"
+           "        def make():\n"
+           "            return jax.jit(lambda x: x + 1)\n")
+    assert lint_source(src, "m.py") == []
+
+
+def test_recompile_tracks_self_attr_jits():
+    src = ("import jax\n"
+           "class E:\n"
+           "    def __init__(self):\n"
+           "        self.fwd = jax.jit(lambda x, n: x)\n"
+           "    def predict(self, b):\n"
+           "        return self.fwd(b, 8)\n")
+    assert rules_of(lint_source(src, "m.py")) == ["recompile"]
+
+
+# --- lock-discipline --------------------------------------------------------
+
+LOCK_SRC = (
+    "import threading\n"
+    "class S:\n"
+    "    def __init__(self):\n"
+    "        self._lock = threading.Lock()\n"
+    "        self.items = []\n"
+    "    def guarded(self):\n"
+    "        with self._lock:\n"
+    "            self.items.append(1)\n"
+    "            self.count = 2\n"
+    "    def bare(self):\n"
+    "        self.items.append(3){sup1}\n"
+    "        self.count += 1{sup2}\n"
+)
+
+
+def test_lock_discipline_fires_on_bare_writes():
+    found = lint_source(LOCK_SRC.format(sup1="", sup2=""), "m.py")
+    assert rules_of(found) == ["lock-discipline"] * 2
+    assert [f.line for f in found] == [11, 12]
+    # __init__ writes (object not yet shared) never fire
+
+
+def test_lock_discipline_suppression():
+    src = LOCK_SRC.format(
+        sup1="  # pva: disable=lock-discipline -- single-threaded phase",
+        sup2="  # pva: disable=lock-discipline -- consumer-thread-only")
+    assert lint_source(src, "m.py") == []
+
+
+def test_lock_discipline_ignores_never_guarded_attrs():
+    # attributes never written under the lock are out of contract
+    src = ("import threading\n"
+           "class S:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def a(self):\n"
+           "        self.free = 1\n")
+    assert lint_source(src, "m.py") == []
+
+
+# --- tracer-leak ------------------------------------------------------------
+
+def test_tracer_leak_fires_in_jitted_factory():
+    src = (
+        "import jax\n"
+        "def make(model):\n"
+        "    log = []\n"
+        "    def step(state, batch):\n"
+        "        self.cached = batch\n"
+        "        log.append(batch)\n"
+        "        global LAST\n"
+        "        return state\n"
+        "    return jax.jit(step)\n"
+    )
+    found = lint_source(src, "m.py")
+    assert rules_of(found) == ["tracer-leak"] * 3
+    assert [f.line for f in found] == [5, 6, 7]
+
+
+def test_tracer_leak_allows_local_mutation_and_pure_update():
+    # locals die at trace end; `a, b = tx.update(...)` is optax's PURE
+    # update (result bound), not dict mutation
+    src = (
+        "import jax\n"
+        "def make(tx):\n"
+        "    def step(state, grads):\n"
+        "        out = {}\n"
+        "        out['x'] = 1\n"
+        "        updates, opt = tx.update(grads, state)\n"
+        "        return updates\n"
+        "    return jax.jit(step)\n"
+    )
+    assert lint_source(src, "m.py") == []
+
+
+def test_tracer_leak_suppression():
+    src = ("import jax\n"
+           "def make():\n"
+           "    def step(s):\n"
+           "        self.x = s  # pva: disable=tracer-leak -- trace-time probe\n"
+           "        return s\n"
+           "    return jax.jit(step)\n")
+    assert lint_source(src, "m.py") == []
+
+
+# --- span-discipline --------------------------------------------------------
+
+def test_span_discipline_fires_on_discarded_span():
+    src = ("from pytorchvideo_accelerate_tpu import obs\n"
+           "def f():\n"
+           "    obs.span('step')\n"
+           "    with obs.span('ok'):\n"
+           "        pass\n"
+           "    return obs.span('returned-is-fine')\n")
+    found = lint_source(src, "m.py")
+    assert rules_of(found) == ["span-discipline"]
+    assert found[0].line == 3
+
+
+def test_span_discipline_suppression():
+    src = ("from pytorchvideo_accelerate_tpu import obs\n"
+           "def f():\n"
+           "    obs.span('step')  # pva: disable=span-discipline -- fixture\n")
+    assert lint_source(src, "m.py") == []
+
+
+# --- engine -----------------------------------------------------------------
+
+def test_parse_error_is_a_finding_not_a_crash():
+    found = lint_source("def broken(:\n", "m.py")
+    assert rules_of(found) == ["parse-error"]
+
+
+def test_full_tree_is_clean():
+    """THE acceptance bar: `pva-tpu-lint pytorchvideo_accelerate_tpu/`
+    exits 0 on the merged tree (every deliberate sync point is
+    allowlisted or suppressed with a reason)."""
+    findings = run_lint([PKG_DIR])
+    assert findings == [], "\n".join(f.format() for f in findings)
+
+
+def test_every_package_suppression_carries_a_reason():
+    """A suppression without a reason defeats the audit trail the doctor
+    reports; the merged tree must not accumulate bare disables."""
+    from pytorchvideo_accelerate_tpu.analysis.core import iter_py_files
+
+    bare = []
+    for fp in iter_py_files([PKG_DIR]):
+        with open(fp, encoding="utf-8") as f:
+            for s in iter_suppressions(f.read()):
+                if not s.reason:
+                    bare.append(f"{fp}:{s.line}")
+    assert bare == [], bare
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    dirty = tmp_path / "dirty.py"
+    # fake a hot path under tmp so the host-sync rule applies
+    hot_dir = tmp_path / "trainer"
+    hot_dir.mkdir()
+    hot = hot_dir / "loop.py"
+    hot.write_text("def f(m):\n    return float(m['loss'])\n")
+    dirty.write_text("import threading\n")
+    assert lint_main([str(dirty)]) == 0           # clean file
+    assert lint_main([str(hot)]) == 1             # findings
+    assert lint_main([str(tmp_path / "nope.py")]) == 2   # missing path
+    assert lint_main(["--select", "bogus", str(dirty)]) == 2
+    assert lint_main(["--list-rules"]) == 0
+    out = capsys.readouterr().out
+    for rule in ("host-sync", "recompile", "lock-discipline",
+                 "tracer-leak", "span-discipline"):
+        assert rule in out
+    # selecting away the matching rule silences the hot file
+    assert lint_main(["--select", "span-discipline", str(hot)]) == 0
+
+
+# --- runtime recompile guard ------------------------------------------------
+
+def test_recompile_guard_counts_cache_growth():
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchvideo_accelerate_tpu.obs.registry import Registry
+
+    reg = Registry()
+    f = jax.jit(lambda x: x * 2)
+    guard = RecompileGuard(f, registry=reg)
+    assert guard.supported
+    assert guard.sample() is None  # unarmed: no baseline yet
+    f(jnp.ones((3,)))  # warmup compile
+    guard.arm()
+    f(jnp.ones((3,)))  # same shape: cache hit
+    assert guard.sample() == 0
+    assert reg.get("pva_train_recompiles").value() == 0.0
+    f(jnp.ones((5,)))  # new shape: steady-state recompile
+    assert guard.sample() == 1
+    assert reg.get("pva_train_recompiles").value() == 1.0
+
+
+def test_recompile_guard_inert_without_probe():
+    from pytorchvideo_accelerate_tpu.obs.registry import Registry
+
+    guard = RecompileGuard(lambda x: x, registry=Registry())
+    assert not guard.supported
+    guard.arm()
+    assert guard.sample() is None  # degrades to "unknown", never lies 0
+
+
+def test_shard_state_settles_layouts_no_second_compile():
+    """The bug the guard caught on day one: a freshly-created TrainState
+    mixes uncommitted single-device leaves (step counter, optax state)
+    with sharded params, so the second step used to pay a full silent
+    recompile. shard_state places every leaf committed; the jit cache
+    must stay at one entry."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.parallel.sharding import shard_state
+    from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+
+    mesh = make_mesh()
+    params = {"w": jnp.ones((4, 4))}
+    state = shard_state(mesh, TrainState.create(
+        params, {}, optax.sgd(0.1, momentum=0.9)))
+
+    @jax.jit
+    def step(state, x):
+        return state.replace(step=state.step + 1), (x * 2).sum()
+
+    x = jnp.ones((2, 4))
+    for _ in range(3):
+        state, _ = step(state, x)
+    assert step._cache_size() == 1
+
+
+def test_doctor_lint_snapshot():
+    from pytorchvideo_accelerate_tpu.utils.device_doctor import lint_snapshot
+
+    snap = lint_snapshot()
+    assert snap.get("error") is None, snap
+    assert snap["findings"] == 0
+    assert snap["suppressions"] > 0  # the tree carries documented debt
+    assert snap["suppressions_without_reason"] == 0
+    assert all(s["reason"] for s in snap["suppression_list"])
+
+
+@pytest.mark.slow
+def test_lint_cli_over_package_via_script_entry():
+    """The exact acceptance command: pva-tpu-lint pytorchvideo_accelerate_tpu/
+    (through the console-script callable) exits 0 on the merged tree."""
+    assert lint_main([PKG_DIR]) == 0
