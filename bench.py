@@ -670,12 +670,36 @@ def main():
             "bench --smoke requires a lint-clean tree; pva-tpu-lint found:\n"
             + "\n".join(f.format() for f in lint_findings[:20]))
         log(f"[lint] pva-tpu-lint clean ({len(lint_findings)} findings)")
+        # the dynamic half of the same contract: one short pva-tpu-tsan
+        # stress pass (lockset races + lock-order cycles over the threaded
+        # layers) must come back clean before any child spends minutes.
+        # Runs in the parent (CPU-pinned, like the serving lane).
+        from pytorchvideo_accelerate_tpu.analysis.tsan_report import (
+            finding_count,
+            format_report,
+            publish,
+            run_stress,
+        )
+
+        tsan_report = run_stress(smoke=True, log=log)
+        publish(tsan_report)
+        tsan_findings = finding_count(tsan_report)
+        log(f"[tsan] pva-tpu-tsan: {tsan_findings} finding(s) "
+            f"in {tsan_report['elapsed_s']}s")
+        if tsan_findings:
+            log(format_report(tsan_report))
+        assert tsan_findings == 0, (
+            "bench --smoke requires a tsan-clean stress pass; pva-tpu-tsan "
+            f"found {tsan_findings} race/lock-cycle finding(s) (report "
+            "logged above; see docs/STATIC_ANALYSIS.md)")
 
     user_smoke = args.smoke
     probe_attempts: list = []
     partial_path = os.path.join(HERE, "bench_partial.json")
     results: dict = {}
     extras: dict = {"probe_attempts": probe_attempts}
+    if user_smoke:
+        extras["tsan_findings"] = tsan_findings
 
     def flush_partial():
         try:
@@ -870,6 +894,15 @@ def main():
             f"steady-state recompiles detected: {extras['train_recompiles']} "
             "jit cache entries compiled after warmup (see "
             "docs/STATIC_ANALYSIS.md, rule `recompile`)")
+    if user_smoke:
+        # dynamic-sanitizer contract, the third leg alongside lint-clean
+        # and train_recompiles == 0: the bundled pva-tpu-tsan stress pass
+        # over the threaded layers must report zero races / lock cycles
+        # (docs/STATIC_ANALYSIS.md § dynamic sanitizer)
+        assert extras.get("tsan_findings") == 0, (
+            f"pva-tpu-tsan found {extras.get('tsan_findings')} race/"
+            "lock-cycle finding(s) on the stress scenario (report logged "
+            "above; see docs/STATIC_ANALYSIS.md)")
     if user_smoke and args.serve_smoke:
         # smoke mode doubles as the CI check that the serving lane's
         # headline keys didn't silently fall out (same contract as the
@@ -1006,7 +1039,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     }
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "trainer_input_wait_frac", "obs_step_s",
-                "obs_input_wait_frac", "obs_h2d_s", "train_recompiles"):
+                "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
+                "tsan_findings"):
         if key in extras:
             out[key] = extras[key]
     # serving lane: request-latency percentiles + batcher fill ratio

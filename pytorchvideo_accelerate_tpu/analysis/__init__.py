@@ -28,3 +28,7 @@ from pytorchvideo_accelerate_tpu.analysis.recompile_guard import (  # noqa: F401
     RecompileGuard,
     cache_size,
 )
+from pytorchvideo_accelerate_tpu.analysis.tsan import (  # noqa: F401
+    Tsan,
+    get_tsan,
+)

@@ -173,10 +173,15 @@ def default_rules() -> List[Rule]:
     from pytorchvideo_accelerate_tpu.analysis.rules_lock import LockDisciplineRule
     from pytorchvideo_accelerate_tpu.analysis.rules_recompile import RecompileHazardRule
     from pytorchvideo_accelerate_tpu.analysis.rules_span import SpanDisciplineRule
+    from pytorchvideo_accelerate_tpu.analysis.rules_thread import (
+        ThreadFactoryRule,
+        ThreadJoinRule,
+    )
     from pytorchvideo_accelerate_tpu.analysis.rules_tracer import TracerLeakRule
 
     return [HostSyncRule(), RecompileHazardRule(), LockDisciplineRule(),
-            TracerLeakRule(), SpanDisciplineRule()]
+            TracerLeakRule(), SpanDisciplineRule(), ThreadFactoryRule(),
+            ThreadJoinRule()]
 
 
 def parse_module(source: str, path: str) -> ModuleInfo:

@@ -117,7 +117,10 @@ def _lock_attrs(cls: ast.ClassDef) -> Set[str]:
         if isinstance(node, ast.Assign) and isinstance(node.value, ast.Call):
             tail = call_name(node.value).rsplit(".", 1)[-1]
             if tail in ("Lock", "RLock", "Condition", "Semaphore",
-                        "BoundedSemaphore"):
+                        "BoundedSemaphore",
+                        # the utils/sync.py creation points (thread-factory
+                        # rule routes all raw construction through them)
+                        "make_lock", "make_rlock", "make_condition"):
                 for tgt in node.targets:
                     a = _self_attr(tgt)
                     if a:

@@ -1,0 +1,189 @@
+"""Rules `thread-factory` and `thread-join`: keep threads sanitizable.
+
+`thread-factory`: direct `threading.Lock`/`RLock`/`Condition`/`Thread`
+construction inside package modules. Every one of these must go through
+the `utils/sync.py` factory (`make_lock`/`make_rlock`/`make_condition`/
+`make_thread`) so `pva-tpu-tsan` can wrap the primitive when armed — a
+lock the sanitizer cannot see makes every access it guards look unguarded
+(a false positive) and its acquisition order invisible (a false negative).
+Exempt: `utils/sync.py` and `analysis/tsan.py`, which ARE the interception
+layer and must build the raw primitives. Events and semaphores stay direct:
+they carry no lockset and the sanitizer does not model them.
+
+`thread-join`: a NON-daemon thread (factory-made or not) started without a
+reachable `.join(...)` on its binding anywhere in the module. A non-daemon
+thread with no join is a shutdown hazard: process exit blocks on it
+forever, which is exactly the wedge the watchdog exists to diagnose.
+Daemon threads (`daemon=True` at the construction site) are exempt — the
+package convention is daemon workers + explicit joins in close paths.
+
+Both scope to package modules only (`pytorchvideo_accelerate_tpu/` in the
+path): test fixtures and user scripts construct primitives freely.
+Suppressions follow the house syntax: `# pva: disable=<rule> -- reason`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+)
+
+_PKG_MARKER = "pytorchvideo_accelerate_tpu/"
+_FACTORY_EXEMPT = ("pytorchvideo_accelerate_tpu/utils/sync.py",
+                   "pytorchvideo_accelerate_tpu/analysis/tsan.py")
+_KINDS = ("Lock", "RLock", "Condition", "Thread")
+_FACTORY_OF = {"Lock": "make_lock", "RLock": "make_rlock",
+               "Condition": "make_condition", "Thread": "make_thread"}
+
+
+def _in_package(module: ModuleInfo) -> bool:
+    return _PKG_MARKER in module.posix_path
+
+
+def _threading_aliases(tree: ast.AST) -> Dict[str, str]:
+    """local name -> primitive kind, for `from threading import X [as Y]`."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "threading":
+            for alias in node.names:
+                if alias.name in _KINDS:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _threading_modules(tree: ast.AST) -> Set[str]:
+    """Every local name the threading module is bound to: "threading" plus
+    any `import threading as th` alias."""
+    out = {"threading"}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name == "threading":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _make_thread_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound to the factory's make_thread by a from-import of
+    utils.sync (absolute or relative) — `import ... as mt` must not let a
+    non-daemon, never-joined thread slip past thread-join."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and (node.module == "sync" or node.module.endswith(".sync"))):
+            for alias in node.names:
+                if alias.name == "make_thread":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+class ThreadFactoryRule(Rule):
+    name = "thread-factory"
+    description = ("direct threading.Lock/RLock/Condition/Thread "
+                   "construction in a package module — use the "
+                   "utils/sync.py factory so pva-tpu-tsan can intercept")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not _in_package(module) or module.matches(_FACTORY_EXEMPT):
+            return
+        aliases = _threading_aliases(module.tree)
+        modules = _threading_modules(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = call_name(node)
+            kind = None
+            if "." in dn:
+                head, tail = dn.rsplit(".", 1)
+                if head in modules and tail in _KINDS:
+                    kind = tail
+            elif dn in aliases:
+                kind = aliases[dn]
+            if kind is not None:
+                yield self.finding(
+                    module, node,
+                    f"`{dn}(...)` constructs a raw threading.{kind}; use "
+                    f"`utils.sync.{_FACTORY_OF[kind]}(...)` so the dynamic "
+                    "sanitizer can track it when armed")
+
+
+def _binding_of(call: ast.Call,
+                parents: Dict[ast.AST, ast.AST]) -> Optional[str]:
+    """Canonical name the thread object is bound to: "self.X", "X", or
+    None (anonymous — constructed and used inline)."""
+    parent = parents.get(call)
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        tgt = parent.targets[0]
+        if isinstance(tgt, ast.Name):
+            return tgt.id
+        if (isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name)
+                and tgt.value.id == "self"):
+            return f"self.{tgt.attr}"
+    return None
+
+
+class ThreadJoinRule(Rule):
+    name = "thread-join"
+    description = ("non-daemon thread started without a reachable "
+                   "`.join()` — blocks process shutdown forever")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not _in_package(module) or module.matches(_FACTORY_EXEMPT):
+            return
+        aliases = _threading_aliases(module.tree)
+        modules = _threading_modules(module.tree)
+        factory = _make_thread_aliases(module.tree) | {"make_thread"}
+        parents: Dict[ast.AST, ast.AST] = {}
+        for node in ast.walk(module.tree):
+            for child in ast.iter_child_nodes(node):
+                parents[child] = node
+        # every name/attr a .join() is called on, module-wide: "t.join()"
+        # -> "t", "self._thread.join()" -> "self._thread"
+        joined: Set[str] = set()
+        creations: List[Tuple[ast.Call, str]] = []
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            f = node.func
+            if isinstance(f, ast.Attribute) and f.attr == "join":
+                base = f.value
+                if isinstance(base, ast.Name):
+                    joined.add(base.id)
+                elif (isinstance(base, ast.Attribute)
+                      and isinstance(base.value, ast.Name)
+                      and base.value.id == "self"):
+                    joined.add(f"self.{base.attr}")
+                continue
+            dn = call_name(node)
+            tail = dn.rsplit(".", 1)[-1]
+            # a thread constructor however it's spelled: threading.Thread
+            # (any module alias), a from-imported Thread (any as-name), the
+            # factory make_thread bare/qualified, or its from-import alias
+            head = dn.rsplit(".", 1)[0] if "." in dn else ""
+            is_thread = (aliases.get(dn) == "Thread"
+                         or (head in modules and tail == "Thread")
+                         or tail == "make_thread"
+                         or dn in factory)
+            if not is_thread:
+                continue
+            daemon = next((kw.value for kw in node.keywords
+                           if kw.arg == "daemon"), None)
+            if (isinstance(daemon, ast.Constant) and daemon.value is True):
+                continue  # daemon threads cannot block shutdown
+            creations.append((node, dn))
+        for call, dn in creations:
+            binding = _binding_of(call, parents)
+            if binding is not None and binding in joined:
+                continue
+            yield self.finding(
+                module, call,
+                f"non-daemon thread from `{dn}(...)` "
+                + (f"bound to `{binding}` " if binding else "")
+                + "is never joined in this module — pass daemon=True or "
+                  "join it on the shutdown path")

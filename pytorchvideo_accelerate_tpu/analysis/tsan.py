@@ -1,0 +1,611 @@
+"""pva-tpu-tsan runtime: dynamic lockset race + lock-order deadlock sanitizer.
+
+The dynamic complement of the static `lock-discipline` rule. That rule can
+only see writes that are half-guarded WITHIN one class; it is blind to locks
+passed across modules, queue-mediated ownership handoffs, and shutdown
+ordering — exactly where the two real bugs it did catch (Watchdog
+stall_count, ServingStats torn read) suggest more are hiding. This module
+watches the program actually run:
+
+- **Lockset (Eraser) checking.** Every factory-made lock (utils/sync.py)
+  tracks, per thread, the set of locks currently held. Every instrumented
+  shared-attribute access (the `@shared_state` registry) intersects the
+  field's candidate lockset with the accessor's held set; the classic state
+  machine (Exclusive → read-Shared → Shared-Modified) keeps init-phase and
+  read-only fields from false-alarming, and a race is reported only for a
+  Shared-Modified field whose candidate lockset went empty.
+- **Happens-before edges.** Pure lockset checking false-alarms on ownership
+  transfer, which this codebase uses everywhere (prefetch ring, batcher
+  queue, thread start/join). Each thread carries a small vector clock;
+  `make_thread` start/join and `make_queue` put→get publish/acquire clock
+  snapshots, and an access ordered after every prior conflicting access is
+  an ownership TRANSFER (the field returns to Exclusive under its new
+  owner) rather than a race.
+- **Lock-order graph.** Acquiring B while holding A records the edge A→B
+  (keyed by the factory `name`, i.e. lockdep-style lock classes, so two
+  DevicePrefetcher instances share one node). Any cycle in the graph is a
+  potential ABBA deadlock, reported with the first-observation stack of
+  every edge on the cycle.
+
+Armed only inside a `pva-tpu-tsan` run (or a test): `arm()` installs the
+runtime into utils/sync.py and patches `__getattribute__`/`__setattr__` onto
+the registered classes; `disarm()` restores everything. Disarmed — the
+default, always in production — no wrapper objects exist and no class is
+patched, so overhead is exactly zero.
+
+Known limits (documented, not accidental): attribute-level granularity
+(container *mutations* like `self._beats[k] = v` read the attribute — only
+rebinding writes it), `id()`-keyed field identity (weakref-finalized where
+possible), and Eraser's deliberate write-then-unordered-read blind spot.
+See docs/STATIC_ANALYSIS.md § dynamic sanitizer.
+"""
+
+from __future__ import annotations
+
+import itertools
+import queue
+import threading
+import traceback
+import weakref
+from typing import Dict, FrozenSet, List, Optional, Tuple
+
+from pytorchvideo_accelerate_tpu.utils import sync
+
+_STACK_LIMIT = 12  # frames kept on a report (innermost last)
+
+# Eraser field states
+_EXCLUSIVE = 0   # touched by one thread only (or freshly transferred)
+_SHARED = 1      # read by >1 thread, no unordered write seen yet
+_SHARED_MOD = 2  # written while shared: lockset empties == race
+
+
+def _stack(skip: int = 2) -> List[str]:
+    """Trimmed formatted stack of the calling thread (report payloads)."""
+    frames = traceback.format_stack()[:-skip]
+    return [ln.rstrip() for ln in frames[-_STACK_LIMIT:]]
+
+
+class TsanLock:
+    """Tracking twin of threading.Lock/RLock: delegates to a raw primitive
+    and notifies the runtime on acquire/release (lockset + order graph).
+    Condition-compatible (acquire/release/_is_owned)."""
+
+    __slots__ = ("name", "reentrant", "_raw", "_rt")
+
+    def __init__(self, name: str, rt: "Tsan", reentrant: bool):
+        self.name = name
+        self.reentrant = reentrant
+        self._raw = threading.RLock() if reentrant else threading.Lock()
+        self._rt = rt
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._raw.acquire(blocking, timeout)
+        if ok:
+            self._rt.note_acquire(self)
+        return ok
+
+    def release(self) -> None:
+        self._rt.note_release(self)
+        self._raw.release()
+
+    def __enter__(self) -> "TsanLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.release()
+        return False
+
+    def locked(self) -> bool:
+        raw = self._raw
+        if hasattr(raw, "locked"):
+            return raw.locked()
+        return self._is_owned()  # pragma: no cover - old-RLock fallback
+
+    def _is_owned(self) -> bool:
+        """threading.Condition support."""
+        raw = self._raw
+        if hasattr(raw, "_is_owned"):
+            return raw._is_owned()
+        if raw.acquire(False):
+            raw.release()
+            return False
+        return True
+
+    def _release_save(self):
+        """threading.Condition.wait() support: fully release the mutex —
+        ALL recursion levels on an RLock, where Condition's plain-release
+        fallback would drop only one and deadlock the armed run where the
+        disarmed (raw-RLock) run works. The sanitizer forgets the lock
+        entirely: a thread blocked in wait() holds nothing."""
+        count = self._rt.note_release_save(self)
+        raw = self._raw
+        if hasattr(raw, "_release_save"):
+            return raw._release_save(), count
+        raw.release()
+        return None, count
+
+    def _acquire_restore(self, state):
+        saved, count = state
+        raw = self._raw
+        if hasattr(raw, "_acquire_restore"):
+            raw._acquire_restore(saved)
+        else:
+            raw.acquire()
+        self._rt.note_acquire_restore(self, count)
+
+
+class _TsanThread(threading.Thread):
+    """make_thread twin: start()/join() carry happens-before edges."""
+
+    def __init__(self, rt: "Tsan", **kwargs):
+        super().__init__(**kwargs)
+        self._rt = rt
+        self._start_token: Optional[dict] = None
+        self._final_token: Optional[dict] = None
+
+    def start(self) -> None:
+        self._start_token = self._rt.publish()  # parent's writes so far
+        super().start()
+
+    def run(self) -> None:
+        if self._start_token is not None:
+            self._rt.acquire_token(self._start_token)
+        try:
+            super().run()
+        finally:
+            self._final_token = self._rt.publish()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        super().join(timeout)
+        if not self.is_alive() and self._final_token is not None:
+            self._rt.acquire_token(self._final_token)
+
+
+class _TsanQueue(queue.Queue):
+    """make_queue twin: every item rides with the producer's clock snapshot;
+    the consumer joins it at get() — put→get is a happens-before edge."""
+
+    def __init__(self, rt: "Tsan", maxsize: int = 0):
+        self._rt = rt
+        super().__init__(maxsize)
+
+    def _put(self, item) -> None:  # runs in the producer, under the q mutex
+        super()._put((self._rt.publish(), item))
+
+    def _get(self):  # runs in the consumer, under the q mutex
+        token, item = super()._get()
+        self._rt.acquire_token(token)
+        return item
+
+
+class _ThreadState:
+    """Per-thread sanitizer state (vector clock + held locks)."""
+
+    __slots__ = ("tid", "name", "vc", "held")
+
+    def __init__(self, tid: int, name: str):
+        self.tid = tid
+        self.name = name
+        # own component starts at 1: an unrelated thread's vc reads 0 for
+        # us, so our epoch-0 writes must still compare as UNordered
+        self.vc: Dict[int, int] = {tid: 1}
+        self.held: List[List] = []  # [TsanLock, recursion_count]
+
+
+class _FieldState:
+    """Eraser state for one (object, attribute)."""
+
+    __slots__ = ("state", "owner", "lockset", "write_tid", "write_clk",
+                 "write_thread", "write_op_locked", "reads")
+
+    def __init__(self, owner: int):
+        self.state = _EXCLUSIVE
+        self.owner = owner
+        self.lockset: Optional[FrozenSet[int]] = None  # None == universal
+        self.write_tid: Optional[int] = None
+        self.write_clk = 0
+        self.write_thread = ""
+        self.write_op_locked = False
+        self.reads: Dict[int, int] = {}  # tid -> clock at read
+
+
+class Tsan:
+    """One sanitizer run: arm → exercise code → disarm → collect()."""
+
+    def __init__(self):
+        # RLock: note_acquire runs inside lock.acquire, and a gauge/report
+        # path could re-enter through instrumented attribute access
+        self._glock = threading.RLock()
+        self._tls = threading.local()
+        self._tids = itertools.count(1)
+        self._threads: Dict[int, _ThreadState] = {}
+        self._fields: Dict[Tuple[int, str, str], _FieldState] = {}
+        # (from_name, to_name) -> first-observation evidence
+        self._edges: Dict[Tuple[str, str], dict] = {}
+        self.races: List[dict] = []
+        self.suppressed: List[dict] = []
+        self._reported: set = set()
+        self._armed = False
+        self._patched: List[tuple] = []
+        self.access_count = 0
+
+    # --- arming -------------------------------------------------------------
+
+    def arm(self) -> "Tsan":
+        """Install into utils/sync and instrument every @shared_state class.
+        One runtime may be armed at a time (the factory has one hook)."""
+        with self._glock:
+            if self._armed:
+                return self
+            current = sync.get_runtime()
+            if current is not None and current is not self:
+                raise RuntimeError(
+                    "another pva-tpu-tsan runtime is already armed")
+            self._armed = True
+            sync.set_runtime(self)
+            for cls in sync.shared_classes():
+                self._instrument_class(cls)
+        return self
+
+    def disarm(self) -> "Tsan":
+        """Restore the factory and every patched class; findings survive."""
+        with self._glock:
+            if not self._armed:
+                return self
+            self._armed = False
+            sync.set_runtime(None)
+            for cls, had_get, orig_get, had_set, orig_set in self._patched:
+                if had_get:
+                    cls.__getattribute__ = orig_get  # pragma: no cover
+                else:
+                    type.__delattr__(cls, "__getattribute__")
+                if had_set:
+                    cls.__setattr__ = orig_set  # pragma: no cover
+                else:
+                    type.__delattr__(cls, "__setattr__")
+            self._patched = []
+        return self
+
+    def instrument_class(self, cls: type) -> None:
+        """Late registration: a @shared_state class whose module imports
+        AFTER arm() (the CLI imports the threaded layers lazily) is
+        instrumented the moment the decorator runs."""
+        with self._glock:
+            if not self._armed:
+                return
+            if any(p[0] is cls for p in self._patched):
+                return
+            self._instrument_class(cls)
+
+    def _instrument_class(self, cls: type) -> None:
+        fields = cls.__pva_shared_fields__
+        had_get = "__getattribute__" in cls.__dict__
+        had_set = "__setattr__" in cls.__dict__
+        orig_get = cls.__getattribute__
+        orig_set = cls.__setattr__
+        rt = self
+
+        def __getattribute__(obj, name):
+            if name in fields:
+                rt.record(obj, name, is_write=False)
+            return orig_get(obj, name)
+
+        def __setattr__(obj, name, value):
+            if name in fields:
+                rt.record(obj, name, is_write=True)
+            orig_set(obj, name, value)
+
+        cls.__getattribute__ = __getattribute__
+        cls.__setattr__ = __setattr__
+        self._patched.append((cls, had_get, orig_get, had_set, orig_set))  # pva: disable=lock-discipline -- every caller (arm, instrument_class) already holds self._glock
+
+    # --- factory wrappers (called via utils/sync while armed) ---------------
+
+    def wrap_lock(self, name: str, reentrant: bool) -> TsanLock:
+        return TsanLock(name, self, reentrant)
+
+    def wrap_thread(self, **kwargs) -> _TsanThread:
+        return _TsanThread(self, **kwargs)
+
+    def wrap_queue(self, maxsize: int = 0) -> _TsanQueue:
+        return _TsanQueue(self, maxsize)
+
+    # --- per-thread state / vector clocks -----------------------------------
+
+    def _state(self) -> _ThreadState:
+        st = getattr(self._tls, "state", None)
+        if st is None:
+            st = _ThreadState(next(self._tids),
+                              threading.current_thread().name)
+            self._tls.state = st
+            with self._glock:
+                self._threads[st.tid] = st
+        return st
+
+    def publish(self) -> dict:
+        """Return a snapshot token, then tick this thread's clock; whoever
+        `acquire_token`s it is ordered after everything we did BEFORE the
+        publish — and nothing after it. Snapshot-then-tick matters: ticking
+        first would stamp the token with the same clock as our NEXT writes,
+        making a parent's post-start() mutation compare as ordered-before
+        the child (a silently missed race)."""
+        st = self._state()
+        token = dict(st.vc)
+        st.vc[st.tid] = st.vc.get(st.tid, 0) + 1
+        return token
+
+    def acquire_token(self, token: dict) -> None:
+        st = self._state()
+        vc = st.vc
+        for tid, clk in token.items():
+            if vc.get(tid, 0) < clk:
+                vc[tid] = clk
+
+    # --- lock tracking ------------------------------------------------------
+
+    def note_acquire(self, lock: TsanLock) -> None:
+        st = self._state()
+        for entry in st.held:
+            if entry[0] is lock:  # reentrant re-acquire: no new edges
+                entry[1] += 1
+                return
+        if st.held:
+            with self._glock:
+                for held, _ in st.held:
+                    if held.name == lock.name:
+                        continue  # same lock class (two instances): skip
+                    edge = (held.name, lock.name)
+                    ev = self._edges.get(edge)
+                    if ev is None:
+                        self._edges[edge] = {
+                            "count": 1, "thread": st.name,
+                            "stack": _stack(skip=3)}
+                    else:
+                        ev["count"] += 1
+        st.held.append([lock, 1])
+
+    def note_release(self, lock: TsanLock) -> None:
+        st = self._state()
+        for i in range(len(st.held) - 1, -1, -1):
+            if st.held[i][0] is lock:
+                st.held[i][1] -= 1
+                if st.held[i][1] == 0:
+                    del st.held[i]
+                return
+
+    def note_release_save(self, lock: TsanLock) -> int:
+        """Condition.wait() released every recursion level at once: drop
+        the whole held entry, return its count for the restore."""
+        st = self._state()
+        for i in range(len(st.held) - 1, -1, -1):
+            if st.held[i][0] is lock:
+                count = st.held[i][1]
+                del st.held[i]
+                return count
+        return 1
+
+    def note_acquire_restore(self, lock: TsanLock, count: int) -> None:
+        """Re-held after wait(): records order edges against whatever the
+        thread now holds, then restores the saved recursion count."""
+        self.note_acquire(lock)
+        st = self._state()
+        for entry in st.held:
+            if entry[0] is lock:
+                entry[1] = count
+                return
+
+    # --- the Eraser + HB core -----------------------------------------------
+
+    def record(self, obj, field: str, is_write: bool) -> None:
+        """One instrumented shared-attribute access."""
+        st = self._state()
+        cls = type(obj)
+        key = (id(obj), cls.__name__, field)
+        now_clk = st.vc.get(st.tid, 0)
+        with self._glock:
+            if not self._armed:
+                return
+            self.access_count += 1
+            fs = self._fields.get(key)
+            if fs is None:
+                fs = _FieldState(owner=st.tid)
+                self._fields[key] = fs
+                # id()s recycle: drop the entry when the object dies, so a
+                # fresh object at a reused address starts EXCLUSIVE instead
+                # of inheriting a dead object's shared/epoch state (also
+                # bounds _fields for object-churning runs). _glock is an
+                # RLock, so a finalizer firing under our own lock is safe.
+                try:
+                    weakref.finalize(obj, self._forget, key)
+                except TypeError:  # no __weakref__ slot: keep the entry
+                    pass
+                self._update_epochs(fs, st, now_clk, is_write)
+                return
+            if fs.state == _EXCLUSIVE and fs.owner == st.tid:
+                self._update_epochs(fs, st, now_clk, is_write)
+                return
+            # HB check: ordered after the last write (reads and writes),
+            # and — for a write — after every read since that write
+            vc = st.vc
+            ordered = (fs.write_tid is None or fs.write_tid == st.tid
+                       or vc.get(fs.write_tid, 0) >= fs.write_clk)
+            if ordered and is_write:
+                ordered = all(tid == st.tid or vc.get(tid, 0) >= clk
+                              for tid, clk in fs.reads.items())
+            if ordered:
+                # ownership transfer (queue handoff, start/join): the field
+                # returns to Exclusive under its new owner, candidates reset
+                fs.state = _EXCLUSIVE
+                fs.owner = st.tid
+                fs.lockset = None
+            else:
+                held = frozenset(id(entry[0]) for entry in st.held)
+                fs.lockset = (held if fs.lockset is None
+                              else fs.lockset & held)
+                if fs.state == _EXCLUSIVE:
+                    fs.state = _SHARED_MOD if is_write else _SHARED
+                elif is_write:
+                    fs.state = _SHARED_MOD
+                if (fs.state == _SHARED_MOD and not fs.lockset
+                        and key not in self._reported):
+                    self._reported.add(key)
+                    self._report_race(cls, field, fs, st, is_write)
+            self._update_epochs(fs, st, now_clk, is_write)
+
+    def _forget(self, key: Tuple[int, str, str]) -> None:
+        """weakref.finalize callback: the tracked object died."""
+        with self._glock:
+            self._fields.pop(key, None)
+
+    @staticmethod
+    def _update_epochs(fs: _FieldState, st: _ThreadState, clk: int,
+                       is_write: bool) -> None:
+        if is_write:
+            fs.write_tid = st.tid
+            fs.write_clk = clk
+            fs.write_thread = st.name
+            fs.write_op_locked = bool(st.held)
+            fs.reads = {}
+        else:
+            fs.reads[st.tid] = clk
+
+    def _report_race(self, cls: type, field: str, fs: _FieldState,
+                     st: _ThreadState, is_write: bool) -> None:
+        finding = {
+            "kind": "race",
+            "field": f"{cls.__name__}.{field}",
+            "op": "write" if is_write else "read",
+            "thread": st.name,
+            "locks_held": sorted(e[0].name for e in st.held),
+            "last_write_thread": fs.write_thread,
+            "last_write_locked": fs.write_op_locked,
+            "stack": _stack(skip=4),
+        }
+        reason = cls.__pva_benign_fields__.get(field)
+        if reason is not None:
+            finding["suppressed_reason"] = reason
+            self.suppressed.append(finding)
+        else:
+            self.races.append(finding)
+
+    # --- lock-order cycles --------------------------------------------------
+
+    def lock_cycles(self) -> List[dict]:
+        """Every distinct cycle in the acquisition-order graph, with the
+        first-observation stack of each edge (the `both stacks` evidence)."""
+        with self._glock:
+            edges = dict(self._edges)
+        adj: Dict[str, List[str]] = {}
+        for a, b in edges:
+            adj.setdefault(a, []).append(b)
+        seen: set = set()
+        cycles: List[dict] = []
+        for a, b in edges:
+            # BFS for a path b -> ... -> a closes the cycle through (a, b)
+            path = self._find_path(adj, b, a)
+            if path is None:
+                continue
+            cyc = [a] + path  # a -> b -> ... -> a
+            # canonical rotation (cycle nodes minus the repeated tail)
+            nodes = tuple(cyc[:-1])
+            k = nodes.index(min(nodes))
+            canon = nodes[k:] + nodes[:k]
+            if canon in seen:
+                continue
+            seen.add(canon)
+            cycles.append({
+                "kind": "lock-cycle",
+                "cycle": " -> ".join(cyc),
+                "edges": [
+                    {"edge": f"{x} -> {y}", **edges[(x, y)]}
+                    for x, y in zip(cyc, cyc[1:])
+                ],
+            })
+        return cycles
+
+    @staticmethod
+    def _find_path(adj: Dict[str, List[str]], src: str,
+                   dst: str) -> Optional[List[str]]:
+        """Shortest node path src..dst (inclusive) or None."""
+        if src == dst:
+            return [src]
+        prev: Dict[str, str] = {src: src}
+        frontier = [src]
+        while frontier:
+            nxt = []
+            for node in frontier:
+                for n in adj.get(node, ()):
+                    if n in prev:
+                        continue
+                    prev[n] = node
+                    if n == dst:
+                        path = [n]
+                        while path[-1] != src:
+                            path.append(prev[path[-1]])
+                        return list(reversed(path))
+                    nxt.append(n)
+            frontier = nxt
+        return None
+
+    # --- reporting ----------------------------------------------------------
+
+    def collect(self) -> dict:
+        """The run's findings: races, lock cycles, suppressed (benign)
+        races, and the raw graph/traffic counters for the report."""
+        cycles = self.lock_cycles()
+        with self._glock:
+            return {
+                "races": list(self.races),
+                "cycles": cycles,
+                "suppressed": list(self.suppressed),
+                "lock_order_edges": len(self._edges),
+                "fields_tracked": len(self._fields),
+                "accesses": self.access_count,
+                "threads": len(self._threads),
+            }
+
+    def snapshot(self) -> dict:
+        """Live view for the doctor: armed?, the current lock-order graph,
+        held locks per thread, finding counts."""
+        with self._glock:
+            edges = sorted(f"{a} -> {b}" for a, b in self._edges)
+            held = {
+                f"{st.name}-{tid}": [e[0].name for e in st.held]
+                for tid, st in self._threads.items() if st.held
+            }
+            return {
+                "armed": self._armed,
+                "lock_order_edges": edges,
+                "held_locks": held,
+                "races": len(self.races),
+                "suppressed": len(self.suppressed),
+                "race_heads": [r["field"] for r in self.races[:10]],
+            }
+
+
+# --- module-level current runtime (doctor / CLI share one view) -------------
+
+_current: Optional[Tsan] = None
+
+
+def arm() -> Tsan:
+    """Create+arm a fresh runtime (disarming any previous one) and remember
+    it as the module's current instance."""
+    global _current
+    if _current is not None:
+        _current.disarm()
+    _current = Tsan()
+    return _current.arm()
+
+
+def disarm() -> Optional[Tsan]:
+    if _current is not None:
+        _current.disarm()
+    return _current
+
+
+def get_tsan() -> Optional[Tsan]:
+    """The most recent runtime (armed or already disarmed), or None if no
+    sanitizer ran in this process."""
+    return _current

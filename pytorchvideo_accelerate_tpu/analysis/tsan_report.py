@@ -1,0 +1,505 @@
+"""pva-tpu-tsan front: stress scenario, report plumbing, console script.
+
+Three jobs:
+
+- **Bundled stress scenario** (`run_stress`): arm the sanitizer, then
+  exercise every threaded layer the way production does — prefetcher churn
+  over a synthetic loader (full epoch + mid-flight break), a concurrent
+  micro-batcher with a mid-flight close, TrackerHub fan-out with a raising
+  tracker (the disable-on-failure path), flight-recorder record/dump
+  re-entrancy, and a forced watchdog stall — and report what the run
+  proved. Zero findings on this scenario is a CI gate (`bench.py --smoke`,
+  `scripts/analyze.sh`), same contract as `pva-tpu-lint`.
+- **Report plumbing** (`publish`/`tsan_snapshot`): findings land in the
+  obs registry (`pva_tsan_races`, `pva_tsan_lock_cycles` gauges), the
+  flight-recorder ring, and `pva-tpu-doctor diagnose()`.
+- **`pva-tpu-tsan` CLI**: runs the scenario (exit 0 clean / 1 findings /
+  2 usage) or `--selftest` (the seeded race + seeded ABBA cycle fixtures
+  MUST be detected — exit 0 iff the sanitizer still has teeth).
+
+The scenario swaps FRESH obs singletons (collector, recorder) in for its
+duration: instances created before arming hold raw, untracked locks, and
+accesses guarded by an invisible lock would read as unguarded (a false
+positive by construction, not evidence).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import tempfile
+import time
+from typing import Callable, List, Optional
+
+from pytorchvideo_accelerate_tpu.analysis import tsan as tsan_mod
+from pytorchvideo_accelerate_tpu.utils.sync import (
+    make_lock,
+    make_queue,
+    make_thread,
+    shared_state,
+)
+
+
+# --- seeded fixtures (the sanitizer's own regression teeth) -----------------
+
+@shared_state("counter")
+class _RaceFixture:
+    """Deliberately broken: two threads increment `counter` bare."""
+
+    def __init__(self):
+        self.counter = 0
+
+
+def seeded_race(rounds: int = 200) -> dict:
+    """A textbook unsynchronized read-modify-write; the report MUST carry a
+    race on `_RaceFixture.counter`."""
+    rt = tsan_mod.arm()
+    try:
+        fx = _RaceFixture()
+
+        def bump():
+            for _ in range(rounds):
+                fx.counter += 1
+
+        ts = [make_thread(target=bump, name=f"race-{i}", daemon=True)
+              for i in range(2)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        rt.disarm()
+    return rt.collect()
+
+
+def seeded_lock_cycle() -> dict:
+    """A -> B in one thread, B -> A in another: the classic ABBA order
+    inversion; the report MUST carry a lock cycle."""
+    rt = tsan_mod.arm()
+    try:
+        la = make_lock("tsan-fixture.A")
+        lb = make_lock("tsan-fixture.B")
+
+        def ab():
+            with la:
+                with lb:
+                    pass
+
+        def ba():
+            with lb:
+                with la:
+                    pass
+
+        # sequential threads: the ORDER graph records the inversion without
+        # risking an actual deadlock in the test process
+        for fn, name in ((ab, "abba-1"), (ba, "abba-2")):
+            t = make_thread(target=fn, name=name, daemon=True)
+            t.start()
+            t.join()
+    finally:
+        rt.disarm()
+    return rt.collect()
+
+
+def queue_handoff_fixture(rounds: int = 50) -> dict:
+    """Ownership transfer through a queue — the pattern the prefetcher and
+    batcher live on. MUST report zero findings (put→get happens-before)."""
+    rt = tsan_mod.arm()
+    try:
+        q = make_queue()
+
+        def produce():
+            for _ in range(rounds):
+                fx = _RaceFixture()
+                fx.counter = 1  # producer writes...
+                q.put(fx)
+            q.put(None)
+
+        t = make_thread(target=produce, name="handoff-producer", daemon=True)
+        t.start()
+        while True:
+            fx = q.get()
+            if fx is None:
+                break
+            fx.counter += 1  # ...consumer reads+writes after the handoff
+        t.join()
+    finally:
+        rt.disarm()
+    return rt.collect()
+
+
+# --- the bundled stress scenario --------------------------------------------
+
+class _StubEngine:
+    """MicroBatcher-facing engine double: bucket geometry + a host-side
+    forward, so the batcher/stats layers run full speed without jax."""
+
+    def __init__(self, num_classes: int = 4):
+        import numpy as np
+
+        self._np = np
+        self.buckets = (2, 4)
+        self.num_classes = num_classes
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds {self.buckets[-1]}")
+
+    def predict(self, batch):
+        time.sleep(0.001)  # a forward takes time; lets flushes coalesce
+        n = next(iter(batch.values())).shape[0]
+        return self._np.zeros((n, self.num_classes), self._np.float32)
+
+
+def _tiny_transform(frames, rng=None):
+    """(T, H, W, 3) uint8 -> a float32 'video' leaf, small enough that the
+    whole scenario moves kilobytes, not megabytes."""
+    import numpy as np
+
+    return {"video": (frames[:4, :8, :8, :].astype(np.float32) / 255.0)}
+
+
+def _stress_prefetcher(watchdog, log: Callable[[str], None]) -> None:
+    """Prefetcher churn: full epoch, then a mid-flight break (the shutdown
+    path: stop flag, worker join, queue drain) — twice over for rollover."""
+    from pytorchvideo_accelerate_tpu.data.device_prefetch import (
+        DevicePrefetcher,
+    )
+    from pytorchvideo_accelerate_tpu.data.pipeline import (
+        ClipLoader,
+        SyntheticClipSource,
+    )
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh()
+    source = SyntheticClipSource(_tiny_transform, num_videos=16,
+                                 num_classes=4, raw_frames=4, raw_size=(8, 8))
+    loader = ClipLoader(source, global_batch_size=8, shuffle=True,
+                        num_workers=2, prefetch_batches=1)
+    pf = DevicePrefetcher(loader, mesh, depth=2, watchdog=watchdog)
+    try:
+        n = 0
+        for _ in pf.epoch(0):
+            n += 1
+        log(f"[tsan] prefetcher epoch complete ({n} batches)")
+        for _ in pf.epoch(1):
+            break  # mid-flight shutdown: generator close tears down worker
+        _ = pf.pop_wait(), pf.max_resident
+    finally:
+        loader.close()
+
+
+def _stress_batcher(watchdog, log: Callable[[str], None]) -> None:
+    """Concurrent submitters against one flush thread, snapshots racing the
+    traffic, then a mid-flight close with requests still queued."""
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.serving.batcher import MicroBatcher
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+
+    stats = ServingStats(window=64)
+    mb = MicroBatcher(_StubEngine(), max_wait_ms=1.0, max_queue=64,
+                      stats=stats,
+                      heartbeat=(watchdog.beat_fn("serve_batcher")
+                                 if watchdog else None))
+    stats.queue_depth_fn = mb.queue_depth
+    clip = {"video": np.zeros((2, 4, 4, 3), np.float32)}
+    errors: List[str] = []
+
+    def client(k: int):
+        for i in range(8):
+            try:
+                fut = mb.submit(clip)
+                if i % 2 == 0:
+                    fut.result(timeout=5.0)
+            except Exception as e:  # noqa: BLE001 - late submits hit close()
+                errors.append(f"{type(e).__name__}")
+                return
+
+    def snapshotter():
+        for _ in range(6):
+            stats.snapshot()
+            time.sleep(0.002)
+
+    ts = [make_thread(target=client, args=(k,), name=f"serve-client-{k}",
+                      daemon=True) for k in range(3)]
+    ts.append(make_thread(target=snapshotter, name="stats-snapshotter",
+                          daemon=True))
+    for t in ts:
+        t.start()
+    time.sleep(0.02)
+    mb.close()  # mid-flight: pending requests fail, not hang
+    for t in ts:
+        t.join(timeout=10.0)
+    snap = stats.snapshot()
+    log(f"[tsan] batcher churn: {int(snap['requests'])} served, "
+        f"{len(errors)} submits hit the close")
+
+
+def _stress_trackers(log: Callable[[str], None]) -> None:
+    """TrackerHub fan-out from two threads with a tracker that raises: the
+    disable-on-failure path mutates the tracker list under traffic."""
+    from pytorchvideo_accelerate_tpu.trainer.tracking import Tracker, TrackerHub
+
+    class _Boom(Tracker):
+        name = "boom"
+
+        def start(self, run_name, config):
+            pass
+
+        def log(self, values, step):
+            raise RuntimeError("tracker deliberately failing")
+
+    class _Count(Tracker):
+        name = "count"
+
+        def __init__(self):
+            self.n = 0
+
+        def start(self, run_name, config):
+            pass
+
+        def log(self, values, step):
+            self.n += 1
+
+    hub = TrackerHub("", logging_dir="")
+    counter = _Count()
+    hub.trackers.extend([_Boom(), counter])
+
+    def logs(k: int):
+        for i in range(10):
+            hub.log({"x": float(i)}, step=k * 10 + i)
+
+    ts = [make_thread(target=logs, args=(k,), name=f"tracker-{k}",
+                      daemon=True) for k in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    hub.finish()
+    log(f"[tsan] tracker fan-out survived a raising tracker "
+        f"({counter.n} logs reached the healthy one)")
+
+
+def _stress_recorder_watchdog(tmpdir: str,
+                              log: Callable[[str], None]):
+    """Flight-recorder churn + dump re-entrancy, and a watchdog whose stall
+    path is forced deterministically (no real 30s hang needed). The forced
+    stall fires while the churn threads are mid-flight, so the dump path
+    (watchdog lock -> ring lock -> collector lock) genuinely races live
+    recorder traffic. The returned watchdog is still RUNNING with a fast
+    poll so check() keeps executing concurrently with the batcher and
+    prefetcher legs — the caller owns wd.stop()."""
+    from pytorchvideo_accelerate_tpu import obs
+    from pytorchvideo_accelerate_tpu.obs.flight_recorder import FlightRecorder
+
+    rec = FlightRecorder(capacity=64)
+    wd = obs.Watchdog(30.0, output_dir=tmpdir, recorder=rec,
+                      collector=obs.get_collector(), poll_s=0.02).start()
+    wd.heartbeat("main")
+
+    def churn(k: int):
+        for i in range(40):
+            rec.record("span", f"stress-{k}", i=i)
+        rec.dump(f"{tmpdir}/flight_{k}.json")
+
+    ts = [make_thread(target=churn, args=(k,), name=f"recorder-{k}",
+                      daemon=True) for k in range(2)]
+    for t in ts:
+        t.start()
+    # forced stall: pretend 2 minutes elapsed — exercises the stall dump
+    # (stderr stacks + ring dump) against the still-running churn threads
+    stalled = wd.check(now=time.monotonic() + 120.0)
+    for t in ts:
+        t.join()
+    rec.set_capacity(32)
+    wd.heartbeat("main")  # recovery re-arms the one-shot
+    log(f"[tsan] watchdog forced-stall fired for {stalled}; "
+        f"ring at {len(rec.snapshot())} events")
+    return wd
+
+
+def run_stress(smoke: bool = True,
+               log: Optional[Callable[[str], None]] = None) -> dict:
+    """Arm, run every layer's stress leg, disarm, return the report dict:
+    {races, cycles, suppressed, lock_order_edges, fields_tracked, ...}.
+
+    `smoke` keeps shapes/iterations tiny (the CI lane); the full mode just
+    repeats the churn legs for more interleavings.
+    """
+    from pytorchvideo_accelerate_tpu.obs import flight_recorder, spans
+
+    log = log or (lambda msg: None)
+    rounds = 1 if smoke else 3
+    t0 = time.perf_counter()
+    rt = tsan_mod.arm()
+    # fresh obs singletons: pre-arm instances hold raw (untracked) locks,
+    # which would make their guarded accesses look unguarded — swap in
+    # factory-built twins for the scenario, restore after
+    old_collector, old_recorder = spans._DEFAULT, flight_recorder._DEFAULT
+    try:
+        flight_recorder._DEFAULT = flight_recorder.FlightRecorder()
+        spans._DEFAULT = spans.SpanCollector(
+            enabled=True, recorder=flight_recorder._DEFAULT)
+        with tempfile.TemporaryDirectory(prefix="pva_tsan_") as tmpdir:
+            for _ in range(rounds):
+                wd = _stress_recorder_watchdog(tmpdir, log)
+                try:
+                    # live watchdog: its poll thread runs check() every
+                    # 20ms concurrently with the legs' heartbeats/churn
+                    _stress_batcher(wd, log)
+                    _stress_trackers(log)
+                    _stress_prefetcher(wd, log)
+                finally:
+                    wd.stop()
+            # drain the scenario collector the way the trainer would
+            spans._DEFAULT.pop_window()
+    finally:
+        spans._DEFAULT, flight_recorder._DEFAULT = old_collector, old_recorder
+        rt.disarm()
+    report = rt.collect()
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    report["smoke"] = bool(smoke)
+    log(f"[tsan] scenario done in {report['elapsed_s']}s: "
+        f"{len(report['races'])} race(s), {len(report['cycles'])} "
+        f"cycle(s), {len(report['suppressed'])} suppressed, "
+        f"{report['accesses']} accesses over {report['fields_tracked']} "
+        f"fields, {report['lock_order_edges']} lock-order edges")
+    return report
+
+
+# --- report plumbing --------------------------------------------------------
+
+def finding_count(report: dict) -> int:
+    """What the CI gates count: hard findings only (suppressed/benign races
+    are auditable, not fatal — same stance as lint suppressions)."""
+    return len(report.get("races", ())) + len(report.get("cycles", ()))
+
+
+def publish(report: dict) -> None:
+    """Mirror a report into the process obs spine: gauges in the default
+    registry + one flight-ring event per finding (crash dumps then carry
+    the sanitizer's verdict alongside the timeline)."""
+    from pytorchvideo_accelerate_tpu import obs
+
+    reg = obs.get_registry()
+    reg.gauge("pva_tsan_races",
+              "data races found by the last pva-tpu-tsan run").set(
+                  len(report.get("races", ())))
+    reg.gauge("pva_tsan_lock_cycles",
+              "lock-order cycles found by the last pva-tpu-tsan run").set(
+                  len(report.get("cycles", ())))
+    rec = obs.get_recorder()
+    for r in report.get("races", ()):
+        rec.record("tsan", "race", field=r["field"], thread=r["thread"],
+                   op=r["op"])
+    for c in report.get("cycles", ()):
+        rec.record("tsan", "lock-cycle", cycle=c["cycle"])
+
+
+def tsan_snapshot() -> dict:
+    """Doctor view (`pva-tpu-doctor` diagnose()): the current/last runtime's
+    lock-order graph, live held locks per thread, and finding counts."""
+    rt = tsan_mod.get_tsan()
+    if rt is None:
+        return {"armed": False, "ran": False}
+    out = rt.snapshot()
+    out["ran"] = True
+    out["cycles"] = len(rt.lock_cycles())
+    return out
+
+
+def format_report(report: dict, max_stack: int = 6) -> str:
+    lines: List[str] = []
+    for r in report.get("races", ()):
+        lines.append(
+            f"RACE {r['field']}: {r['op']} by {r['thread']} holding "
+            f"{r['locks_held'] or 'no locks'}; last write by "
+            f"{r['last_write_thread']} "
+            f"({'locked' if r['last_write_locked'] else 'bare'})")
+        lines.extend("    " + ln for ln in r["stack"][-max_stack:])
+    for c in report.get("cycles", ()):
+        lines.append(f"LOCK CYCLE {c['cycle']}")
+        for e in c["edges"]:
+            lines.append(f"    edge {e['edge']} (seen {e['count']}x, "
+                         f"first by {e['thread']}):")
+            lines.extend("        " + ln for ln in e["stack"][-max_stack:])
+    for s in report.get("suppressed", ()):
+        lines.append(f"suppressed (benign) {s['field']}: "
+                     f"{s['suppressed_reason']}")
+    lines.append(
+        f"pva-tpu-tsan: {finding_count(report)} finding(s) — "
+        f"{len(report.get('races', ()))} race(s), "
+        f"{len(report.get('cycles', ()))} lock cycle(s), "
+        f"{len(report.get('suppressed', ()))} suppressed; "
+        f"{report.get('accesses', 0)} accesses, "
+        f"{report.get('lock_order_edges', 0)} lock-order edges")
+    return "\n".join(lines)
+
+
+# --- CLI --------------------------------------------------------------------
+
+def selftest(log: Callable[[str], None]) -> int:
+    """The sanitizer must still catch what it exists to catch: seeded race
+    detected, seeded ABBA cycle detected, queue handoff NOT flagged."""
+    ok = True
+    r = seeded_race()
+    if not any("_RaceFixture.counter" in x["field"] for x in r["races"]):
+        log("FAIL: seeded data race not detected")
+        ok = False
+    c = seeded_lock_cycle()
+    if not c["cycles"]:
+        log("FAIL: seeded ABBA lock cycle not detected")
+        ok = False
+    h = queue_handoff_fixture()
+    if finding_count(h):
+        log("FAIL: queue handoff false-alarmed")
+        ok = False
+    log("selftest: " + ("ok (race detected, cycle detected, handoff clean)"
+                        if ok else "FAILED"))
+    return 0 if ok else 1
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pva-tpu-tsan",
+        description="dynamic lockset race + lock-order deadlock sanitizer "
+                    "over the threaded data/train/serve layers; see "
+                    "docs/STATIC_ANALYSIS.md")
+    ap.add_argument("--smoke", action="store_true",
+                    help="one round of the stress scenario (the CI lane)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="verify the sanitizer still detects its seeded "
+                         "race/cycle fixtures (and stays quiet on the "
+                         "queue-handoff pattern)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    def log(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
+    if args.selftest:
+        return selftest(log)
+
+    # the scenario's device work (prefetcher H2D) must not wedge a CLI run
+    # on a half-attached accelerator: CPU unless the caller overrides
+    import os
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    report = run_stress(smoke=args.smoke, log=log)
+    publish(report)
+    if args.format == "json":
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(format_report(report))
+    return 1 if finding_count(report) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

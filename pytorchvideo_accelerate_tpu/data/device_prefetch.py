@@ -52,11 +52,18 @@ from typing import Any, Iterator, Optional
 from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader
 from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+from pytorchvideo_accelerate_tpu.utils.sync import (
+    make_lock,
+    make_queue,
+    make_thread,
+    shared_state,
+)
 
 _SENTINEL_POLL_S = 0.05  # stop-flag poll cadence for blocking waits
 _JOIN_TIMEOUT_S = 10.0
 
 
+@shared_state("wait_s", "_resident", "max_resident")
 class DevicePrefetcher:
     """Bounded background H2D pipeline over one `ClipLoader`.
 
@@ -95,7 +102,7 @@ class DevicePrefetcher:
         self.watchdog_name = watchdog_name
         self.wait_name = wait_name
         self.h2d_name = h2d_name
-        self._lock = threading.Lock()
+        self._lock = make_lock("DevicePrefetcher._lock")
         self._resident = 0  # placed-but-unconsumed device batches
         self.max_resident = 0  # high-water mark (tests; monotonic per run)
 
@@ -124,11 +131,11 @@ class DevicePrefetcher:
             yield from self._epoch_sync(epoch, from_start)
             return
 
-        q: "queue.Queue[tuple]" = queue.Queue()  # bounded by `slots`, not maxsize
+        q: "queue.Queue[tuple]" = make_queue()  # bounded by `slots`, not maxsize
         stop = threading.Event()
         slots = threading.Semaphore(self.depth)
         items = self.loader.epoch_items(epoch, from_start)
-        worker = threading.Thread(
+        worker = make_thread(
             target=self._worker, args=(items, q, stop, slots),
             name="device-prefetch", daemon=True,
         )

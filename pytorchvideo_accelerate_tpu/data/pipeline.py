@@ -27,7 +27,6 @@ independent deterministic sample.
 from __future__ import annotations
 
 import logging
-import threading
 from concurrent.futures import ThreadPoolExecutor
 from dataclasses import dataclass
 from queue import Empty, Queue
@@ -36,6 +35,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 from pytorchvideo_accelerate_tpu.data import decode as decode_mod
 from pytorchvideo_accelerate_tpu.data.manifest import Manifest
 from pytorchvideo_accelerate_tpu.data.samplers import random_clip, uniform_clips
@@ -135,7 +135,7 @@ class VideoClipSource(ClipSource):
         self.num_clips = max(num_clips, 1) if not training else 1
         self.num_classes = manifest.num_classes
         self._meta_cache: Dict[str, decode_mod.VideoMeta] = {}
-        self._meta_lock = threading.Lock()
+        self._meta_lock = make_lock("VideoClipSource._meta_lock")
         self._failed: set = set()
 
     _MAX_CONSECUTIVE_FAILURES = 10  # pytorchvideo LabeledVideoDataset parity

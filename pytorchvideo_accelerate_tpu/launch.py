@@ -41,6 +41,8 @@ import sys
 import threading
 from typing import List, Optional, Sequence
 
+from pytorchvideo_accelerate_tpu.utils.sync import make_thread
+
 
 def find_free_port() -> int:
     with socket.socket(socket.AF_INET, socket.SOCK_STREAM) as s:
@@ -113,8 +115,8 @@ def _run_group(num_processes: int, prog: List[str], coordinator_address: str,
         else:
             p = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                                  stderr=subprocess.STDOUT)
-            t = threading.Thread(target=_forward, args=(p.stdout, rank),
-                                 daemon=True)
+            t = make_thread(target=_forward, args=(p.stdout, rank),
+                            daemon=True)
             t.start()
             threads.append(t)
         procs.append(p)

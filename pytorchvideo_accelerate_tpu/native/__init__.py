@@ -10,10 +10,10 @@ from __future__ import annotations
 import ctypes
 import os
 import subprocess
-import threading
 from typing import Optional
 
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 
 logger = get_logger("pva_tpu")
 
@@ -25,7 +25,7 @@ _LIB_DIR = os.environ.get(
 )
 _LIB = os.path.join(_LIB_DIR, "libpva_native.so")
 
-_lock = threading.Lock()
+_lock = make_lock("native._lock")
 _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 

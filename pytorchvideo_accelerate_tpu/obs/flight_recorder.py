@@ -30,9 +30,12 @@ import time
 from collections import deque
 from typing import List, Optional
 
+from pytorchvideo_accelerate_tpu.utils.sync import make_rlock, shared_state
+
 _MIN_CAPACITY = 16
 
 
+@shared_state("_events", "_output_dir", "_installed")
 class FlightRecorder:
     """Thread-safe bounded event ring + crash-dump plumbing."""
 
@@ -42,7 +45,7 @@ class FlightRecorder:
         # thread inside record(), a plain lock would deadlock and the
         # process would die to SIGKILL with no flight record (the exact
         # failure this file exists to prevent)
-        self._lock = threading.RLock()
+        self._lock = make_rlock("FlightRecorder._lock")
         self._events: deque = deque(maxlen=max(capacity, _MIN_CAPACITY))
         self._output_dir = ""
         self._installed = False
@@ -74,9 +77,14 @@ class FlightRecorder:
     # --- dumping ----------------------------------------------------------
 
     def default_path(self) -> Optional[str]:
-        if not self._output_dir:
+        # dump() runs on watchdog/excepthook/handler threads while install()
+        # (re)points the output dir from the main thread — same lock as the
+        # ring (pva-tpu-tsan: bare read of `_output_dir` raced `install`)
+        with self._lock:
+            out_dir = self._output_dir
+        if not out_dir:
             return None
-        return os.path.join(self._output_dir, "flight_record.json")
+        return os.path.join(out_dir, "flight_record.json")
 
     def dump(self, path: Optional[str] = None) -> Optional[str]:
         """Write the ring to `path` (default: the installed output dir).
@@ -102,11 +110,12 @@ class FlightRecorder:
         """Point dumps at `output_dir` and (once per process) chain
         sys.excepthook + SIGTERM so an uncaught crash or an external kill
         flushes the ring. Re-installs just update the output dir."""
-        if output_dir:
-            self._output_dir = output_dir
-        if self._installed:
-            return
-        self._installed = True
+        with self._lock:
+            if output_dir:
+                self._output_dir = output_dir
+            if self._installed:
+                return
+            self._installed = True
 
         prev_hook = sys.excepthook
 

@@ -23,8 +23,9 @@ under a lock — nanoseconds against a network request or a train step.
 from __future__ import annotations
 
 import math
-import threading
 from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 
 
 def _fmt(v: float) -> str:
@@ -59,7 +60,10 @@ class _Metric:
         self.name = name
         self.help = help or name
         self.labelnames = tuple(labelnames)
-        self._lock = threading.Lock()
+        # one lock CLASS for every metric instance (lockdep-style): the
+        # sanitizer's order graph cares about metric-lock vs other-lock
+        # ordering, not which of hundreds of counters was involved
+        self._lock = make_lock("registry._Metric._lock")
 
     def _key(self, labels: Dict[str, str]) -> Tuple[str, ...]:
         if set(labels) != set(self.labelnames):
@@ -218,7 +222,7 @@ class Registry:
     name reads the same numbers)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = make_lock("registry.Registry._lock")
         self._metrics: Dict[str, _Metric] = {}
 
     def _get_or_create(self, cls, name: str, *args, **kwargs):

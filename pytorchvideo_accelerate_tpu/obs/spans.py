@@ -30,6 +30,8 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
+
 
 class _Noop:
     __slots__ = ()
@@ -76,6 +78,7 @@ BACKGROUND = frozenset({"h2d", "decode", "serve_flush",
 RECORDER_EXCLUDE = frozenset({"decode"})
 
 
+@shared_state("_window", "recorder")
 class SpanCollector:
     """Thread-safe span aggregator: per-name (total_s, count) windows plus
     per-thread open-span stacks."""
@@ -83,7 +86,7 @@ class SpanCollector:
     def __init__(self, enabled: bool = True, recorder=None):
         self.enabled = enabled
         self.recorder = recorder  # FlightRecorder or None
-        self._lock = threading.Lock()
+        self._lock = make_lock("SpanCollector._lock")
         self._window: Dict[str, list] = {}
         self._tls = threading.local()
         # thread ident -> (thread name, live stack list); stacks are the

@@ -29,7 +29,14 @@ import time
 import traceback
 from typing import Callable, List, Optional
 
+from pytorchvideo_accelerate_tpu.utils.sync import (
+    make_lock,
+    make_thread,
+    shared_state,
+)
 
+
+@shared_state("stall_count", "last_stalled", "_thread")
 class Watchdog:
     """No-progress detector over named heartbeats."""
 
@@ -45,7 +52,7 @@ class Watchdog:
         self.collector = collector    # SpanCollector or None (open spans)
         self.on_stall = on_stall      # test/ops hook, called after the dump
         self._poll_s = poll_s or min(max(self.timeout_s / 4.0, 0.02), 5.0)
-        self._lock = threading.Lock()
+        self._lock = make_lock("Watchdog._lock")
         self._beats = {}   # name -> last monotonic heartbeat
         self._fired = set()  # names already dumped for the current stall
         self._stop = threading.Event()
@@ -81,9 +88,13 @@ class Watchdog:
             # stall dump: never spawn a second one (duplicate dumps)
             return self
         self._stop.clear()  # a stopped watchdog can be restarted
-        self._thread = threading.Thread(
+        thread = make_thread(
             target=self._run, name="pva-watchdog", daemon=True)
-        self._thread.start()
+        # `_thread` is handed between start()/stop() callers (trainer main
+        # thread, serving close path): same lock as the beat table
+        with self._lock:
+            self._thread = thread
+        thread.start()
         return self
 
     def stop(self) -> None:
@@ -92,7 +103,9 @@ class Watchdog:
         if thread is not None:
             thread.join(timeout=self._poll_s * 4 + 1.0)
             if not thread.is_alive():
-                self._thread = None
+                with self._lock:
+                    if self._thread is thread:  # a racing start() may have
+                        self._thread = None     # installed a fresh poller
             # else: keep the handle so start() can see the straggler
 
     def _run(self) -> None:

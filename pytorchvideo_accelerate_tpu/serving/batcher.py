@@ -31,6 +31,11 @@ import numpy as np
 from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, clip_key
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+from pytorchvideo_accelerate_tpu.utils.sync import (
+    make_queue,
+    make_thread,
+    shared_state,
+)
 
 logger = get_logger("pva_tpu")
 
@@ -50,6 +55,7 @@ class _Request:
 _STOP = object()
 
 
+@shared_state("max_batch_size", "max_wait_s", "stats")
 class MicroBatcher:
     """Bounded request queue + flush thread over an `InferenceEngine`."""
 
@@ -67,9 +73,9 @@ class MicroBatcher:
         self.max_batch_size = min(max_batch_size or top, top)
         self.max_wait_s = max(max_wait_ms, 0.0) / 1e3
         self.stats = stats
-        self._q: "queue.Queue" = queue.Queue(maxsize=max(max_queue, 1))
+        self._q: "queue.Queue" = make_queue(maxsize=max(max_queue, 1))
         self._closed = threading.Event()
-        self._thread = threading.Thread(
+        self._thread = make_thread(
             target=self._loop, name="pva-serve-batcher", daemon=True)
         self._thread.start()
 

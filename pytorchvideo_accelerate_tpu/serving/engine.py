@@ -22,7 +22,6 @@ logits (and therefore top-1) match `evaluate()`.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
@@ -38,6 +37,7 @@ from pytorchvideo_accelerate_tpu.trainer.steps import (
     multiview_logits,
 )
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 
 logger = get_logger("pva_tpu")
 
@@ -107,7 +107,7 @@ class InferenceEngine:
         self.params = shard_params(self.mesh, params)
         self.batch_stats = shard_params(self.mesh, batch_stats or {})
         self._fns: Dict[tuple, Callable] = {}
-        self._lock = threading.Lock()
+        self._lock = make_lock("InferenceEngine._lock")
         # set by from_artifact: the training run's resolved TrainConfig
         # (clip geometry for warmup, provenance for /healthz debugging)
         self.artifact_config = None

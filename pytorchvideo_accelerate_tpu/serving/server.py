@@ -193,9 +193,9 @@ class InferenceServer:
 
     def start(self) -> "InferenceServer":
         """Serve on a background thread (tests / embedding)."""
-        import threading
+        from pytorchvideo_accelerate_tpu.utils.sync import make_thread
 
-        self._thread = threading.Thread(
+        self._thread = make_thread(
             target=self.httpd.serve_forever, name="pva-serve-http",
             daemon=True)
         self._thread.start()

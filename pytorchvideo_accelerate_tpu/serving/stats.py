@@ -17,12 +17,12 @@ exposes both the aggregate (`rejected`) and the per-cause split.
 
 from __future__ import annotations
 
-import threading
 import time
 from collections import deque
 from typing import Callable, Dict, Optional, Sequence
 
 from pytorchvideo_accelerate_tpu.obs.registry import DEFAULT_BUCKETS, Registry
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
 
 # request latencies are enqueue -> response: sub-ms (cache-hot tiny model)
 # through multi-second (cold compile, deep queue) — the shared bounds plus
@@ -39,6 +39,7 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> float:
     return float(sorted_vals[idx])
 
 
+@shared_state("queue_depth_fn", "_lat", "_fills")
 class ServingStats:
     """Thread-safe rolling serving metrics.
 
@@ -57,7 +58,7 @@ class ServingStats:
     def __init__(self, window: int = 1024,
                  queue_depth_fn: Optional[Callable[[], int]] = None,
                  registry: Optional[Registry] = None):
-        self._lock = threading.Lock()
+        self._lock = make_lock("ServingStats._lock")
         self._lat = deque(maxlen=max(window, 1))     # (done_ts, latency_s)
         self._fills = deque(maxlen=max(window, 1))   # (n_real, bucket)
         self.queue_depth_fn = queue_depth_fn

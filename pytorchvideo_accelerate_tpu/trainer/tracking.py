@@ -17,6 +17,7 @@ import time
 from typing import Dict, List, Optional
 
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
 
 logger = get_logger("pva_tpu")
 
@@ -144,6 +145,7 @@ def resolve_trackers(spec: str, logging_dir: str) -> List[Tracker]:
     return out
 
 
+@shared_state("trackers")
 class TrackerHub:
     """Fan-out facade: `init_trackers`/`log`/`end_training` equivalents
     (reference run.py:231,274,323). Construct on the main process only.
@@ -151,13 +153,22 @@ class TrackerHub:
     Fan-out is NON-FATAL: a raising tracker (broken tensorboard install,
     wandb network hiccup, full disk under the jsonl file) is warned about
     once and disabled — a logging failure must never kill a training step.
-    The surviving trackers keep logging."""
+    The surviving trackers keep logging.
+
+    The disable path REBINDS `self.trackers` under a lock instead of
+    mutating the live list: `log()` is called from the train loop and from
+    serving/metric threads, and pva-tpu-tsan flagged the old bare
+    `list.remove` racing a concurrent fan-out's iteration copy — two
+    threads disabling at once could resurrect a just-removed tracker."""
 
     def __init__(self, spec: str, logging_dir: str):
+        self._lock = make_lock("TrackerHub._lock")
         self.trackers = resolve_trackers(spec, logging_dir)
 
     def _fanout(self, op: str, fn) -> None:
-        for t in list(self.trackers):
+        with self._lock:
+            trackers = list(self.trackers)
+        for t in trackers:
             try:
                 fn(t)
             except Exception as e:  # noqa: BLE001 - any tracker bug qualifies
@@ -165,10 +176,8 @@ class TrackerHub:
                     "tracker %r raised in %s (%s: %s); disabling it — "
                     "a logging failure must never kill a training step",
                     t.name, op, type(e).__name__, e)
-                try:
-                    self.trackers.remove(t)
-                except ValueError:  # pragma: no cover - already gone
-                    pass
+                with self._lock:
+                    self.trackers = [x for x in self.trackers if x is not t]
                 try:
                     from pytorchvideo_accelerate_tpu.obs import get_recorder
 

@@ -1,3 +1,19 @@
-"""Utilities: RNG management, logging, profiling, debug modes."""
+"""Utilities: RNG management, logging, profiling, sync factory, debug modes.
 
-from pytorchvideo_accelerate_tpu.utils.rng import RngManager, set_seed  # noqa: F401
+The RNG re-exports are lazy (PEP 562): `utils.rng` imports jax, and the
+stdlib-only consumers of this package (obs/, utils/sync.py — imported from
+worker threads and the analysis CLIs) must not pay a jax import for
+touching `pytorchvideo_accelerate_tpu.utils.*`.
+"""
+
+_RNG_EXPORTS = ("RngManager", "set_seed")
+
+__all__ = list(_RNG_EXPORTS)
+
+
+def __getattr__(name):
+    if name in _RNG_EXPORTS:
+        from pytorchvideo_accelerate_tpu.utils import rng
+
+        return getattr(rng, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -310,6 +310,24 @@ def lint_snapshot(root: str = "", max_items: int = 40) -> dict:
     return out
 
 
+def tsan_snapshot() -> dict:
+    """Dynamic-sanitizer health (analysis/tsan.py — docs/STATIC_ANALYSIS.md
+    § dynamic sanitizer): whether a pva-tpu-tsan run happened in this
+    process, the current lock-order graph, live held locks per thread, and
+    recent finding counts. For a wedged ARMED process this is the "who
+    holds what right now" view the stall dump can't always reach."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.analysis.tsan_report import (
+            tsan_snapshot as _snap,
+        )
+
+        out.update(_snap())
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def diagnose(timeout_s: int = 120, skip_init: bool = False,
              variants: bool = False, obs_dir: str = "") -> dict:
     rec = {
@@ -320,6 +338,7 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "loopback_listeners": loopback_listeners(),
         "obs": obs_snapshot(obs_dir),
         "lint": lint_snapshot(),
+        "tsan": tsan_snapshot(),
     }
     if not skip_init:
         rec["verbose_init"] = verbose_init_attempt(timeout_s)

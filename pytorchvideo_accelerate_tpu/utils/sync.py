@@ -1,0 +1,134 @@
+"""Single creation point for threading primitives (pva-tpu-tsan hook).
+
+Every lock, condition, worker thread, and cross-thread handoff queue in the
+package is constructed HERE instead of via `threading.*` directly (the
+`thread-factory` lint rule enforces it). Reason: the dynamic sanitizer
+(`analysis/tsan.py`) can only track locksets, lock-acquisition order, and
+happens-before edges for primitives it can see being created — a factory
+gives it one interception point instead of a monkeypatching whack-a-mole.
+
+Disarmed (the default, always in production): each `make_*` is one module
+global read + a `None` check at CREATION time, then returns the raw stdlib
+primitive — the returned object is indistinguishable from `threading.Lock()`
+et al., so steady-state lock traffic pays zero overhead. Armed (inside a
+`pva-tpu-tsan` run): the registered runtime wraps each primitive with its
+tracking twin.
+
+`shared_state(...)` is the companion registry: the known cross-thread
+classes declare which instance attributes are shared mutable state, and the
+sanitizer instruments exactly those attribute accesses while armed (a class
+decorator is import-time metadata only — nothing happens until `arm()`).
+
+Stdlib-only on purpose: obs/ and serving worker paths import this module,
+and they must stay importable without jax (this file must never grow a
+heavyweight import).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Dict, List, Optional
+
+# The armed sanitizer runtime (analysis/tsan.Tsan) or None. Module-global by
+# design: the check must be one load, and arming is a whole-process decision
+# exactly like logging configuration.
+_runtime = None
+
+
+def set_runtime(runtime) -> None:
+    """Install (or clear, with None) the sanitizer runtime. Called only by
+    `analysis.tsan` arm/disarm — never by application code."""
+    global _runtime
+    _runtime = runtime
+
+
+def get_runtime():
+    return _runtime
+
+
+# --- creation points --------------------------------------------------------
+
+def make_lock(name: str = "lock"):
+    """A mutex; `name` is the lock's CLASS for the sanitizer (lockdep-style:
+    every instance created at one call site shares the name, so the
+    acquisition-order graph generalizes across instances)."""
+    rt = _runtime
+    if rt is None:
+        return threading.Lock()
+    return rt.wrap_lock(name, reentrant=False)
+
+
+def make_rlock(name: str = "rlock"):
+    rt = _runtime
+    if rt is None:
+        return threading.RLock()
+    return rt.wrap_lock(name, reentrant=True)
+
+
+def make_condition(name: str = "cond", lock=None):
+    """A condition over a factory-made lock (created here when not given,
+    so the sanitizer sees the condition's mutex too)."""
+    if lock is None:
+        lock = make_rlock(name + ".lock")
+    return threading.Condition(lock)
+
+
+def make_thread(target=None, name: Optional[str] = None, args: tuple = (),
+                kwargs: Optional[dict] = None,
+                daemon: Optional[bool] = None) -> threading.Thread:
+    """A worker thread. Armed, start()/join() publish happens-before edges
+    (parent's writes before start() are visible to the child; the child's
+    writes are visible to a joiner) so ordinary lifecycle handoffs never
+    read as races."""
+    rt = _runtime
+    if rt is None:
+        return threading.Thread(target=target, name=name, args=args,
+                                kwargs=kwargs or {}, daemon=daemon)
+    return rt.wrap_thread(target=target, name=name, args=args,
+                          kwargs=kwargs or {}, daemon=daemon)
+
+
+def make_queue(maxsize: int = 0) -> "queue.Queue":
+    """A cross-thread handoff queue. Armed, every put→get carries a
+    happens-before edge (the producer's writes to an object published
+    through the queue are ordered before the consumer's reads — the
+    standard ownership-transfer pattern must not false-alarm)."""
+    rt = _runtime
+    if rt is None:
+        return queue.Queue(maxsize=maxsize)
+    return rt.wrap_queue(maxsize=maxsize)
+
+
+# --- shared-state registry --------------------------------------------------
+
+# classes that declared shared fields, in registration order
+_SHARED_CLASSES: List[type] = []
+
+
+def shared_state(*fields: str, benign: Optional[Dict[str, str]] = None):
+    """Class decorator: declare which instance attributes are cross-thread
+    shared mutable state. Import-time cost: two class attributes and a
+    registry append — instrumentation is installed only while the sanitizer
+    is armed (and removed on disarm).
+
+    `benign` maps field -> reason for races that are understood and
+    accepted; armed findings on those fields are reported as suppressed
+    (auditable, never fatal) — the dynamic twin of the linter's
+    `# pva: disable=... -- reason`.
+    """
+
+    def deco(cls: type) -> type:
+        cls.__pva_shared_fields__ = frozenset(fields)
+        cls.__pva_benign_fields__ = dict(benign or {})
+        _SHARED_CLASSES.append(cls)
+        rt = _runtime
+        if rt is not None:  # module imported while a sanitizer is armed
+            rt.instrument_class(cls)
+        return cls
+
+    return deco
+
+
+def shared_classes() -> List[type]:
+    return list(_SHARED_CLASSES)

@@ -276,6 +276,96 @@ def test_span_discipline_suppression():
     assert lint_source(src, "m.py") == []
 
 
+# --- thread-factory / thread-join -------------------------------------------
+
+PKG_MOD = "pytorchvideo_accelerate_tpu/serving/newmod.py"
+
+
+def test_thread_factory_fires_in_package_modules_only():
+    src = ("import threading\n"
+           "from threading import Lock as L\n"
+           "def f():\n"
+           "    a = threading.Lock()\n"
+           "    b = threading.RLock()\n"
+           "    c = threading.Condition()\n"
+           "    d = L()\n")
+    found = lint_source(src, PKG_MOD)
+    assert rules_of(found) == ["thread-factory"] * 4
+    # fixtures / user scripts outside the package tree: silent
+    assert lint_source(src, "m.py") == []
+    # events and semaphores are not modeled — never flagged
+    assert lint_source("import threading\ne = threading.Event()\n"
+                       "s = threading.Semaphore(2)\n", PKG_MOD) == []
+
+
+def test_thread_factory_exempts_the_interception_layer():
+    src = "import threading\n_l = threading.Lock()\n"
+    assert lint_source(
+        src, "pytorchvideo_accelerate_tpu/utils/sync.py") == []
+    assert lint_source(
+        src, "pytorchvideo_accelerate_tpu/analysis/tsan.py") == []
+
+
+def test_thread_factory_suppression():
+    src = ("import threading\n"
+           "l = threading.Lock()  "
+           "# pva: disable=thread-factory -- interpreter-shutdown path\n")
+    assert lint_source(src, PKG_MOD) == []
+
+
+def test_thread_join_fires_on_unjoined_nondaemon():
+    src = ("from pytorchvideo_accelerate_tpu.utils.sync import make_thread\n"
+           "class W:\n"
+           "    def start(self):\n"
+           "        self._t = make_thread(target=print)\n"
+           "        self._t.start()\n")
+    assert rules_of(lint_source(src, PKG_MOD)) == ["thread-join"]
+
+
+def test_thread_join_quiet_on_daemon_or_joined():
+    # daemon thread: cannot block shutdown
+    src = ("from pytorchvideo_accelerate_tpu.utils.sync import make_thread\n"
+           "def f():\n"
+           "    t = make_thread(target=print, daemon=True)\n"
+           "    t.start()\n")
+    assert lint_source(src, PKG_MOD) == []
+    # non-daemon but joined on the close path (self-attr binding)
+    src = ("from pytorchvideo_accelerate_tpu.utils.sync import make_thread\n"
+           "class W:\n"
+           "    def start(self):\n"
+           "        self._t = make_thread(target=print)\n"
+           "    def close(self):\n"
+           "        self._t.join(timeout=5)\n")
+    assert lint_source(src, PKG_MOD) == []
+    # local binding joined in a loop (the launch.py shape)
+    src = ("import threading\n"
+           "def f(threads):\n"
+           "    t = threading.Thread(target=print)  "
+           "# pva: disable=thread-factory -- rule-isolation fixture\n"
+           "    t.start()\n"
+           "    t.join()\n")
+    assert lint_source(src, PKG_MOD) == []
+
+
+def test_thread_rules_see_aliased_constructors():
+    """An import alias must not launder a primitive past the rules: a
+    non-daemon, never-joined thread built via `Thread as T` or
+    `make_thread as mt` is the exact shutdown wedge thread-join exists
+    to catch."""
+    src = "import threading as th\nl = th.Lock()\n"
+    assert rules_of(lint_source(src, PKG_MOD)) == ["thread-factory"]
+    src = ("from threading import Thread as T\n"
+           "def f():\n"
+           "    T(target=print).start()  "
+           "# pva: disable=thread-factory -- rule-isolation fixture\n")
+    assert rules_of(lint_source(src, PKG_MOD)) == ["thread-join"]
+    src = ("from pytorchvideo_accelerate_tpu.utils.sync import "
+           "make_thread as mt\n"
+           "def f():\n"
+           "    mt(target=print).start()\n")
+    assert rules_of(lint_source(src, PKG_MOD)) == ["thread-join"]
+
+
 # --- engine -----------------------------------------------------------------
 
 def test_parse_error_is_a_finding_not_a_crash():
@@ -320,7 +410,8 @@ def test_cli_exit_codes(tmp_path, capsys):
     assert lint_main(["--list-rules"]) == 0
     out = capsys.readouterr().out
     for rule in ("host-sync", "recompile", "lock-discipline",
-                 "tracer-leak", "span-discipline"):
+                 "tracer-leak", "span-discipline", "thread-factory",
+                 "thread-join"):
         assert rule in out
     # selecting away the matching rule silences the hot file
     assert lint_main(["--select", "span-discipline", str(hot)]) == 0

@@ -404,7 +404,7 @@ def test_tracker_failure_is_nonfatal_and_disables_offender(tmp_path, caplog):
         TrackerHub,
     )
 
-    hub = TrackerHub.__new__(TrackerHub)
+    hub = TrackerHub("", str(tmp_path))  # empty spec: no auto trackers
     jsonl = JsonlTracker(str(tmp_path))
     boom = _BoomTracker()
     _BoomTracker.calls = 0
@@ -431,7 +431,7 @@ def test_deferred_logger_on_flush_hook(tmp_path):
         TrackerHub,
     )
 
-    hub = TrackerHub.__new__(TrackerHub)
+    hub = TrackerHub("", str(tmp_path))  # empty spec: no auto trackers
     hub.trackers = [JsonlTracker(str(tmp_path))]
     hub.start("run", {})
     seen = []
