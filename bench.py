@@ -692,6 +692,30 @@ def main():
             "bench --smoke requires a tsan-clean stress pass; pva-tpu-tsan "
             f"found {tsan_findings} race/lock-cycle finding(s) (report "
             "logged above; see docs/STATIC_ANALYSIS.md)")
+        # the resilience leg of the same contract (docs/RELIABILITY.md):
+        # the pva-tpu-chaos seeded fault-injection scenario — decode
+        # faults, a mid-write checkpoint failure, a tracker outage, a
+        # mid-epoch SIGTERM, serving overload — must RECOVER everywhere.
+        # Gated here, before any child spends minutes (the lint/tsan
+        # pattern). Runs in the parent: CPU-pinned, like the tsan pass.
+        from pytorchvideo_accelerate_tpu.reliability.chaos import (
+            finding_count as chaos_finding_count,
+            format_report as chaos_format,
+            publish as chaos_publish,
+            run_scenario as run_chaos,
+        )
+
+        chaos_report = run_chaos(smoke=True, log=log)
+        chaos_publish(chaos_report)
+        chaos_findings = chaos_finding_count(chaos_report)
+        log(f"[chaos] pva-tpu-chaos: {chaos_findings} finding(s) "
+            f"in {chaos_report['elapsed_s']}s")
+        if chaos_findings:
+            log(chaos_format(chaos_report))
+        assert chaos_findings == 0, (
+            "bench --smoke requires a chaos-clean scenario; pva-tpu-chaos "
+            f"found {chaos_findings} unrecovered fault(s) (report logged "
+            "above; see docs/RELIABILITY.md)")
 
     user_smoke = args.smoke
     probe_attempts: list = []
@@ -700,6 +724,7 @@ def main():
     extras: dict = {"probe_attempts": probe_attempts}
     if user_smoke:
         extras["tsan_findings"] = tsan_findings
+        extras["chaos_findings"] = chaos_findings
 
     def flush_partial():
         try:
@@ -903,6 +928,11 @@ def main():
             f"pva-tpu-tsan found {extras.get('tsan_findings')} race/"
             "lock-cycle finding(s) on the stress scenario (report logged "
             "above; see docs/STATIC_ANALYSIS.md)")
+        # resilience contract, fourth leg: the chaos scenario already
+        # gated at the top; the headline must carry its verdict too
+        assert extras.get("chaos_findings") == 0, (
+            f"pva-tpu-chaos found {extras.get('chaos_findings')} "
+            "unrecovered fault(s) (see docs/RELIABILITY.md)")
     if user_smoke and args.serve_smoke:
         # smoke mode doubles as the CI check that the serving lane's
         # headline keys didn't silently fall out (same contract as the
@@ -1040,7 +1070,7 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "trainer_input_wait_frac", "obs_step_s",
                 "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
-                "tsan_findings"):
+                "tsan_findings", "chaos_findings"):
         if key in extras:
             out[key] = extras[key]
     # serving lane: request-latency percentiles + batcher fill ratio

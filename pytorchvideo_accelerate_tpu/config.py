@@ -209,6 +209,17 @@ class ServeConfig:
     stats_window: int = 1024
     # per-request wall-clock budget inside the server before a 504
     request_timeout_s: float = 30.0
+    # admission control (serving/admission.py): shed load with
+    # 503 + Retry-After once queue depth crosses shed_queue_frac *
+    # max_queue (the "degraded" state) — BEFORE latency collapses at the
+    # hard queue bound; recover to "healthy" below recover_queue_frac.
+    shed_queue_frac: float = 0.9
+    recover_queue_frac: float = 0.5
+    # the Retry-After seconds sent with every 503/504 rejection
+    retry_after_s: float = 1.0
+    # drain-on-SIGTERM budget: stop accepting, flush in-flight futures,
+    # then shut down (0 = no drain handler; the PR-3 dump-only behavior)
+    drain_grace_s: float = 10.0
 
 
 @dataclass
@@ -229,6 +240,40 @@ class ObsConfig:
     # bounded in-memory event ring (spans/metrics/warnings) dumped to
     # <output_dir>/flight_record.json on exception, SIGTERM, or stall
     flight_recorder_events: int = 512
+
+
+@dataclass
+class ReliabilityConfig:
+    """Resilience substrate (reliability/): retries, preemption grace,
+    emergency checkpoints (docs/RELIABILITY.md). Fault injection has no
+    config here on purpose — arming a FaultPlan is a chaos-harness act
+    (`pva-tpu-chaos`), never a production knob."""
+
+    # SIGTERM/SIGINT grace path in Trainer.fit(): finish the in-flight
+    # step, write an emergency checkpoint (resume=auto round-trips to the
+    # exact step), dump the flight record, exit 0. False restores PR 3's
+    # dump-only signal behavior.
+    graceful_shutdown: bool = True
+    # total decode attempts per clip read before the substitution path
+    # takes over (transient I/O — cold NFS, flaky storage — recovers here;
+    # a truly corrupt file still substitutes after the budget)
+    decode_retries: int = 2
+    # total attempts for checkpoint/artifact writes (orbax save dispatch,
+    # inference-export files, the emergency record)
+    ckpt_retries: int = 3
+    # total attempts per tracker call before the tracker is disabled
+    # (PR 3 disabled on the FIRST failure; a tracker outage is usually
+    # transient, losing the rest of the run's metrics is not)
+    tracker_retries: int = 2
+    # backoff shape for checkpoint/artifact writes: base * 2^attempt *
+    # jitter, capped per try at retry_max_delay_s, whole-call wall time
+    # capped at retry_deadline_s. retry_base_delay_s also seeds the decode
+    # read backoff; the decode deadline (5s — the substitution path waits
+    # behind it) and the tracker budget (2s — a logging outage must never
+    # stall a training step longer) are fixed by design.
+    retry_base_delay_s: float = 0.05
+    retry_max_delay_s: float = 2.0
+    retry_deadline_s: float = 30.0
 
 
 @dataclass
@@ -253,6 +298,7 @@ class TrainConfig:
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
+    reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
 
     seed: int = 42  # run.py:138 set_seed(42); run.py:355 exposes --seed
     # write a params-only (EMA-resolved) serving artifact to this path and

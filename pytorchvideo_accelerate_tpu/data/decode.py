@@ -14,6 +14,8 @@ from typing import Optional
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
+
 try:
     import cv2
 except Exception:  # pragma: no cover
@@ -59,6 +61,10 @@ def decode_span(path: str, start_sec: float, end_sec: float,
     least one frame for any readable video (short videos yield what exists,
     mirroring pytorchvideo's clamp-to-duration behavior [external]).
     """
+    # chaos hook (reliability/faults.py): disarmed = one global read. An
+    # injected fault IS an OSError, so it rides DECODE_ERRORS into the
+    # same retry/substitution machinery a real unreadable file exercises.
+    fault_point("decode.read", path=path)
     cap = cv2.VideoCapture(path)
     try:
         if not cap.isOpened():

@@ -52,6 +52,7 @@ from typing import Any, Iterator, Optional
 from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader
 from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
 from pytorchvideo_accelerate_tpu.utils.sync import (
     make_lock,
     make_queue,
@@ -117,6 +118,11 @@ class DevicePrefetcher:
     # --- placement --------------------------------------------------------
 
     def _place(self, batch: dict) -> Any:
+        # chaos hook: "delay" here IS the slow-worker scenario (a starved
+        # host link); "raise" crosses the queue and re-raises in the
+        # consumer like any real placement failure. Disarmed: one global
+        # read (reliability/faults.py).
+        fault_point("prefetch.h2d")
         with obs.span(self.h2d_name):
             return shard_batch(self.mesh, batch, micro_dim=self.micro_dim)
 

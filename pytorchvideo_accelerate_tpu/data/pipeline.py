@@ -35,6 +35,7 @@ from typing import Callable, Dict, Iterator, List, Optional
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.reliability.retry import retry_call
 from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 from pytorchvideo_accelerate_tpu.data import decode as decode_mod
 from pytorchvideo_accelerate_tpu.data.manifest import Manifest
@@ -123,12 +124,20 @@ class VideoClipSource(ClipSource):
         training: bool,
         seed: int = 42,
         num_clips: int = 1,
+        decode_retries: int = 2,
+        retry_base_delay_s: float = 0.05,
     ):
         self.manifest = manifest
         self.transform = transform
         self.clip_duration = clip_duration
         self.training = training
         self.seed = seed
+        # total decode attempts per read before substitution: transient
+        # I/O (cold NFS, flaky storage) recovers via reliability/retry.py;
+        # a genuinely corrupt file still exhausts the budget fast and
+        # falls through to the substitution path below
+        self.decode_retries = max(int(decode_retries), 1)
+        self.retry_base_delay_s = retry_base_delay_s
         # eval-only multi-view: `num_clips` evenly-spaced views per video,
         # stacked on a leading axis; the eval step view-averages the logits
         # in-graph (reference uniform-sampler tiling, run.py:163)
@@ -174,7 +183,16 @@ class VideoClipSource(ClipSource):
                 # (which would silently blacklist readable videos)
                 def read_span(a, b, _path=entry.path):
                     try:
-                        return decode_mod.decode_span(_path, a, b)
+                        # transient read failures retry with backoff before
+                        # the substitution machinery gives up on the file
+                        return retry_call(
+                            lambda: decode_mod.decode_span(_path, a, b),
+                            name="decode.read",
+                            attempts=self.decode_retries,
+                            retry_on=decode_mod.DECODE_ERRORS,
+                            base_delay_s=self.retry_base_delay_s,
+                            deadline_s=5.0,
+                        )
                     except decode_mod.DECODE_ERRORS as e:
                         raise _DecodeFailure(str(e)) from e
 

@@ -1,0 +1,40 @@
+"""Resilience substrate: deterministic fault injection, retries, atomic
+writes, preemption handling (docs/RELIABILITY.md).
+
+The failure-handling counterpart of the analysis/ packages: PR 4/5 made the
+concurrency *provable* (lint + tsan); this package makes failure handling
+provable the same way — every recovery path has a seeded fault that
+exercises it (`pva-tpu-chaos --smoke` is the CI gate, next to lint/tsan).
+
+Stdlib-only on purpose: data/decode.py and the serving worker paths import
+`faults`/`retry`, and they must stay importable without jax.
+"""
+
+from __future__ import annotations
+
+from pytorchvideo_accelerate_tpu.reliability.atomic import (  # noqa: F401
+    atomic_write,
+    atomic_write_bytes,
+    atomic_write_json,
+)
+from pytorchvideo_accelerate_tpu.reliability.faults import (  # noqa: F401
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+    InjectedThreadKill,
+    arm,
+    current_plan,
+    disarm,
+    fault_history,
+    fault_point,
+)
+from pytorchvideo_accelerate_tpu.reliability.preemption import (  # noqa: F401
+    PreemptionGuard,
+    get_guard,
+    read_emergency_record,
+    record_emergency,
+)
+from pytorchvideo_accelerate_tpu.reliability.retry import (  # noqa: F401
+    RetryGiveUp,
+    retry_call,
+)

@@ -1,0 +1,81 @@
+"""Atomic file writes: tmp + fsync + os.replace.
+
+Every artifact this package writes itself (inference-export weights/meta,
+the emergency-checkpoint record, chaos/doctor reports) goes through here,
+so a kill mid-save can never leave a truncated file at the destination
+path — readers see the old complete content or the new complete content,
+never a prefix. (Orbax training checkpoints bring their own atomic commit
+protocol; this covers the plain-file writers around it.)
+
+The `ckpt.write` fault point sits between the tmp write and the rename:
+`pva-tpu-chaos` proves the property by truncating/raising there and
+asserting the destination never changes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable
+
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
+
+
+def _fsync_dir(path: str) -> None:
+    """Durably commit the rename itself (POSIX: the directory entry)."""
+    try:
+        fd = os.open(path or ".", os.O_RDONLY)
+    except OSError:  # pragma: no cover - non-POSIX / odd filesystems
+        return
+    try:
+        os.fsync(fd)
+    except OSError:  # pragma: no cover
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write(path: str, write_fn: Callable[[str], None],
+                 fsync: bool = True) -> str:
+    """Call `write_fn(tmp_path)` to produce the content, then fsync and
+    `os.replace` onto `path`. The tmp file lives in the destination
+    directory (same filesystem — replace must be a rename, not a copy) and
+    keeps the destination's extension (writers like np.savez key behavior
+    on it). Any failure removes the tmp and leaves `path` untouched."""
+    d, base = os.path.split(path)
+    root, ext = os.path.splitext(base)
+    tmp = os.path.join(d, f".{root}.tmp-{os.getpid()}{ext}")
+    try:
+        write_fn(tmp)
+        fault_point("ckpt.write", write_path=tmp)
+        if fsync:
+            fd = os.open(tmp, os.O_RDONLY)
+            try:
+                os.fsync(fd)
+            finally:
+                os.close(fd)
+        os.replace(tmp, path)
+        if fsync:
+            _fsync_dir(d)
+    finally:
+        try:
+            if os.path.exists(tmp):
+                os.remove(tmp)
+        except OSError:  # pragma: no cover - cleanup is best-effort
+            pass
+    return path
+
+
+def atomic_write_bytes(path: str, data: bytes, fsync: bool = True) -> str:
+    def write(tmp: str) -> None:
+        with open(tmp, "wb") as f:
+            f.write(data)
+
+    return atomic_write(path, write, fsync=fsync)
+
+
+def atomic_write_json(path: str, obj, fsync: bool = True, **dump_kw) -> str:
+    dump_kw.setdefault("indent", 1)
+    dump_kw.setdefault("default", str)
+    return atomic_write_bytes(
+        path, json.dumps(obj, **dump_kw).encode(), fsync=fsync)

@@ -1,0 +1,609 @@
+"""pva-tpu-chaos: the bundled chaos scenario + console script.
+
+The proving harness for the resilience substrate (docs/RELIABILITY.md),
+same contract as `pva-tpu-lint`/`pva-tpu-tsan`: a seeded scenario runs
+every recovery path the way production fails, asserts recovery, and
+`chaos_findings == 0` gates `bench.py --smoke` and `scripts/analyze.sh`.
+
+Legs (all seeded via one `--seed`, CPU-only, replayable):
+
+- **replay**: the same FaultPlan seed must fire the identical hit
+  sequence twice — the property every other leg's determinism rests on;
+- **decode**: injected decode failures on a real (tiny, generated) video
+  tree; the retry + substitution machinery must deliver every batch;
+- **ckpt**: a partial-write fault inside the atomic artifact writer; the
+  retry must land a complete artifact and the destination must never
+  hold a truncated file — not even transiently;
+- **tracker**: a transient tracker outage recovers via retry (no metric
+  loss); a permanent one disables the tracker without killing anything;
+- **preempt**: a real SIGTERM mid-epoch (slow-worker + slow-dispatch
+  faults armed) takes the grace path — emergency checkpoint, flight
+  dump, exit 0 — and `resume=auto` lands on the exact step and finishes
+  the run;
+- **serve**: synthetic overload against a micro-batcher + admission
+  controller — load sheds with 503/Retry-After semantics before latency
+  collapses, an injected flush fault fails one batch (not the thread),
+  and the service recovers to `healthy`, then drains clean.
+
+Exit codes: 0 clean, 1 findings, 2 usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import contextlib
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, List, Optional
+
+from pytorchvideo_accelerate_tpu.reliability import faults
+from pytorchvideo_accelerate_tpu.reliability.faults import FaultPlan, FaultSpec
+from pytorchvideo_accelerate_tpu.reliability.preemption import (
+    get_guard,
+    read_emergency_record,
+)
+from pytorchvideo_accelerate_tpu.utils.sync import make_thread
+
+Log = Callable[[str], None]
+
+
+def _leg(report: dict, name: str) -> dict:
+    out = report["legs"].setdefault(name, {})
+    return out
+
+
+def _finding(report: dict, leg: str, msg: str) -> None:
+    report["findings"].append(f"{leg}: {msg}")
+
+
+# --- legs -------------------------------------------------------------------
+
+def leg_replay(report: dict, seed: int, log: Log) -> None:
+    """Same seed → byte-identical fault sequence (the determinism every
+    chaos assertion rests on)."""
+    leg = _leg(report, "replay")
+
+    def one_run() -> List[tuple]:
+        faults.arm(FaultPlan(seed, [
+            FaultSpec("decode.read", kind="raise", p=0.4),
+            FaultSpec("prefetch.h2d", kind="delay", p=0.3, delay_s=0.0),
+        ]))
+        try:
+            for _ in range(64):
+                try:
+                    faults.fault_point("decode.read")
+                except faults.InjectedFault:
+                    pass
+                faults.fault_point("prefetch.h2d")
+        finally:
+            faults.disarm()
+        return [(e["point"], e["hit"], e["kind"])
+                for e in faults.fault_history()]
+
+    a, b = one_run(), one_run()
+    leg["fires"] = len(a)
+    if not a:
+        _finding(report, "replay", "seeded plan fired nothing in 64 hits")
+    if a != b:
+        _finding(report, "replay",
+                 f"fault sequence not replayable: {a[:5]} != {b[:5]}")
+    log(f"[chaos] replay: {len(a)} fires, sequences identical={a == b}")
+
+
+def _write_video_tree(root: str, n_per_class: int = 2) -> bool:
+    """Tiny real mp4 tree (2 classes); False when the codec is missing."""
+    try:
+        import cv2
+        import numpy as np
+    except Exception:
+        return False
+    rng = np.random.default_rng(0)
+    for c in range(2):
+        d = os.path.join(root, f"class{c}")
+        os.makedirs(d, exist_ok=True)
+        for v in range(n_per_class):
+            wr = cv2.VideoWriter(os.path.join(d, f"v{v}.mp4"),
+                                 cv2.VideoWriter_fourcc(*"mp4v"),
+                                 30.0, (48, 32))
+            if not wr.isOpened():
+                return False
+            for _ in range(12):
+                wr.write(rng.integers(0, 255, (32, 48, 3), np.uint8))
+            wr.release()
+    return True
+
+
+def leg_decode(report: dict, tmpdir: str, seed: int, log: Log) -> None:
+    """Injected decode failures must never cost a batch: transient ones
+    recover via retry, persistent ones via the substitution path."""
+    from pytorchvideo_accelerate_tpu.data.manifest import scan_directory
+    from pytorchvideo_accelerate_tpu.data.pipeline import (
+        ClipLoader,
+        VideoClipSource,
+    )
+    from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+
+    leg = _leg(report, "decode")
+    root = os.path.join(tmpdir, "videos")
+    if not _write_video_tree(root, n_per_class=3):
+        leg["skipped"] = "no mp4 codec on this host"
+        log("[chaos] decode: skipped (no codec)")
+        return
+    tf = make_transform(training=True, num_frames=4, crop_size=24,
+                        min_short_side_scale=26, max_short_side_scale=30)
+    src = VideoClipSource(scan_directory(root), tf, clip_duration=0.2,
+                          training=True, seed=seed, decode_retries=2,
+                          retry_base_delay_s=0.001)
+    # num_workers=1: the per-point hit SEQUENCE is seeded either way, but
+    # a single decode thread makes the whole leg's timeline replayable
+    loader = ClipLoader(src, global_batch_size=2, shuffle=True,
+                        num_workers=1, seed=seed)
+    faults.arm(FaultPlan(seed, [FaultSpec("decode.read", kind="raise",
+                                          p=0.35)]))
+    try:
+        batches = sum(1 for _ in loader.epoch(0))
+    finally:
+        faults.disarm()
+        loader.close()
+    fires = len(faults.fault_history())
+    leg.update(batches=batches, fires=fires)
+    want = loader.batches_per_epoch()
+    if batches != want:
+        _finding(report, "decode",
+                 f"epoch yielded {batches}/{want} batches under faults")
+    if fires == 0:
+        _finding(report, "decode", "no decode faults fired (vacuous leg)")
+    log(f"[chaos] decode: {batches}/{want} batches with {fires} injected "
+        "failures")
+
+
+def leg_ckpt(report: dict, tmpdir: str, seed: int, log: Log) -> None:
+    """A write that dies mid-file must retry into a COMPLETE artifact and
+    never leave a truncated file at the destination."""
+    import jax.numpy as jnp
+    import optax
+
+    from pytorchvideo_accelerate_tpu.reliability.atomic import (
+        atomic_write_bytes,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+        export_inference,
+        load_inference,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+
+    leg = _leg(report, "ckpt")
+    state = TrainState.create(
+        {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}, {}, optax.sgd(0.1))
+    art = os.path.join(tmpdir, "artifact")
+    # one partial-write on the FIRST write of the export: the tmp file is
+    # truncated and the write raises; the retry must produce a complete
+    # artifact, and the destination must never have held the prefix
+    faults.arm(FaultPlan(seed, [FaultSpec("ckpt.write",
+                                          kind="partial_write",
+                                          at_hits=(0,), max_fires=1)]))
+    try:
+        export_inference(art, state, meta={"num_classes": 4,
+                                           "model": "tiny"})
+    finally:
+        faults.disarm()
+    fires = len(faults.fault_history())
+    try:
+        params, _stats, meta = load_inference(art)
+        complete = "w" in params and meta.get("num_classes") == 4
+    except Exception as e:  # noqa: BLE001 - the artifact IS the assertion
+        complete = False
+        leg["load_error"] = f"{type(e).__name__}: {e}"
+    leftovers = [f for f in os.listdir(art) if ".tmp" in f]
+    leg.update(fires=fires, complete=complete, tmp_leftovers=leftovers)
+    if fires != 1:
+        _finding(report, "ckpt", f"expected 1 injected write fault, {fires}")
+    if not complete:
+        _finding(report, "ckpt", "artifact incomplete after retried write")
+    if leftovers:
+        _finding(report, "ckpt", f"tmp files left behind: {leftovers}")
+    # and when every attempt dies, the destination must simply not exist
+    dead = os.path.join(tmpdir, "dead.json")
+    faults.arm(FaultPlan(seed, [FaultSpec("ckpt.write", kind="raise")]))
+    try:
+        atomic_write_bytes(dead, b"{}")
+    except faults.InjectedFault:
+        pass
+    else:
+        _finding(report, "ckpt", "always-failing write did not raise")
+    finally:
+        faults.disarm()
+    if os.path.exists(dead):
+        _finding(report, "ckpt", "failed write left a destination file")
+    log(f"[chaos] ckpt: retried partial write -> complete={complete}, "
+        f"no tmp leftovers={not leftovers}")
+
+
+def leg_tracker(report: dict, tmpdir: str, seed: int, log: Log) -> None:
+    """Transient tracker outage: retry recovers, zero metric loss.
+    Permanent outage: disabled after the budget, run unharmed."""
+    from pytorchvideo_accelerate_tpu.trainer.tracking import TrackerHub
+
+    leg = _leg(report, "tracker")
+    logdir = os.path.join(tmpdir, "logs")
+    hub = TrackerHub("jsonl", logdir, retries=3)
+    hub.start("chaosrun", {})
+    # hit 0 was start(); fail the first attempt of the first two log()
+    # fan-outs — each retries into the next hit, which succeeds
+    faults.arm(FaultPlan(seed, [FaultSpec("tracker.log", kind="raise",
+                                          at_hits=(1, 3), max_fires=2)]))
+    try:
+        for i in range(5):
+            hub.log({"x": float(i)}, step=i)
+    finally:
+        faults.disarm()
+    fires = len(faults.fault_history())
+    hub.finish()
+    path = os.path.join(logdir, "chaosrun.jsonl")
+    with open(path) as f:
+        steps = [json.loads(ln).get("step") for ln in f
+                 if "step" in ln]
+    leg.update(fires=fires, survivors=len(hub.trackers), logged=len(steps))
+    if len(hub.trackers) != 1:
+        _finding(report, "tracker",
+                 "transient outage disabled the tracker despite retries")
+    if sorted(s for s in steps if s is not None) != [0, 1, 2, 3, 4]:
+        _finding(report, "tracker", f"metric loss under outage: {steps}")
+    # permanent outage: every attempt fails -> disabled, nothing raises
+    hub2 = TrackerHub("jsonl", logdir, retries=2)
+    hub2.start("chaosrun2", {})
+    faults.arm(FaultPlan(seed, [FaultSpec("tracker.log", kind="raise")]))
+    try:
+        hub2.log({"x": 1.0}, step=0)
+    finally:
+        faults.disarm()
+    if hub2.trackers:
+        _finding(report, "tracker",
+                 "permanently failing tracker was not disabled")
+    hub2.finish()
+    log(f"[chaos] tracker: {fires} injected failures, "
+        f"{len(steps)} steps logged, survivors={len(hub.trackers)}")
+
+
+def leg_preempt(report: dict, tmpdir: str, seed: int, log: Log) -> None:
+    """Mid-epoch SIGTERM under slow-worker faults: grace path saves at the
+    consumed step, exits clean; resume=auto lands exactly there and
+    finishes the run.
+
+    This leg is also the live regression test for the resume
+    re-materialization in `Checkpointer.restore`: resuming mid-epoch and
+    TRAINING on the restored state with jax's persistent compilation
+    cache enabled (bench configures one) heap-corrupted the pinned
+    jaxlib until restore started copying every leaf into an XLA-owned
+    buffer."""
+    from pytorchvideo_accelerate_tpu.config import (
+        CheckpointConfig, DataConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    leg = _leg(report, "preempt")
+    outdir = os.path.join(tmpdir, "run")
+
+    def cfg(resume: str = "") -> TrainConfig:
+        return TrainConfig(
+            model=ModelConfig(name="tiny3d", num_classes=4,
+                              dropout_rate=0.0),
+            data=DataConfig(synthetic=True, synthetic_num_videos=16,
+                            num_frames=4, crop_size=24, batch_size=2,
+                            num_workers=1, limit_val_batches=1),
+            optim=OptimConfig(num_epochs=2, lr=0.01),
+            checkpoint=CheckpointConfig(output_dir=outdir,
+                                        resume_from_checkpoint=resume),
+            seed=seed,
+        )
+
+    tr = Trainer(cfg())
+    total = tr.total_steps
+    # slow worker + slow dispatch: every step pays an injected delay, so
+    # the SIGTERM below always lands mid-epoch, never after the run
+    faults.arm(FaultPlan(seed, [
+        FaultSpec("step.dispatch", kind="delay", p=1.0, delay_s=0.05),
+        FaultSpec("prefetch.h2d", kind="delay", p=0.5, delay_s=0.01),
+    ]))
+    # pre-install the guard so the kill can never race the dump-only
+    # handler during Trainer warmup; fit()'s own install is then a no-op
+    get_guard().install()
+    in_fit = threading.Event()
+    in_fit.set()
+
+    def killer():
+        time.sleep(0.4)
+        if in_fit.is_set():  # a real signal, mid-epoch, main thread
+            os.kill(os.getpid(), __import__("signal").SIGTERM)
+
+    kt = make_thread(target=killer, name="chaos-sigterm", daemon=True)
+    kt.start()
+    try:
+        res = tr.fit()
+    finally:
+        in_fit.clear()
+        kt.join(timeout=5.0)
+        faults.disarm()
+        get_guard().uninstall()
+    rec = read_emergency_record(outdir)
+    leg.update(preempted=bool(res.get("preempted")),
+               stopped_at=res.get("steps"),
+               emergency=rec and {"step": rec["step"],
+                                  "reason": rec.get("reason")},
+               total_steps=total)
+    if not res.get("preempted"):
+        _finding(report, "preempt", "SIGTERM did not take the grace path")
+        return
+    if rec is None:
+        _finding(report, "preempt", "no emergency_checkpoint.json record")
+        return
+    if not 0 < rec["step"] < total:
+        _finding(report, "preempt",
+                 f"emergency step {rec['step']} not mid-run (total {total})")
+    # recovery: resume=auto must land on the EXACT saved step and finish
+    tr2 = Trainer(cfg(resume="auto"))
+    latest = tr2.checkpointer.latest_step()
+    if latest != rec["step"]:
+        _finding(report, "preempt",
+                 f"resume=auto found step {latest}, emergency saved "
+                 f"{rec['step']}")
+    res2 = tr2.fit()
+    leg["resumed_to"] = res2.get("steps")
+    if res2.get("preempted") or res2.get("steps") != total:
+        _finding(report, "preempt",
+                 f"resumed run did not complete: {res2.get('steps')}/"
+                 f"{total} steps")
+    log(f"[chaos] preempt: SIGTERM at step {rec['step']}/{total}, "
+        f"resumed and finished at {res2.get('steps')}")
+
+
+class _StubEngine:
+    """Bucket geometry + a host-side forward slow enough to build a queue
+    (no jax: the serving leg measures the control plane, not the chip)."""
+
+    def __init__(self, forward_s: float = 0.005):
+        import numpy as np
+
+        self._np = np
+        self.forward_s = forward_s
+        self.buckets = (2, 4)
+        self.num_classes = 4
+
+    def bucket_for(self, n: int) -> int:
+        for b in self.buckets:
+            if b >= n:
+                return b
+        raise ValueError(f"batch of {n} exceeds {self.buckets[-1]}")
+
+    def predict(self, batch):
+        time.sleep(self.forward_s)
+        n = next(iter(batch.values())).shape[0]
+        return self._np.zeros((n, self.num_classes), self._np.float32)
+
+
+def leg_serve(report: dict, seed: int, log: Log) -> None:
+    """Synthetic overload: shed with Retry-After semantics before the
+    queue saturates, survive an injected flush fault, recover to healthy,
+    then drain clean."""
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.serving.admission import (
+        AdmissionController,
+    )
+    from pytorchvideo_accelerate_tpu.serving.batcher import (
+        MicroBatcher,
+        QueueFullError,
+    )
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+
+    leg = _leg(report, "serve")
+    stats = ServingStats(window=256)
+    mb = MicroBatcher(_StubEngine(forward_s=0.02), max_wait_ms=1.0,
+                      max_queue=16, stats=stats, retry_after_s=0.25)
+    stats.queue_depth_fn = mb.queue_depth
+    ac = AdmissionController(max_queue=16, shed_frac=0.5, recover_frac=0.2,
+                             retry_after_s=0.25)
+    clip = {"video": np.zeros((2, 4, 4, 3), np.float32)}
+    served, shed, errors = [], [], []
+    # one injected flush failure partway through the flood: the batch's
+    # futures must fail (the 500 path) without taking the flush thread
+    faults.arm(FaultPlan(seed, [FaultSpec("serve.flush", kind="raise",
+                                          at_hits=(3,), max_fires=1)]))
+
+    def client(k: int):
+        # open-loop arrival: submit the whole burst without waiting
+        # (overload means arrivals outrun the drain), collect at the end;
+        # the admit-then-submit sequence mirrors server.py's do_POST
+        futs = []
+        for _ in range(40):
+            ok, retry_after = ac.admit(mb.queue_depth())
+            if not ok:
+                stats.observe_shed(ac.state())
+                shed.append(retry_after)
+                continue
+            try:
+                futs.append(mb.submit(clip))
+            except QueueFullError as e:
+                shed.append(e.retry_after_s)
+        for fut in futs:
+            try:
+                served.append(fut.result(timeout=30.0))
+            except Exception as e:  # noqa: BLE001 - injected flush fault
+                errors.append(type(e).__name__)
+
+    try:
+        ts = [make_thread(target=client, args=(k,), name=f"chaos-client-{k}",
+                          daemon=True) for k in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30.0)
+        # the flood is over: depth drains, the state machine must recover
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and mb.queue_depth() > 0:
+            time.sleep(0.01)
+        ac.admit(mb.queue_depth())  # drives degraded -> healthy
+        recovered = ac.state()
+        # drain: stop admitting, flush in-flight, close
+        ac.start_draining()
+        drained_admit = ac.admit(0)
+        drained = mb.drain(timeout_s=5.0)
+    finally:
+        faults.disarm()
+        mb.close()
+    fires = len(faults.fault_history())
+    snap = stats.snapshot()
+    leg.update(served=len(served), shed=len(shed), errors=len(errors),
+               flush_fires=fires, recovered_state=recovered,
+               stats_shed=snap["shed"], drained=drained)
+    if not shed:
+        _finding(report, "serve", "overload never shed (vacuous leg)")
+    if shed and min(shed) <= 0:
+        _finding(report, "serve", "shed without a Retry-After hint")
+    if not served:
+        _finding(report, "serve", "overload starved every request")
+    if fires and not errors:
+        _finding(report, "serve",
+                 "injected flush fault did not surface to any future")
+    if recovered != "healthy":
+        _finding(report, "serve",
+                 f"state stuck at {recovered!r} after the flood drained")
+    if snap["shed"] <= 0:
+        _finding(report, "serve", "shed counter not visible on /stats")
+    if drained_admit[0]:
+        _finding(report, "serve", "draining state admitted a request")
+    if not drained:
+        _finding(report, "serve", "drain left requests queued")
+    log(f"[chaos] serve: {len(served)} served, {len(shed)} shed, "
+        f"{len(errors)} failed by injected flush fault, "
+        f"recovered={recovered!r}, drained={drained}")
+
+
+def leg_sigterm_plumbing(report: dict, log: Log) -> None:
+    """The raw signal path: a real SIGTERM to the installed guard sets the
+    request (and does NOT kill), outside any trainer."""
+    from pytorchvideo_accelerate_tpu.reliability.preemption import (
+        PreemptionGuard,
+    )
+    import signal as _signal
+
+    leg = _leg(report, "sigterm")
+    g = PreemptionGuard()
+    if not g.install():  # not the main thread (embedded runs): skip
+        leg["skipped"] = "not the main thread"
+        return
+    try:
+        os.kill(os.getpid(), _signal.SIGTERM)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not g.requested:
+            time.sleep(0.005)
+        leg.update(requested=g.requested, reason=g.reason)
+        if not g.requested:
+            _finding(report, "sigterm",
+                     "SIGTERM did not set the preemption request")
+    finally:
+        g.uninstall()
+    log(f"[chaos] sigterm: guard caught the signal "
+        f"(reason={leg.get('reason')!r})")
+
+
+# --- scenario ---------------------------------------------------------------
+
+def run_scenario(seed: int = 42, smoke: bool = True,
+                 log: Optional[Log] = None) -> dict:
+    """Run every leg; returns the report dict. `smoke` is accepted for
+    CLI-symmetry with pva-tpu-tsan — the scenario is already sized for CI
+    (tiny shapes, two short tiny3d fits); full mode is identical today."""
+    log = log or (lambda msg: None)
+    t0 = time.perf_counter()
+    report: dict = {"seed": int(seed), "smoke": bool(smoke),
+                    "findings": [], "legs": {}}
+    with tempfile.TemporaryDirectory(prefix="pva_chaos_") as tmpdir:
+        for fn, args in (
+                (leg_replay, (report, seed, log)),
+                (leg_sigterm_plumbing, (report, log)),
+                (leg_decode, (report, tmpdir, seed, log)),
+                (leg_ckpt, (report, tmpdir, seed, log)),
+                (leg_tracker, (report, tmpdir, seed, log)),
+                (leg_serve, (report, seed, log)),
+                (leg_preempt, (report, tmpdir, seed, log)),
+        ):
+            try:
+                fn(*args)
+            except Exception as e:  # noqa: BLE001 - a crashed leg IS a finding
+                faults.disarm()  # never leak an armed plan into later legs
+                _finding(report, fn.__name__,
+                         f"leg crashed: {type(e).__name__}: {e}")
+    report["elapsed_s"] = round(time.perf_counter() - t0, 3)
+    log(f"[chaos] scenario done in {report['elapsed_s']}s: "
+        f"{len(report['findings'])} finding(s)")
+    return report
+
+
+def finding_count(report: dict) -> int:
+    return len(report.get("findings", ()))
+
+
+def publish(report: dict) -> None:
+    """Mirror the verdict into the obs spine (gauge + flight ring), the
+    lint/tsan pattern: crash dumps carry the chaos verdict too."""
+    from pytorchvideo_accelerate_tpu import obs
+
+    obs.get_registry().gauge(
+        "pva_chaos_findings",
+        "findings from the last pva-tpu-chaos scenario").set(
+            finding_count(report))
+    for f in report.get("findings", ()):
+        obs.get_recorder().record("chaos", "finding", detail=f[:200])
+
+
+def format_report(report: dict) -> str:
+    lines: List[str] = []
+    for name, leg in report.get("legs", {}).items():
+        lines.append(f"leg {name}: " + json.dumps(leg, default=str))
+    for f in report.get("findings", ()):
+        lines.append(f"FINDING {f}")
+    lines.append(
+        f"pva-tpu-chaos: {finding_count(report)} finding(s) over "
+        f"{len(report.get('legs', {}))} legs in "
+        f"{report.get('elapsed_s', 0)}s (seed {report.get('seed')})")
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pva-tpu-chaos",
+        description="deterministic fault-injection scenario over the "
+                    "data/train/serve resilience layer; see "
+                    "docs/RELIABILITY.md")
+    ap.add_argument("--smoke", action="store_true",
+                    help="the CI lane (the scenario is CI-sized either way)")
+    ap.add_argument("--seed", type=int, default=42,
+                    help="fault-plan seed: same seed, same fault sequence")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    def log(msg: str) -> None:
+        print(msg, file=sys.stderr, flush=True)
+
+    # the trainer legs must not wedge a CLI run on a half-attached
+    # accelerator: CPU unless the caller overrides (the tsan CLI pattern)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    report = run_scenario(seed=args.seed, smoke=args.smoke, log=log)
+    publish(report)
+    if args.format == "json":
+        print(json.dumps(report, indent=1, default=str))
+    else:
+        print(format_report(report))
+    return 1 if finding_count(report) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,241 @@
+"""Seeded, deterministic fault-injection registry (pva-tpu-chaos hook).
+
+The resilience twin of `utils/sync.py`'s primitive factory: named fault
+points are planted at the real hazard sites of the data→train→serve path —
+
+    decode.read      data/decode.py        unreadable/corrupt video
+    prefetch.h2d     data/device_prefetch  slow or failing host→HBM copy
+    ckpt.write       reliability/atomic.py checkpoint/artifact write dies
+    tracker.log      trainer/tracking.py   tracker backend outage
+    serve.flush      serving/batcher.py    inference batch failure
+    step.dispatch    trainer/loop.py       slow/failing step dispatch
+
+— and cost ONE module-global read when disarmed (the default, always in
+production): `fault_point()` loads `_plan`, sees None, returns. Armed (a
+chaos run), each hit of a point is numbered, and every fire decision is a
+pure function of `(plan.seed, point, hit_index)` — so the same plan replays
+the same fault sequence, byte for byte, every run. Concurrent callers make
+the *interleaving* nondeterministic, never the fired hit set.
+
+Fault kinds:
+
+- ``raise``: raise `InjectedFault` (an OSError, so decode/IO handlers treat
+  it exactly like the real failure it stands in for);
+- ``delay``: sleep `delay_s` (slow worker / slow link);
+- ``partial_write``: truncate the in-flight file (the `path=` the call site
+  passed) to half, then raise — the mid-write kill that must never produce
+  a truncated artifact through the atomic writer;
+- ``kill_thread``: raise `InjectedThreadKill` (a BaseException, so it
+  escapes ordinary `except Exception` recovery and takes the worker down
+  the way a real thread death would).
+
+Every fire increments `pva_fault_injected_total{point=...}` in the obs
+registry and lands in the flight-recorder ring, so a chaos run's crash
+evidence says which faults were live. See docs/RELIABILITY.md.
+"""
+
+from __future__ import annotations
+
+import time
+import zlib
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock
+
+
+class InjectedFault(OSError):
+    """An injected failure. OSError on purpose: the call sites' real
+    failure handlers (DECODE_ERRORS, checkpoint-write retries) must treat
+    it like the outage it simulates — a fault the product code needs
+    special-casing for would prove nothing."""
+
+
+class InjectedThreadKill(BaseException):
+    """An injected worker death. BaseException on purpose: it must escape
+    `except Exception` recovery the way a real SIGKILLed/dying thread's
+    disappearance does."""
+
+
+@dataclass
+class FaultSpec:
+    """One fault point's behavior under a plan.
+
+    `at_hits` (exact 0-based hit indices) wins over `p` (per-hit fire
+    probability, decided by a deterministic per-hit RNG). `max_fires`
+    bounds total fires (0 = unlimited)."""
+
+    point: str
+    kind: str = "raise"  # raise | delay | partial_write | kill_thread
+    p: float = 1.0
+    at_hits: Tuple[int, ...] = ()
+    max_fires: int = 0
+    delay_s: float = 0.01
+    message: str = ""
+
+    _KINDS = ("raise", "delay", "partial_write", "kill_thread")
+
+    def __post_init__(self):
+        if self.kind not in self._KINDS:
+            raise ValueError(
+                f"fault kind must be one of {self._KINDS}, got {self.kind!r}")
+        self.at_hits = tuple(int(h) for h in self.at_hits)
+
+    def to_dict(self) -> dict:
+        return {"point": self.point, "kind": self.kind, "p": self.p,
+                "at_hits": list(self.at_hits), "max_fires": self.max_fires,
+                "delay_s": self.delay_s}
+
+
+def _hit_roll(seed: int, point: str, hit: int) -> float:
+    """Deterministic per-hit uniform in [0, 1): stable across processes
+    (crc32, not `hash()` — tuple hashing is salted per interpreter)."""
+    h = zlib.crc32(f"{seed}:{point}:{hit}".encode())
+    return (h & 0xFFFFFFFF) / 2**32
+
+
+class FaultPlan:
+    """A seeded set of FaultSpecs; `arm(plan)` makes it live process-wide.
+
+    Thread-safe: hit numbering and the fired-history append happen under
+    one lock (the decode pool and serving threads hit points concurrently).
+    """
+
+    def __init__(self, seed: int, specs: List[FaultSpec]):
+        self.seed = int(seed)
+        self.specs: Dict[str, List[FaultSpec]] = {}
+        for s in specs:
+            self.specs.setdefault(s.point, []).append(s)
+        self._lock = make_lock("FaultPlan._lock")
+        self._hits: Dict[str, int] = {}
+        self._fires: Dict[int, int] = {}  # id(spec) -> fires so far
+        self.history: List[dict] = []
+
+    def to_dict(self) -> dict:
+        return {"seed": self.seed,
+                "specs": [s.to_dict() for ss in self.specs.values()
+                          for s in ss]}
+
+    @classmethod
+    def from_dict(cls, d: dict) -> "FaultPlan":
+        return cls(d.get("seed", 0),
+                   [FaultSpec(**s) for s in d.get("specs", [])])
+
+    def points(self) -> Tuple[str, ...]:
+        return tuple(sorted(self.specs))
+
+    # --- the armed hit path -------------------------------------------------
+
+    def _decide(self, point: str) -> Optional[Tuple[FaultSpec, int]]:
+        """Number this hit and pick the firing spec (if any) — pure
+        bookkeeping under the lock; the action happens outside it."""
+        with self._lock:
+            hit = self._hits.get(point, 0)
+            self._hits[point] = hit + 1
+            for spec in self.specs.get(point, ()):
+                fires = self._fires.get(id(spec), 0)
+                if spec.max_fires and fires >= spec.max_fires:
+                    continue
+                if spec.at_hits:
+                    fire = hit in spec.at_hits
+                else:
+                    fire = _hit_roll(self.seed, point, hit) < spec.p
+                if fire:
+                    self._fires[id(spec)] = fires + 1
+                    self.history.append(
+                        {"point": point, "hit": hit, "kind": spec.kind,
+                         "ts": round(time.time(), 6)})
+                    return spec, hit
+            return None
+
+    def hit(self, point: str, path: Optional[str] = None,
+            write_path: Optional[str] = None) -> None:
+        decision = self._decide(point)
+        if decision is None:
+            return
+        spec, hit = decision
+        _publish_fire(point, spec.kind, hit)
+        msg = spec.message or f"injected {spec.kind} at {point} (hit {hit})"
+        if spec.kind == "delay":
+            time.sleep(spec.delay_s)
+            return
+        if spec.kind == "partial_write":
+            # truncation ONLY on a write_path the call site declared as
+            # in-flight scratch (atomic.py's tmp file). `path` is evidence
+            # (e.g. decode.read's SOURCE video) — a mis-authored
+            # partial_write spec at a read point must degrade to a plain
+            # raise, never corrupt real data the harness only reads.
+            if write_path:
+                try:
+                    with open(write_path, "r+b") as f:
+                        f.truncate(max(f.seek(0, 2) // 2, 0))
+                except OSError:
+                    pass  # nothing written yet: the raise alone suffices
+            raise InjectedFault(msg)
+        if spec.kind == "kill_thread":
+            raise InjectedThreadKill(msg)
+        raise InjectedFault(msg)
+
+
+def _publish_fire(point: str, kind: str, hit: int) -> None:
+    """Fire evidence: counter + flight-ring event. Best-effort — telemetry
+    must never turn an injected fault into a different failure."""
+    try:
+        from pytorchvideo_accelerate_tpu.obs import get_recorder, get_registry
+
+        get_registry().counter(
+            "pva_fault_injected_total",
+            "faults fired by the armed pva-tpu-chaos plan, by point",
+            labelnames=("point",)).inc(point=point)
+        get_recorder().record("fault", point, kind=kind, hit=hit)
+    except Exception:  # pragma: no cover - obs stays optional here
+        pass
+
+
+# The armed plan or None. Module-global by design (the `utils/sync.py`
+# pattern): the disarmed check must be one load, and arming is a
+# whole-process decision exactly like the sanitizer runtime.
+_plan: Optional[FaultPlan] = None
+# survives disarm so chaos legs can read the fired sequence afterwards
+_last_plan: Optional[FaultPlan] = None
+
+
+def arm(plan: FaultPlan) -> FaultPlan:
+    """Install `plan` process-wide. Called only by chaos harnesses/tests —
+    never by application code."""
+    global _plan, _last_plan
+    _plan = _last_plan = plan
+    return plan
+
+
+def disarm() -> None:
+    global _plan
+    _plan = None
+
+
+def current_plan() -> Optional[FaultPlan]:
+    return _plan
+
+
+def fault_history() -> List[dict]:
+    """Fired-fault sequence of the current (or last armed) plan."""
+    plan = _plan or _last_plan
+    if plan is None:
+        return []
+    with plan._lock:
+        return list(plan.history)
+
+
+def fault_point(name: str, path: Optional[str] = None,
+                write_path: Optional[str] = None) -> None:
+    """A named hazard site. Disarmed: one global read, immediate return.
+    Armed: number the hit and maybe fire (see module docstring).
+
+    `write_path` is the in-flight SCRATCH file at a write site
+    (`partial_write` truncates it before raising); `path` is evidence only
+    — read sites pass the source file they were reading, and it is never
+    mutated."""
+    plan = _plan
+    if plan is None:
+        return
+    plan.hit(name, path, write_path)

@@ -1,0 +1,157 @@
+"""Preemption grace path: SIGTERM/SIGINT → finish the step, save, exit 0.
+
+Preemptible workers are the NORMAL case at pod scale (Podracer
+architectures; the tier-1 harness itself kills with `timeout -k`). PR 3's
+flight-recorder handler made a kill leave evidence; this makes it leave a
+*resumable run*: the trainer polls `guard.requested` once per step (a
+thread-safe Event read — no syncs, no locks on the hot path), and on a
+request it finishes the in-flight step, writes an emergency checkpoint
+(kind "preempt", consumed loader position), dumps the flight record, and
+returns normally — exit 0, `resume=auto` lands on the exact step.
+
+Semantics change vs PR 3 (documented in docs/RELIABILITY.md): while a
+guard is installed, the FIRST SIGTERM/SIGINT no longer chains into the
+flight recorder's re-raise-death path — it requests graceful shutdown
+instead (and records/dumps itself). A SECOND signal restores the previous
+disposition and re-delivers: stuck drains stay killable. `uninstall()`
+(the fit() finally) restores the previous handlers exactly.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+from typing import Dict, Optional
+
+from pytorchvideo_accelerate_tpu.reliability.atomic import atomic_write_json
+
+EMERGENCY_RECORD = "emergency_checkpoint.json"
+
+_SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+
+class PreemptionGuard:
+    """Signal-to-Event adapter with a two-strikes escalation."""
+
+    def __init__(self):
+        self._requested = threading.Event()
+        self.reason: str = ""
+        self._prev: Dict[int, object] = {}
+        self._installed = False
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def request(self, reason: str = "api") -> None:
+        """Programmatic preemption (tests, embedding runtimes)."""
+        if not self._requested.is_set():
+            self.reason = reason
+            self._requested.set()
+            self._record(reason)
+
+    def _record(self, reason: str) -> None:
+        try:
+            from pytorchvideo_accelerate_tpu.obs import get_recorder
+
+            get_recorder().record("preempt", reason)
+        except Exception:  # pragma: no cover - obs stays optional
+            pass
+
+    # --- signal plumbing (main thread only) -------------------------------
+
+    def _handler(self, signum, frame) -> None:
+        if self._requested.is_set():
+            # second strike: the grace path is stuck or the operator means
+            # it — restore the previous disposition and re-deliver
+            self._restore(signum)
+            os.kill(os.getpid(), signum)
+            return
+        name = signal.Signals(signum).name
+        self.reason = name
+        self._requested.set()
+        self._record(name)
+        try:
+            from pytorchvideo_accelerate_tpu.obs import get_recorder
+
+            get_recorder().dump()  # evidence even if the drain then wedges
+        except Exception:  # pragma: no cover
+            pass
+
+    def install(self) -> bool:
+        """Take over SIGTERM/SIGINT; returns False off the main thread
+        (signal.signal raises there — the guard then only serves
+        `request()`/`requested`, which is what threaded tests need)."""
+        if self._installed:
+            return True
+        self._requested.clear()
+        self.reason = ""
+        try:
+            for sig in _SIGNALS:
+                self._prev[sig] = signal.getsignal(sig)
+                signal.signal(sig, self._handler)
+        except (ValueError, OSError):  # not the main thread
+            self._prev.clear()
+            return False
+        self._installed = True
+        return True
+
+    def _restore(self, signum) -> None:
+        prev = self._prev.get(signum)
+        if prev is not None:
+            try:
+                signal.signal(signum, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+
+    def uninstall(self) -> None:
+        if not self._installed:
+            self._requested.clear()
+            return
+        for sig in _SIGNALS:
+            self._restore(sig)
+        self._prev.clear()
+        self._installed = False
+        self._requested.clear()
+
+
+_DEFAULT = PreemptionGuard()
+
+
+def get_guard() -> PreemptionGuard:
+    """Process-default guard (the trainer installs/uninstalls it around
+    fit(); chaos/tests reach the same instance to request or observe)."""
+    return _DEFAULT
+
+
+# --- emergency-checkpoint record --------------------------------------------
+
+def record_emergency(output_dir: str, *, step: int, epoch: int,
+                     checkpoint_dir: str, reason: str = "") -> Optional[str]:
+    """Atomically drop `<output_dir>/emergency_checkpoint.json` — the
+    breadcrumb `pva-tpu-doctor`'s reliability snapshot and operators read
+    to find where a preempted run stopped. Best-effort: a failing record
+    write must not turn a successful emergency save into a crash."""
+    try:
+        return atomic_write_json(
+            os.path.join(output_dir, EMERGENCY_RECORD),
+            {"step": int(step), "epoch": int(epoch),
+             "checkpoint_dir": checkpoint_dir, "reason": reason,
+             "pid": os.getpid(), "ts": round(time.time(), 6)})
+    except OSError:
+        return None
+
+
+def read_emergency_record(output_dir: str) -> Optional[dict]:
+    import json
+
+    path = os.path.join(output_dir, EMERGENCY_RECORD)
+    try:
+        with open(path) as f:
+            out = json.load(f)
+        out["path"] = path
+        return out
+    except (OSError, ValueError):
+        return None

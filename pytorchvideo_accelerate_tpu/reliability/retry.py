@@ -1,0 +1,116 @@
+"""One retry primitive for the whole package: jittered exponential backoff
+with a hard deadline.
+
+Replaces the ad-hoc fail-or-disable behavior at the three transient-failure
+sites (decode reads, checkpoint/artifact writes, tracker fan-out) with one
+policy whose evidence trail is shared: every RE-attempt increments
+`pva_retry_attempts_total{op=...}` in the obs registry and lands in the
+flight-recorder ring; exhaustion increments `pva_retry_giveups_total`,
+recovery after at least one failure `pva_retry_recoveries_total`. The first
+attempt is free — a hot path that never fails never pays a counter.
+
+Determinism under chaos: when a fault plan is armed
+(`reliability/faults.py`), the backoff jitter derives from the plan's seed
+and the op name, so a chaos run's retry timing replays with its fault
+sequence. Without a plan, jitter is ordinary `random`.
+"""
+
+from __future__ import annotations
+
+import random
+import time
+import zlib
+from typing import Callable, Optional, Tuple, Type
+
+from pytorchvideo_accelerate_tpu.reliability import faults
+
+
+class RetryGiveUp(Exception):
+    """Marker mixin — retry_call never raises this directly; it re-raises
+    the last underlying error. Exists for callers that want to wrap."""
+
+
+def _jitter(name: str, attempt: int) -> float:
+    """Uniform in [0.5, 1.5): seeded from the armed fault plan (replayable
+    chaos runs) or the process RNG (production)."""
+    plan = faults.current_plan()
+    if plan is None:
+        return 0.5 + random.random()
+    h = zlib.crc32(f"{plan.seed}:retry:{name}:{attempt}".encode())
+    return 0.5 + (h & 0xFFFFFFFF) / 2**32
+
+
+def _publish(kind: str, name: str, attempt: int, error: str = "") -> None:
+    try:
+        from pytorchvideo_accelerate_tpu.obs import get_recorder, get_registry
+
+        reg = get_registry()
+        counter = {
+            "attempt": ("pva_retry_attempts_total",
+                        "re-attempts after a retryable failure, by op"),
+            "giveup": ("pva_retry_giveups_total",
+                       "retry budgets exhausted (error re-raised), by op"),
+            "recovery": ("pva_retry_recoveries_total",
+                         "calls that succeeded after >=1 failure, by op"),
+        }[kind]
+        reg.counter(counter[0], counter[1], labelnames=("op",)).inc(op=name)
+        get_recorder().record("retry", name, event=kind, attempt=attempt,
+                              **({"error": error[:200]} if error else {}))
+    except Exception:  # pragma: no cover - telemetry must not break retries
+        pass
+
+
+def retry_call(
+    fn: Callable,
+    *,
+    name: str,
+    attempts: int = 3,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    base_delay_s: float = 0.05,
+    max_delay_s: float = 2.0,
+    deadline_s: float = 30.0,
+    sleep: Callable[[float], None] = time.sleep,
+    on_retry: Optional[Callable[[int, BaseException], None]] = None,
+):
+    """Call `fn()`; on a `retry_on` failure, back off and retry.
+
+    - `attempts` is the TOTAL call budget (1 = no retries).
+    - Backoff: `base_delay_s * 2^(attempt-1) * jitter(0.5..1.5)`, capped at
+      `max_delay_s`.
+    - `deadline_s` bounds elapsed wall time across the whole call: when the
+      next sleep would cross it, the last error re-raises immediately —
+      a retry loop must never outlive its caller's budget (the in-flight
+      step a preemption grace period is waiting on, a serving request's
+      504 budget).
+    - Non-retryable exceptions propagate untouched on the first throw.
+    """
+    if attempts < 1:
+        raise ValueError(f"attempts must be >= 1, got {attempts}")
+    t0 = time.monotonic()
+    failures = 0
+    for attempt in range(1, attempts + 1):
+        try:
+            result = fn()
+        except retry_on as e:
+            failures += 1
+            last = e
+            if attempt >= attempts:
+                _publish("giveup", name, attempt, error=str(e))
+                raise
+            # jitter INSIDE the cap: max_delay_s is the documented hard
+            # per-try bound, a 1.5x jitter must not overshoot it
+            delay = min(base_delay_s * 2 ** (attempt - 1)
+                        * _jitter(name, attempt), max_delay_s)
+            if time.monotonic() - t0 + delay > deadline_s:
+                _publish("giveup", name, attempt,
+                         error=f"deadline {deadline_s}s: {e}")
+                raise
+            _publish("attempt", name, attempt, error=str(e))
+            if on_retry is not None:
+                on_retry(attempt, e)
+            sleep(delay)
+        else:
+            if failures:
+                _publish("recovery", name, attempt)
+            return result
+    raise last  # pragma: no cover - loop always raises or returns

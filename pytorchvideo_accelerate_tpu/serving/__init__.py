@@ -11,13 +11,19 @@ and the actor side of the Podracer actor/learner split — PAPERS.md):
   micro-batching (flush on size or deadline, pad to the nearest bucket,
   masked padded rows) returning per-request futures;
 - `stats.ServingStats` — rolling latency percentiles, queue depth,
-  batch-fill ratio, throughput;
+  batch-fill ratio, throughput, load-shed counters;
+- `admission.AdmissionController` — healthy/degraded/draining state
+  machine: queue-depth load shedding (503 + Retry-After) and the
+  drain-on-SIGTERM latch (docs/RELIABILITY.md);
 - `server.InferenceServer` — stdlib HTTP front (`/predict`, `/healthz`,
   `/stats`) and the `pva-tpu-serve` CLI.
 
 See docs/SERVING.md.
 """
 
+from pytorchvideo_accelerate_tpu.serving.admission import (  # noqa: F401
+    AdmissionController,
+)
 from pytorchvideo_accelerate_tpu.serving.batcher import (  # noqa: F401
     MicroBatcher,
     QueueFullError,

@@ -1,0 +1,107 @@
+"""Admission control + service health state machine.
+
+The graceful-degradation front of the serving tier (docs/RELIABILITY.md):
+a `healthy / degraded / draining` state machine driven by queue depth and
+the drain signal, deciding per request whether to admit or shed.
+
+Why shed EARLY (at `shed_hwm` requests queued, not at the hard queue
+bound): once the queue is deep, every admitted request inherits the whole
+queue's latency — the batcher keeps launching full buckets either way, so
+admitting more work past the high-water mark buys zero throughput and
+buys p99 collapse. A `503 + Retry-After` at the door is the cheapest
+response the server can produce and tells a well-behaved client exactly
+when to come back. Hysteresis (`recover_lwm` < `shed_hwm`) keeps the
+state from flapping at the boundary.
+
+`draining` is terminal-ish: entered on SIGTERM (server drain path), sheds
+everything, and `/healthz` goes non-200 so load balancers stop routing
+while in-flight futures flush.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional, Tuple
+
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
+
+HEALTHY, DEGRADED, DRAINING = "healthy", "degraded", "draining"
+
+
+@shared_state("_state")
+class AdmissionController:
+    """Queue-depth load shedding with hysteresis + a drain latch.
+
+    Thread-safe: `admit()` runs on every HTTP handler thread while
+    `start_draining()` arrives from a signal handler's helper thread.
+    """
+
+    def __init__(self, max_queue: int, shed_frac: float = 0.9,
+                 recover_frac: float = 0.5, retry_after_s: float = 1.0,
+                 on_state_change: Optional[Callable[[str, str], None]] = None):
+        if not 0.0 < shed_frac <= 1.0:
+            raise ValueError(f"shed_frac must be in (0, 1], got {shed_frac}")
+        if not 0.0 <= recover_frac <= shed_frac:
+            raise ValueError(
+                f"recover_frac must be in [0, shed_frac], got {recover_frac}")
+        self.max_queue = max(int(max_queue), 1)
+        self.shed_hwm = max(int(self.max_queue * shed_frac), 1)
+        self.recover_lwm = int(self.max_queue * recover_frac)
+        self.retry_after_s = float(retry_after_s)
+        self.on_state_change = on_state_change  # (old, new) observer
+        # live depth source (e.g. MicroBatcher.queue_depth): lets state()
+        # reads drive degraded->healthy recovery on an IDLE server — after
+        # a burst, clients back off exactly as Retry-After told them to,
+        # so without this /healthz would report degraded forever until the
+        # next /predict happened to call admit()
+        self.queue_depth_fn: Optional[Callable[[], int]] = None
+        self._lock = make_lock("AdmissionController._lock")
+        self._state = HEALTHY
+
+    # --- state ------------------------------------------------------------
+
+    def state(self) -> str:
+        with self._lock:
+            state = self._state
+        if state == DEGRADED and self.queue_depth_fn is not None:
+            try:
+                depth = int(self.queue_depth_fn())
+            except Exception:  # pragma: no cover - a broken probe can't shed
+                return state
+            if depth <= self.recover_lwm:
+                self._transition(HEALTHY)
+                return HEALTHY
+        return state
+
+    def _transition(self, new: str) -> None:
+        """Caller holds no lock; observer runs outside it (it may log)."""
+        with self._lock:
+            old = self._state
+            if old == new or old == DRAINING:  # draining never un-drains
+                return
+            self._state = new
+        if self.on_state_change is not None:
+            try:
+                self.on_state_change(old, new)
+            except Exception:  # pragma: no cover - observer must not shed
+                pass
+
+    def start_draining(self) -> None:
+        """Drain latch (SIGTERM): every subsequent request sheds; the
+        in-flight ones keep their futures."""
+        self._transition(DRAINING)
+
+    # --- the per-request decision -----------------------------------------
+
+    def admit(self, queue_depth: int) -> Tuple[bool, float]:
+        """(admit?, retry_after_s). Called BEFORE submit with the live
+        queue depth; also drives the healthy<->degraded hysteresis."""
+        with self._lock:
+            state = self._state
+        if state == DRAINING:
+            return False, self.retry_after_s
+        if queue_depth >= self.shed_hwm:
+            self._transition(DEGRADED)
+            return False, self.retry_after_s
+        if state == DEGRADED and queue_depth <= self.recover_lwm:
+            self._transition(HEALTHY)
+        return True, 0.0

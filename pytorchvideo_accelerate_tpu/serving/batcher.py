@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
 from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, clip_key
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 from pytorchvideo_accelerate_tpu.utils.sync import (
@@ -41,7 +42,14 @@ logger = get_logger("pva_tpu")
 
 
 class QueueFullError(RuntimeError):
-    """Request queue at serve.max_queue — shed load instead of buffering."""
+    """Request queue at serve.max_queue — shed load instead of buffering.
+
+    Carries `retry_after_s` so the HTTP front can tell a well-behaved
+    client when to come back (503 + Retry-After)."""
+
+    def __init__(self, message: str, retry_after_s: float = 1.0):
+        super().__init__(message)
+        self.retry_after_s = float(retry_after_s)
 
 
 @dataclass
@@ -61,7 +69,8 @@ class MicroBatcher:
 
     def __init__(self, engine, *, max_batch_size: Optional[int] = None,
                  max_wait_ms: float = 5.0, max_queue: int = 256, stats=None,
-                 heartbeat=None):
+                 heartbeat=None, retry_after_s: float = 1.0):
+        self.retry_after_s = float(retry_after_s)
         self.engine = engine
         # obs watchdog pinger: called once per flush-loop iteration (idle
         # included), so a wedged flush thread — where EVERY request stalls
@@ -104,7 +113,8 @@ class MicroBatcher:
             if self.stats is not None:
                 self.stats.observe_rejected()
             raise QueueFullError(
-                f"request queue full ({self._q.maxsize}); retry later"
+                f"request queue full ({self._q.maxsize}); retry later",
+                retry_after_s=self.retry_after_s,
             ) from None
         if self._closed.is_set() and not req.future.done():
             # close() may have drained the queue between our closed-check
@@ -118,6 +128,18 @@ class MicroBatcher:
 
     def queue_depth(self) -> int:
         return self._q.qsize()
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Wait for the queue to flush (drain-on-SIGTERM: stop ADMITTING
+        upstream first, then let in-flight futures resolve). Returns True
+        when the queue emptied within the budget; the subsequent `close()`
+        join lets the flush thread finish the batch it is running."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            if self._q.qsize() == 0:
+                return True
+            time.sleep(0.01)
+        return self._q.qsize() == 0
 
     def close(self) -> None:
         """Stop the flush thread; pending requests are failed, not dropped
@@ -190,6 +212,10 @@ class MicroBatcher:
                         req.future.set_exception(e)
 
     def _run(self, reqs: List[_Request]) -> None:
+        # chaos hook: an injected raise fails THIS batch's futures (the
+        # 500 path) without touching the flush thread — exactly what a
+        # real engine/transfer failure does. Disarmed: one global read.
+        fault_point("serve.flush")
         # claim each future before doing device work: a caller-cancelled
         # future (the HTTP front's request-timeout path) drops out of the
         # batch here, and a successful claim makes later cancel() attempts

@@ -17,20 +17,33 @@ Deliberately stdlib (`http.server.ThreadingHTTPServer`): zero new
 dependencies, and the concurrency story is honest — handler threads only
 parse JSON and block on a batcher future; all accelerator work is
 serialized behind the MicroBatcher's single flush thread. Error mapping:
-bad request -> 400, queue full -> 503, request budget exceeded -> 504.
+bad request -> 400, shed/queue full -> 503 (+ Retry-After), request budget
+exceeded -> 504 (+ Retry-After).
+
+Degradation (serving/admission.py, docs/RELIABILITY.md): a
+healthy/degraded/draining state machine sits in front of the batcher —
+queue depth past the high-water mark sheds with `503 + Retry-After`
+BEFORE latency collapses, `/healthz` reports the state (and goes 503
+while draining so load balancers stop routing), and SIGTERM on the CLI
+path drains: stop admitting, flush in-flight futures, exit 0.
 """
 
 from __future__ import annotations
 
 import json
+import signal
 import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.serving.admission import (
+    DRAINING,
+    AdmissionController,
+)
 from pytorchvideo_accelerate_tpu.serving.batcher import MicroBatcher, QueueFullError
 from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, InferenceEngine
 from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
@@ -47,30 +60,45 @@ class _Handler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # noqa: D102
         logger.debug("http: " + fmt, *args)
 
-    def _reply(self, code: int, payload: dict) -> None:
+    def _reply(self, code: int, payload: dict,
+               headers: Optional[Dict[str, str]] = None) -> None:
         body = json.dumps(payload).encode()
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
         self.end_headers()
         self.wfile.write(body)
+
+    def _reject(self, code: int, message: str, retry_after_s: float) -> None:
+        """503/504 with Retry-After: the cheapest response the server can
+        produce, and it tells a well-behaved client when to come back."""
+        self._reply(code, {"error": message, "retry_after_s": retry_after_s},
+                    headers={"Retry-After":
+                             str(max(int(round(retry_after_s)), 1))})
 
     def do_GET(self):  # noqa: N802 - stdlib API
         srv: "InferenceServer" = self.server.owner
         if self.path == "/healthz":
             eng = srv.engine
+            state = srv.admission.state()
             health = {
-                "status": "ok",
+                # the state machine IS the health answer: "healthy",
+                # "degraded" (shedding, still 200 — the replica works,
+                # don't kill it), "draining" (503 — stop routing here)
+                "status": state,
                 "model": eng.model_name,
                 "num_classes": eng.num_classes,
                 "input_dtype": eng.input_dtype,
                 "buckets": list(eng.buckets),
                 "platform": srv.platform,
+                "queue_depth": srv.batcher.queue_depth(),
             }
             if srv.expected_spec is not None:  # per-request (T, H, W, C)
                 health["clip_spec"] = {k: list(v[1:])
                                        for k, v in srv.expected_spec.items()}
-            self._reply(200, health)
+            self._reply(503 if state == DRAINING else 200, health)
         elif self.path == "/stats":
             self._reply(200, srv.stats.snapshot())
         elif self.path == "/metrics":
@@ -89,6 +117,20 @@ class _Handler(BaseHTTPRequestHandler):
         if self.path != "/predict":
             self._reply(404, {"error": f"no route {self.path}"})
             return
+        # admission control BEFORE the body is even read (serving/
+        # admission.py): a shed must be the cheapest response the server
+        # can produce — under real overload, json.loads of a multi-MB clip
+        # per shed request would saturate the host CPU anyway. The unread
+        # body forces a connection close (can't reuse the stream).
+        admitted, retry_after = srv.admission.admit(
+            srv.batcher.queue_depth())
+        if not admitted:
+            state = srv.admission.state()
+            srv.stats.observe_shed(state)
+            self.close_connection = True
+            self._reject(503, f"load shed (service {state}); retry later",
+                         retry_after)
+            return
         try:
             length = int(self.headers.get("Content-Length", 0))
             body = json.loads(self.rfile.read(length) or b"{}")
@@ -106,7 +148,7 @@ class _Handler(BaseHTTPRequestHandler):
             future = srv.batcher.submit(clip)
         except QueueFullError as e:
             # the batcher already counted this one (cause "503")
-            self._reply(503, {"error": str(e)})
+            self._reject(503, str(e), e.retry_after_s)
             return
         except ValueError as e:
             srv.stats.observe_rejected("400")
@@ -127,8 +169,9 @@ class _Handler(BaseHTTPRequestHandler):
                 obs.get_recorder().warn(
                     "504 after engine claim (request completed but client "
                     "timed out)", budget_s=srv.request_timeout_s)
-            self._reply(504, {
-                "error": f"request exceeded {srv.request_timeout_s}s budget"})
+            self._reject(
+                504, f"request exceeded {srv.request_timeout_s}s budget",
+                srv.admission.retry_after_s)
             return
         except Exception as e:  # noqa: BLE001 - batch failure surfaced per-request
             srv.stats.observe_error()
@@ -148,7 +191,8 @@ class InferenceServer:
                  stats: ServingStats, host: str = "127.0.0.1", port: int = 0,
                  request_timeout_s: float = 30.0,
                  expected_spec: Optional[dict] = None,
-                 watchdog=None):
+                 watchdog=None, admission: Optional[AdmissionController] = None,
+                 drain_grace_s: float = 10.0):
         import jax
 
         self.engine = engine
@@ -156,6 +200,15 @@ class InferenceServer:
         self.stats = stats
         self.watchdog = watchdog  # obs.Watchdog over the flush thread
         self.request_timeout_s = request_timeout_s
+        self.drain_grace_s = drain_grace_s
+        if admission is None:  # direct construction (tests, embedding)
+            q = getattr(batcher, "_q", None)
+            admission = AdmissionController(
+                max_queue=getattr(q, "maxsize", 0) or 256)
+        if admission.queue_depth_fn is None:
+            # idle degraded->healthy recovery on /healthz reads
+            admission.queue_depth_fn = batcher.queue_depth
+        self.admission = admission
         # clip-name -> (1, T, H, W, C) from the artifact's config (None =
         # accept any geometry; direct/bench construction)
         self.expected_spec = expected_spec
@@ -201,8 +254,43 @@ class InferenceServer:
         self._thread.start()
         return self
 
-    def serve_forever(self) -> None:
+    def drain(self, grace_s: Optional[float] = None) -> None:
+        """Graceful shutdown: stop admitting (every /predict sheds with
+        503 + Retry-After, /healthz goes 503 so LBs stop routing), flush
+        the in-flight futures within the grace budget, then close."""
+        self.admission.start_draining()
+        drained = self.batcher.drain(
+            self.drain_grace_s if grace_s is None else grace_s)
+        if not drained:
+            logger.warning("drain: queue not empty at grace deadline; "
+                           "remaining requests will be failed by close()")
+        self.close()
+
+    def _install_drain_handler(self) -> None:
+        """SIGTERM -> drain (CLI path only). This REPLACES the recorder's
+        dump-only SIGTERM hook, so the PR 3 evidence is written here
+        explicitly: record the signal, dump the flight ring, then drain."""
+
+        def on_term(signum, frame):
+            logger.info("SIGTERM: draining (stop admitting, flush "
+                        "in-flight, exit 0)")
+            obs.get_recorder().record("signal", "SIGTERM-drain")
+            obs.get_recorder().dump()  # flight_record.json still lands
+            # httpd.shutdown() must run off the serve_forever thread
+            from pytorchvideo_accelerate_tpu.utils.sync import make_thread
+
+            make_thread(target=self.drain, name="pva-serve-drain",
+                        daemon=True).start()
+
+        try:
+            signal.signal(signal.SIGTERM, on_term)
+        except (ValueError, OSError):  # not the main thread: no drain hook
+            pass
+
+    def serve_forever(self, drain_on_sigterm: bool = True) -> None:
         """Serve on the calling thread (the CLI path)."""
+        if drain_on_sigterm and self.drain_grace_s > 0:
+            self._install_drain_handler()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -211,6 +299,11 @@ class InferenceServer:
             self.close()
 
     def close(self) -> None:
+        # idempotent: the drain path closes, then serve_forever's finally
+        # closes again on its way out
+        if getattr(self, "_closed", False):
+            return
+        self._closed = True
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
@@ -273,12 +366,21 @@ def build_server(cfg) -> InferenceServer:
         engine.warmup(sample)
     batcher = MicroBatcher(
         engine, max_wait_ms=s.max_wait_ms, max_queue=s.max_queue,
-        stats=stats,
+        stats=stats, retry_after_s=s.retry_after_s,
         heartbeat=(watchdog.beat_fn("serve_batcher") if watchdog else None))
     stats.queue_depth_fn = batcher.queue_depth
+    admission = AdmissionController(
+        max_queue=s.max_queue, shed_frac=s.shed_queue_frac,
+        recover_frac=s.recover_queue_frac, retry_after_s=s.retry_after_s,
+        on_state_change=lambda old, new: (
+            logger.warning("serving state %s -> %s", old, new),
+            obs.get_recorder().record("serving", "state-change",
+                                      old=old, new=new)))
     return InferenceServer(engine, batcher, stats, host=s.host, port=s.port,
                            request_timeout_s=s.request_timeout_s,
-                           expected_spec=spec, watchdog=watchdog)
+                           expected_spec=spec, watchdog=watchdog,
+                           admission=admission,
+                           drain_grace_s=s.drain_grace_s)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:

@@ -82,6 +82,14 @@ class ServingStats:
         self._c_errors = self.registry.counter(
             "pva_serving_errors_total",
             "requests failed by an engine/batch error (HTTP 500)")
+        # load-shedding split OUT of the generic 503 bucket: a shed is the
+        # admission controller working as designed (degraded/draining
+        # state, serving/admission.py), not a hard queue-full failure —
+        # operators must be able to tell the two apart on /stats + /metrics
+        self._c_shed = self.registry.counter(
+            "pva_serving_shed_total",
+            "requests shed by admission control (503 + Retry-After), "
+            "by service state", labelnames=("state",))
         self._c_compiles = self.registry.counter(
             "pva_serving_compiled_buckets_total",
             "new (bucket, views) shapes compiled by the engine")
@@ -116,6 +124,12 @@ class ServingStats:
         caller saw: "400" bad request, "503" queue full, "504" budget."""
         self._c_rejected.inc(n, cause=str(cause))
 
+    def observe_shed(self, state: str = "degraded", n: int = 1) -> None:
+        """A request shed by admission control BEFORE it touched the
+        queue (503 + Retry-After, serving/admission.py); `state` is the
+        controller state that shed it ("degraded" | "draining")."""
+        self._c_shed.inc(n, state=str(state))
+
     def observe_error(self, n: int = 1) -> None:
         """A request failed by an engine/batch exception (HTTP 500)."""
         self._c_errors.inc(n)
@@ -145,6 +159,10 @@ class ServingStats:
         out["rejected"] = float(sum(rejected.values()))
         for cause in ("400", "503", "504"):
             out[f"rejected_{cause}"] = float(rejected.get(cause, 0.0))
+        # sheds (admission control) are NOT in the rejected split above:
+        # rejected_503 stays "hard queue full", shed is the controller
+        # degrading on purpose — the same split /metrics renders
+        out["shed"] = self._c_shed.total()
         vals = sorted(v for _, v in lat)
         out["p50_ms"] = round(_percentile(vals, 50) * 1e3, 3)
         out["p95_ms"] = round(_percentile(vals, 95) * 1e3, 3)

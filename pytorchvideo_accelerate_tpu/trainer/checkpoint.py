@@ -32,6 +32,11 @@ from typing import Any, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from pytorchvideo_accelerate_tpu.reliability.atomic import (
+    atomic_write,
+    atomic_write_json,
+)
+from pytorchvideo_accelerate_tpu.reliability.retry import retry_call
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 
 logger = get_logger("pva_tpu")
@@ -56,6 +61,11 @@ def export_inference(path: str, state, config=None,
     along; optimizer state does NOT (the engine never builds an optimizer,
     and the artifact is a fraction of a full checkpoint's size). Plain-numpy
     npz + JSON: loadable with no orbax and no training stack.
+
+    Both files land ATOMICALLY (tmp + fsync + os.replace, with write
+    retries): a kill or disk hiccup mid-export can never leave a truncated
+    artifact where a serving engine would find it — the exact failure the
+    `ckpt.write` fault point injects in `pva-tpu-chaos`.
     """
     from pytorchvideo_accelerate_tpu.models.convert import save_converted
 
@@ -63,7 +73,10 @@ def export_inference(path: str, state, config=None,
     params = state.ema_params if state.ema_params is not None else state.params
     tree = jax.device_get({"params": params,
                            "batch_stats": state.batch_stats or {}})
-    save_converted(tree, os.path.join(path, _WEIGHTS_FILE))
+    retry_call(
+        lambda: atomic_write(os.path.join(path, _WEIGHTS_FILE),
+                             lambda tmp: save_converted(tree, tmp)),
+        name="ckpt.write", retry_on=(OSError,))
     info = {
         "format": INFERENCE_FORMAT,
         "step": int(jax.device_get(state.step)),
@@ -72,8 +85,9 @@ def export_inference(path: str, state, config=None,
     }
     if config is not None:
         info["config"] = config.to_dict()
-    with open(os.path.join(path, _META_FILE), "w") as f:
-        json.dump(info, f, indent=1, default=str)
+    retry_call(
+        lambda: atomic_write_json(os.path.join(path, _META_FILE), info),
+        name="ckpt.write", retry_on=(OSError,))
     logger.info("exported inference artifact to %s (step %d, ema=%s)",
                 path, info["step"], info["ema_resolved"])
     return path
@@ -111,8 +125,20 @@ class Checkpointer:
     by `wait()`/`close()`.
     """
 
-    def __init__(self, directory: str, max_to_keep: int = 0, use_async: bool = True):
+    def __init__(self, directory: str, max_to_keep: int = 0,
+                 use_async: bool = True, retries: int = 3,
+                 retry_base_delay_s: float = 0.05,
+                 retry_max_delay_s: float = 2.0,
+                 retry_deadline_s: float = 30.0):
         self.directory = os.path.abspath(directory)
+        # total attempts per save dispatch: transient filesystem failures
+        # (ENOSPC races, cold network mounts) retry with backoff instead
+        # of killing the run mid-epoch (reliability/retry.py); the shape
+        # kwargs mirror --reliability.retry_{base_delay,max_delay,deadline}_s
+        self.retries = max(int(retries), 1)
+        self.retry_base_delay_s = float(retry_base_delay_s)
+        self.retry_max_delay_s = float(retry_max_delay_s)
+        self.retry_deadline_s = float(retry_deadline_s)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep if max_to_keep > 0 else None,
             enable_async_checkpointing=use_async,
@@ -121,13 +147,32 @@ class Checkpointer:
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
 
     def save(self, step: int, state: Any, extra: Optional[dict] = None) -> None:
-        self._mgr.save(
-            int(step),
-            args=ocp.args.Composite(
-                state=ocp.args.StandardSave(state),
-                extra=ocp.args.JsonSave(extra or {}),
-            ),
-        )
+        step = int(step)
+
+        def save_once():
+            # orbax's save(step) is NOT idempotent (it refuses a duplicate
+            # step), so a retry must first check whether the failed attempt
+            # actually committed — otherwise a transient OSError after the
+            # commit point would turn attempt 2 into a misleading
+            # "step already exists" crash instead of a recovery. (With
+            # async checkpointing the dispatch below rarely fails itself —
+            # background write errors surface in wait()/close(); the retry
+            # mainly protects the sync path, e.g. the emergency save.)
+            if step in (self._mgr.all_steps() or ()):
+                return
+            self._mgr.save(
+                step,
+                args=ocp.args.Composite(
+                    state=ocp.args.StandardSave(state),
+                    extra=ocp.args.JsonSave(extra or {}),
+                ),
+            )
+
+        retry_call(save_once, name="ckpt.save", attempts=self.retries,
+                   retry_on=(OSError,),
+                   base_delay_s=self.retry_base_delay_s,
+                   max_delay_s=self.retry_max_delay_s,
+                   deadline_s=self.retry_deadline_s)
 
     def restore(
         self, state_template: Any, step: Optional[int] = None, mesh=None
@@ -175,7 +220,19 @@ class Checkpointer:
                 "re-convert the original weights or retrain; see MIGRATING.md "
                 "'Checkpoint layout changes'."
             ) from e
-        return restored["state"], dict(restored["extra"] or {}), int(step)
+        # Re-materialize every restored leaf into a fresh XLA-owned buffer
+        # (.copy() preserves sharding). Orbax hands back arrays backed by
+        # tensorstore-owned host memory; with the persistent compilation
+        # cache enabled, donating those into the deserialized train step
+        # corrupts the heap in the pinned jaxlib ("corrupted double-linked
+        # list" / segfault a step or two after resume — reproduced by
+        # pva-tpu-chaos's preempt leg, which resumes mid-epoch and trains).
+        # One whole-state copy at resume time is noise next to restore IO.
+        state = jax.tree.map(
+            lambda a: a.copy() if isinstance(a, jax.Array) else a,
+            restored["state"],
+        )
+        return state, dict(restored["extra"] or {}), int(step)
 
     def latest_step(self) -> Optional[int]:
         return self._mgr.latest_step()

@@ -36,6 +36,11 @@ from pytorchvideo_accelerate_tpu.parallel.distributed import (
 )
 from pytorchvideo_accelerate_tpu.parallel.mesh import data_shard_count, make_mesh
 from pytorchvideo_accelerate_tpu.parallel.sharding import shard_params, shard_state
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
+from pytorchvideo_accelerate_tpu.reliability.preemption import (
+    get_guard,
+    record_emergency,
+)
 from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
     Checkpointer,
     resolve_resume_path,
@@ -160,6 +165,10 @@ class Trainer:
                 resume_dir or ckpt_dir,
                 max_to_keep=cfg.checkpoint.max_to_keep,
                 use_async=cfg.checkpoint.async_checkpoint,
+                retries=cfg.reliability.ckpt_retries,
+                retry_base_delay_s=cfg.reliability.retry_base_delay_s,
+                retry_max_delay_s=cfg.reliability.retry_max_delay_s,
+                retry_deadline_s=cfg.reliability.retry_deadline_s,
             )
 
         # user-registered checkpoint participants (reference
@@ -173,7 +182,9 @@ class Trainer:
                 str(cfg.tracking.logging_dir)
                 .replace(".", "").replace("/", "").replace("\\", "")
             )  # reference run-name derivation (run.py:229)
-            self.trackers = TrackerHub(cfg.tracking.trackers, cfg.tracking.logging_dir)
+            self.trackers = TrackerHub(cfg.tracking.trackers,
+                                       cfg.tracking.logging_dir,
+                                       retries=cfg.reliability.tracker_retries)
             self.trackers.start(run_name, cfg.to_dict())
 
     # --- construction -----------------------------------------------------
@@ -258,6 +269,10 @@ class Trainer:
             )
             num_classes = self.train_source.num_classes
         else:
+            video_retry_kw = dict(
+                decode_retries=cfg.reliability.decode_retries,
+                retry_base_delay_s=cfg.reliability.retry_base_delay_s,
+            )
             if d.train_list or d.val_list:
                 if not (d.train_list and d.val_list):
                     raise ValueError(
@@ -280,11 +295,11 @@ class Trainer:
             num_classes = train_manifest.num_classes  # replaces run.py:185
             self.train_source = VideoClipSource(
                 train_manifest, train_tf, cfg.clip_duration, training=True,
-                seed=cfg.seed,
+                seed=cfg.seed, **video_retry_kw,
             )
             self.val_source = VideoClipSource(
                 val_manifest, val_tf, cfg.clip_duration, training=False,
-                seed=cfg.seed, num_clips=eval_clips,
+                seed=cfg.seed, num_clips=eval_clips, **video_retry_kw,
             )
         self.num_classes = num_classes
 
@@ -574,6 +589,42 @@ class Trainer:
             },
         )
 
+    def _emergency_save(self, epoch: int, reason: str = "") -> None:
+        """Preemption grace (reliability/preemption.py): persist the exact
+        consumed position — an orbax checkpoint (kind "preempt", the
+        loader's consumed position in `extra`) plus the atomic
+        `emergency_checkpoint.json` breadcrumb — and dump the flight ring.
+        Creates a checkpointer on demand when checkpointing was off: a
+        preempted run must still be resumable with `resume=auto`."""
+        cfg = self.cfg
+        if self.checkpointer is None:
+            self.checkpointer = Checkpointer(
+                os.path.join(cfg.checkpoint.output_dir, "checkpoints"),
+                max_to_keep=cfg.checkpoint.max_to_keep,
+                use_async=False, retries=cfg.reliability.ckpt_retries,
+                retry_base_delay_s=cfg.reliability.retry_base_delay_s,
+                retry_max_delay_s=cfg.reliability.retry_max_delay_s,
+                retry_deadline_s=cfg.reliability.retry_deadline_s,
+            )
+        step = int(self.state.step)  # pva: disable=host-sync -- preemption exit path, the run is over
+        if self.checkpointer.latest_step() != step:
+            # != instead of unconditional: a checkpointing_steps boundary
+            # may have saved this very step already (orbax refuses a
+            # duplicate step; the data is on disk either way)
+            self._save("preempt", epoch)
+        self.checkpointer.wait()  # ON DISK before the process may exit
+        record_emergency(cfg.checkpoint.output_dir, step=step, epoch=epoch,
+                         checkpoint_dir=self.checkpointer.directory,
+                         reason=reason)
+        if self.obs_on:
+            recorder = obs.get_recorder()
+            recorder.record("preempt", "emergency checkpoint saved",
+                            step=step, epoch=epoch)
+            recorder.dump()
+        main_print(
+            f"preempted ({reason or 'requested'}): emergency checkpoint at "
+            f"step {step}; resume with --resume_from_checkpoint auto")
+
     def _run_eval(self, epoch: int) -> tuple:
         """One pass over the val loader with in-graph masked metric sums
         (shared by fit()'s per-epoch eval and evaluate());
@@ -740,6 +791,16 @@ class Trainer:
         if self.watchdog is not None:
             self.watchdog.start()  # re-arm after a prior fit/evaluate
             self.watchdog.heartbeat("train")
+        # preemption grace (reliability/preemption.py): SIGTERM/SIGINT set
+        # an Event; the step loop polls it once per step (no locks, no
+        # syncs) and exits through the emergency-save path below. NOTE the
+        # semantics change vs PR 3: with the guard installed, the first
+        # signal no longer falls through to the flight recorder's re-raise
+        # death — it drains gracefully; a second signal still kills.
+        guard = get_guard() if cfg.reliability.graceful_shutdown else None
+        if guard is not None:
+            guard.install()
+        preempted = False
         window_t0 = time.perf_counter()
         try:
             for epoch in range(starting_epoch, cfg.optim.num_epochs):
@@ -767,6 +828,9 @@ class Trainer:
                             and gstep - run_start_step == 2):
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling = True
+                    # chaos hook: "delay" = a slow dispatch, "raise" = a
+                    # failing one. Disarmed: one global read.
+                    fault_point("step.dispatch")
                     # "step" span = dispatch time; under async dispatch it
                     # absorbs compute only when the dispatch queue pushes
                     # back (or at compile), which is exactly the reading
@@ -827,8 +891,26 @@ class Trainer:
                             and gstep % self.checkpointing_steps == 0):
                         self._save("step", epoch)
                         main_print(f"saved checkpoint at step {gstep}")
+                    if guard is not None and guard.requested:
+                        # finish-the-step-then-leave: the dispatch above
+                        # has returned, so breaking here never abandons an
+                        # in-flight optimizer update
+                        preempted = True
+                        break
                     if 0 <= cfg.data.limit_train_batches <= step_in_epoch + 1:
                         break
+                if preempted:
+                    # grace path: sync the last step's result, flush the
+                    # pending log, persist, and leave — no eval, no
+                    # further epochs, exit 0 (resume=auto lands here)
+                    if metrics is not None:
+                        with obs.span("sync"):
+                            fetch_loss(metrics)
+                    if deferred is not None:
+                        deferred.flush()
+                    self._emergency_save(
+                        epoch, reason=guard.reason if guard else "")
+                    break
                 if metrics is not None:
                     # value-fetch sync, never block_until_ready (acked
                     # early by forwarding backends — would end the epoch
@@ -958,11 +1040,15 @@ class Trainer:
             if self.watchdog is not None:
                 self.watchdog.clear("train")
                 self.watchdog.stop()
+            if guard is not None:
+                guard.uninstall()  # restore the pre-fit signal handlers
 
         if self.trackers:
             self.trackers.finish()
-        # final save (reference run.py:325, minus its NameError footgun)
-        self._save("final", cfg.optim.num_epochs - 1)
+        # final save (reference run.py:325, minus its NameError footgun);
+        # a preempted run already persisted this exact step
+        if not preempted:
+            self._save("final", cfg.optim.num_epochs - 1)
         if self.checkpointer:
             self.checkpointer.close()
         if use_tqdm:
@@ -971,7 +1057,8 @@ class Trainer:
         self.val_loader.close()
         result = {"train_loss": last_train_loss, "steps": int(self.state.step),  # pva: disable=host-sync -- fit() exit: training is over, the sync is free
                   "epoch_train_times": epoch_train_times,
-                  "flops_per_step": self._flops_per_step, **last_perf}
+                  "flops_per_step": self._flops_per_step,
+                  "preempted": preempted, **last_perf}
         if self.is_pretraining:
             result["val_recon_loss"] = last_val_loss
         else:

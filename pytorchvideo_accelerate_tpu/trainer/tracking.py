@@ -16,6 +16,8 @@ import os
 import time
 from typing import Dict, List, Optional
 
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
+from pytorchvideo_accelerate_tpu.reliability.retry import retry_call
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
 
@@ -150,10 +152,13 @@ class TrackerHub:
     """Fan-out facade: `init_trackers`/`log`/`end_training` equivalents
     (reference run.py:231,274,323). Construct on the main process only.
 
-    Fan-out is NON-FATAL: a raising tracker (broken tensorboard install,
-    wandb network hiccup, full disk under the jsonl file) is warned about
-    once and disabled — a logging failure must never kill a training step.
-    The surviving trackers keep logging.
+    Fan-out is NON-FATAL and RETRIED: a raising tracker (broken
+    tensorboard install, wandb network hiccup, full disk under the jsonl
+    file) gets `retries` total attempts with short backoff
+    (reliability/retry.py — tracker outages are usually transient), and
+    only an exhausted budget disables it — a logging failure must never
+    kill a training step, and a blip must not cost the rest of the run's
+    metrics. The surviving trackers keep logging.
 
     The disable path REBINDS `self.trackers` under a lock instead of
     mutating the live list: `log()` is called from the train loop and from
@@ -161,21 +166,32 @@ class TrackerHub:
     `list.remove` racing a concurrent fan-out's iteration copy — two
     threads disabling at once could resurrect a just-removed tracker."""
 
-    def __init__(self, spec: str, logging_dir: str):
+    def __init__(self, spec: str, logging_dir: str, retries: int = 2):
         self._lock = make_lock("TrackerHub._lock")
         self.trackers = resolve_trackers(spec, logging_dir)
+        self.retries = max(int(retries), 1)
 
     def _fanout(self, op: str, fn) -> None:
         with self._lock:
             trackers = list(self.trackers)
         for t in trackers:
-            try:
+            def attempt(t=t):
+                # chaos hook: an injected raise exercises exactly the
+                # retry-then-disable path a real tracker outage takes
+                fault_point("tracker.log")
                 fn(t)
+
+            try:
+                retry_call(attempt, name=f"tracker.{op}",
+                           attempts=self.retries, retry_on=(Exception,),
+                           base_delay_s=0.02, max_delay_s=0.25,
+                           deadline_s=2.0)
             except Exception as e:  # noqa: BLE001 - any tracker bug qualifies
                 logger.warning(
-                    "tracker %r raised in %s (%s: %s); disabling it — "
-                    "a logging failure must never kill a training step",
-                    t.name, op, type(e).__name__, e)
+                    "tracker %r raised in %s (%s: %s) after %d attempt(s); "
+                    "disabling it — a logging failure must never kill a "
+                    "training step",
+                    t.name, op, type(e).__name__, e, self.retries)
                 with self._lock:
                     self.trackers = [x for x in self.trackers if x is not t]
                 try:

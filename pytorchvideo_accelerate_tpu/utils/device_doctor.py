@@ -328,6 +328,45 @@ def tsan_snapshot() -> dict:
     return out
 
 
+def reliability_snapshot(output_dir: str = "") -> dict:
+    """Resilience health (reliability/ — docs/RELIABILITY.md): the armed
+    fault plan if a chaos run is live (production must read `None`), the
+    fired-fault history length, the retry/fault counters from the default
+    registry, and — given the run's output_dir — the last emergency-
+    checkpoint record (where a preempted run stopped, and the directory
+    `resume=auto` will pick up)."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.obs import get_registry
+        from pytorchvideo_accelerate_tpu.reliability import faults
+        from pytorchvideo_accelerate_tpu.reliability.preemption import (
+            read_emergency_record,
+        )
+
+        plan = faults.current_plan()
+        out["fault_plan_armed"] = plan is not None
+        if plan is not None:
+            out["fault_plan"] = plan.to_dict()
+        out["fault_fires"] = len(faults.fault_history())
+        reg = get_registry()
+        counters: dict = {}
+        for name in ("pva_retry_attempts_total", "pva_retry_giveups_total",
+                     "pva_retry_recoveries_total",
+                     "pva_fault_injected_total"):
+            m = reg.get(name)
+            if m is None:
+                continue
+            counters[name] = {
+                ",".join(f"{k}={v}" for k, v in labels.items()) or "total":
+                value for labels, value in m.samples()}
+        out["retry_counters"] = counters
+        if output_dir:
+            out["emergency_checkpoint"] = read_emergency_record(output_dir)
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def diagnose(timeout_s: int = 120, skip_init: bool = False,
              variants: bool = False, obs_dir: str = "") -> dict:
     rec = {
@@ -339,6 +378,7 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "obs": obs_snapshot(obs_dir),
         "lint": lint_snapshot(),
         "tsan": tsan_snapshot(),
+        "reliability": reliability_snapshot(obs_dir),
     }
     if not skip_init:
         rec["verbose_init"] = verbose_init_attempt(timeout_s)

@@ -1,15 +1,21 @@
 #!/usr/bin/env bash
-# The full static+dynamic analysis gate (docs/STATIC_ANALYSIS.md): the
-# pva-tpu-lint AST pass over the package tree, then a short pva-tpu-tsan
+# The full analysis gate (docs/STATIC_ANALYSIS.md + docs/RELIABILITY.md):
+# the pva-tpu-lint AST pass over the package tree, a short pva-tpu-tsan
 # stress pass (lockset races + lock-order cycles over the threaded
-# data/train/serve layers). Exit codes: 0 clean, 1 findings, 2 usage —
-# CI gates on nonzero. Extra args pass through to the lint step only
+# data/train/serve layers), then the pva-tpu-chaos fault-injection
+# scenario (retry/preemption/shedding recovery asserted under seeded
+# faults). Exit codes: 0 clean, 1 findings, 2 usage — CI gates on
+# nonzero. Extra args pass through to the lint step only
 # (e.g. `scripts/analyze.sh --select host-sync`).
 set -euo pipefail
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 
 "${ROOT}/scripts/lint.sh" "$@"
 
-exec env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python -m pytorchvideo_accelerate_tpu.analysis.tsan_report --smoke
+
+exec env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m pytorchvideo_accelerate_tpu.reliability.chaos --smoke

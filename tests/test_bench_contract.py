@@ -141,6 +141,7 @@ def test_finalize_line_fits_driver_capture():
         "trainer_vs_rawstep": 0.934, "trainer_mfu": 0.1234,
         "obs_step_s": 0.012345, "obs_input_wait_frac": 0.0123,
         "obs_h2d_s": 0.001234, "train_recompiles": 0, "tsan_findings": 0,
+        "chaos_findings": 0,
         "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
         "error": "watchdog fired: " + "y" * 3000,
         "probe_attempts": [
@@ -191,6 +192,16 @@ def test_finalize_tsan_findings_ride_the_headline():
     assert out["tsan_findings"] == 0
     out = bench.finalize(_model(), {"tsan_findings": 2}, user_smoke=False)
     assert out["tsan_findings"] == 2
+
+
+def test_finalize_chaos_findings_ride_the_headline():
+    """The resilience verdict (pva-tpu-chaos scenario;
+    reliability/chaos.py) plumbs through finalize onto the headline
+    line — the number `--smoke` asserts 0 at the gate site."""
+    out = bench.finalize(_model(), {"chaos_findings": 0}, user_smoke=False)
+    assert out["chaos_findings"] == 0
+    out = bench.finalize(_model(), {"chaos_findings": 3}, user_smoke=False)
+    assert out["chaos_findings"] == 3
 
 
 def test_finalize_serving_lane_keys():

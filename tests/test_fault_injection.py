@@ -68,8 +68,10 @@ class TestShmWorkerDeath:
 
 class TestCorruptCheckpoint:
     def test_truncated_checkpoint_fails_cleanly(self, mesh8, tmp_path):
-        """Deleting files from the latest checkpoint must raise an
-        informative error, not hang or return garbage state."""
+        """FOREIGN corruption (files deleted out from under orbax) must
+        raise an informative error, not hang or return garbage state.
+        Our OWN plain-file writers can no longer produce this state at
+        all — see test_our_writer_cannot_truncate below."""
         import optax
 
         from pytorchvideo_accelerate_tpu.trainer.checkpoint import Checkpointer
@@ -96,6 +98,55 @@ class TestCorruptCheckpoint:
             ck2.restore(state)
         assert "1" in str(ei.value) or "checkpoint" in str(ei.value).lower()
         ck2.close()
+
+    def test_our_writer_cannot_truncate(self, tmp_path):
+        """The atomic writer (reliability/atomic.py: tmp + fsync +
+        os.replace) flips truncation from "detected cleanly" to "cannot
+        happen": a kill mid-write — injected between the tmp write and
+        the rename — leaves the destination byte-identical to the last
+        complete write, and the retried export lands complete. The
+        inference-export artifact goes through this writer."""
+        import optax
+
+        from pytorchvideo_accelerate_tpu.reliability import faults
+        from pytorchvideo_accelerate_tpu.reliability.atomic import (
+            atomic_write_json,
+        )
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+            export_inference,
+            load_inference,
+        )
+        from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+
+        dst = tmp_path / "meta.json"
+        atomic_write_json(str(dst), {"v": 1})
+        faults.arm(faults.FaultPlan(0, [faults.FaultSpec(
+            "ckpt.write", kind="partial_write")]))
+        try:
+            with pytest.raises(faults.InjectedFault):
+                atomic_write_json(str(dst), {"v": 2, "pad": "x" * 500})
+        finally:
+            faults.disarm()  # early-alphabet: a leak corrupts the suite
+        import json as _json
+
+        assert _json.loads(dst.read_text()) == {"v": 1}
+
+        # end to end: the export artifact retries through one injected
+        # write death and still loads complete, no tmp litter
+        state = TrainState.create(
+            {"w": jnp.ones((4, 4))}, {}, optax.sgd(0.1))
+        art = tmp_path / "artifact"
+        faults.arm(faults.FaultPlan(0, [faults.FaultSpec(
+            "ckpt.write", kind="partial_write", at_hits=(0,),
+            max_fires=1)]))
+        try:
+            export_inference(str(art), state,
+                             meta={"num_classes": 4, "model": "tiny"})
+        finally:
+            faults.disarm()
+        params, _stats, meta = load_inference(str(art))
+        assert "w" in params and meta["num_classes"] == 4
+        assert not [f for f in os.listdir(art) if ".tmp" in f]
 
     def test_resume_auto_with_no_checkpoint_starts_fresh(self, tmp_path):
         """`--resume_from_checkpoint auto` against an empty output dir must

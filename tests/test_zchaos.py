@@ -1,0 +1,391 @@
+"""Resilience layer (reliability/ + serving admission — docs/RELIABILITY.md):
+seeded fault plans replay exactly, retries honor their deadline, SIGTERM
+takes the grace path and resume=auto lands on the exact step, serving sheds
+under overload and recovers, and disarmed fault points are structurally
+zero-overhead.
+
+Late-alphabet name on purpose: tier-1 is timeout-bound (ROADMAP), and the
+preemption round-trip below runs two tiny fits.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.reliability import faults
+from pytorchvideo_accelerate_tpu.reliability.atomic import (
+    atomic_write_bytes,
+    atomic_write_json,
+)
+from pytorchvideo_accelerate_tpu.reliability.faults import (
+    FaultPlan,
+    FaultSpec,
+    InjectedFault,
+)
+from pytorchvideo_accelerate_tpu.reliability.retry import retry_call
+
+
+@pytest.fixture(autouse=True)
+def _always_disarmed():
+    """No test may leak an armed plan into the rest of the suite."""
+    yield
+    faults.disarm()
+
+
+# --- fault plans -------------------------------------------------------------
+
+class TestFaultPlan:
+    def test_same_seed_replays_byte_identical_sequence(self):
+        def run(seed):
+            faults.arm(FaultPlan(seed, [
+                FaultSpec("decode.read", kind="raise", p=0.3),
+                FaultSpec("step.dispatch", kind="delay", p=0.2,
+                          delay_s=0.0),
+            ]))
+            try:
+                for _ in range(100):
+                    try:
+                        faults.fault_point("decode.read")
+                    except InjectedFault:
+                        pass
+                    faults.fault_point("step.dispatch")
+            finally:
+                faults.disarm()
+            return [(e["point"], e["hit"], e["kind"])
+                    for e in faults.fault_history()]
+
+        a, b, c = run(7), run(7), run(8)
+        assert a and a == b, "same seed must fire the identical sequence"
+        assert a != c, "different seeds should differ (p=0.3 over 100 hits)"
+
+    def test_at_hits_and_max_fires(self):
+        faults.arm(FaultPlan(0, [FaultSpec("x", at_hits=(1, 3, 5),
+                                           max_fires=2)]))
+        fired = []
+        for i in range(8):
+            try:
+                faults.fault_point("x")
+            except InjectedFault:
+                fired.append(i)
+        assert fired == [1, 3], "max_fires=2 must stop the third"
+
+    def test_partial_write_truncates_and_raises(self, tmp_path):
+        victim = tmp_path / "victim.bin"
+        victim.write_bytes(b"A" * 100)
+        faults.arm(FaultPlan(0, [FaultSpec("ckpt.write",
+                                           kind="partial_write")]))
+        with pytest.raises(InjectedFault):
+            faults.fault_point("ckpt.write", write_path=str(victim))
+        assert victim.read_bytes() == b"A" * 50
+
+    def test_partial_write_never_touches_a_read_sites_source(self, tmp_path):
+        """A mis-authored partial_write spec at a READ point (decode.read
+        passes the real dataset file as evidence `path`) must degrade to a
+        plain raise — the harness injects recoverable failures, it never
+        corrupts source data."""
+        src = tmp_path / "real_video.mp4"
+        src.write_bytes(b"A" * 100)
+        faults.arm(FaultPlan(0, [FaultSpec("decode.read",
+                                           kind="partial_write")]))
+        with pytest.raises(InjectedFault):
+            faults.fault_point("decode.read", path=str(src))
+        assert src.read_bytes() == b"A" * 100
+
+    def test_disarmed_is_structurally_zero_overhead(self):
+        """Disarmed, fault_point must be one global read + return: no
+        plan object is consulted, no history recorded, no RNG touched."""
+        faults.disarm()
+        assert faults.current_plan() is None
+        plan = FaultPlan(0, [FaultSpec("hot", kind="raise", p=1.0)])
+        before = len(plan.history)
+        for _ in range(1000):
+            faults.fault_point("hot")  # p=1.0: ANY consultation would raise
+        assert len(plan.history) == before
+        assert plan._hits == {}, "disarmed hits must never be numbered"
+
+    def test_plan_round_trips_through_dict(self):
+        plan = FaultPlan(9, [FaultSpec("a", kind="delay", p=0.5,
+                                       delay_s=0.2),
+                             FaultSpec("b", at_hits=(2,), max_fires=1)])
+        clone = FaultPlan.from_dict(plan.to_dict())
+        assert clone.to_dict() == plan.to_dict()
+
+
+# --- retry -------------------------------------------------------------------
+
+class TestRetry:
+    def test_recovers_after_transient_failures(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise OSError("transient")
+            return "ok"
+
+        assert retry_call(flaky, name="t", attempts=5,
+                          base_delay_s=0.001) == "ok"
+        assert len(calls) == 3
+
+    def test_exhausted_budget_reraises_the_real_error(self):
+        with pytest.raises(OSError, match="forever"):
+            retry_call(lambda: (_ for _ in ()).throw(OSError("forever")),
+                       name="t", attempts=3, base_delay_s=0.001)
+
+    def test_backoff_honors_deadline(self):
+        """A retry loop must never outlive its caller's budget: with big
+        per-try delays and a 0.2s deadline, the call gives up early."""
+        t0 = time.monotonic()
+        with pytest.raises(OSError):
+            retry_call(lambda: (_ for _ in ()).throw(OSError("x")),
+                       name="t", attempts=50, base_delay_s=0.5,
+                       max_delay_s=5.0, deadline_s=0.2)
+        assert time.monotonic() - t0 < 0.6
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def boom():
+            calls.append(1)
+            raise ValueError("not retryable")
+
+        with pytest.raises(ValueError):
+            retry_call(boom, name="t", attempts=5, retry_on=(OSError,),
+                       base_delay_s=0.001)
+        assert len(calls) == 1
+
+    def test_counters_land_in_the_registry(self):
+        from pytorchvideo_accelerate_tpu.obs import get_registry
+
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 2:
+                raise OSError("x")
+
+        retry_call(flaky, name="zchaos-op", attempts=3, base_delay_s=0.001)
+        c = get_registry().get("pva_retry_attempts_total")
+        assert c is not None and c.value(op="zchaos-op") >= 1.0
+        r = get_registry().get("pva_retry_recoveries_total")
+        assert r is not None and r.value(op="zchaos-op") >= 1.0
+
+
+# --- atomic writes -----------------------------------------------------------
+
+class TestAtomicWrite:
+    def test_failed_write_preserves_old_content(self, tmp_path):
+        """A mid-write death (partial_write fault between write and
+        rename) must leave the OLD complete file, never a prefix."""
+        dst = tmp_path / "state.json"
+        atomic_write_json(str(dst), {"v": 1})
+        faults.arm(FaultPlan(0, [FaultSpec("ckpt.write",
+                                           kind="partial_write")]))
+        with pytest.raises(InjectedFault):
+            atomic_write_json(str(dst), {"v": 2, "pad": "x" * 1000})
+        faults.disarm()
+        assert json.loads(dst.read_text()) == {"v": 1}
+        assert not [f for f in os.listdir(tmp_path) if ".tmp" in f]
+
+    def test_retried_write_lands_complete(self, tmp_path):
+        dst = tmp_path / "out.bin"
+        faults.arm(FaultPlan(0, [FaultSpec("ckpt.write", kind="raise",
+                                           at_hits=(0,), max_fires=1)]))
+        retry_call(lambda: atomic_write_bytes(str(dst), b"B" * 256),
+                   name="ckpt.write", attempts=3, base_delay_s=0.001)
+        faults.disarm()
+        assert dst.read_bytes() == b"B" * 256
+        assert len(faults.fault_history()) == 1
+
+
+# --- tracker retry -----------------------------------------------------------
+
+def test_tracker_transient_outage_recovers_without_metric_loss(tmp_path):
+    from pytorchvideo_accelerate_tpu.trainer.tracking import TrackerHub
+
+    hub = TrackerHub("jsonl", str(tmp_path), retries=3)
+    hub.start("r", {})
+    faults.arm(FaultPlan(0, [FaultSpec("tracker.log", kind="raise",
+                                       at_hits=(1,), max_fires=1)]))
+    for i in range(3):
+        hub.log({"x": float(i)}, step=i)
+    faults.disarm()
+    hub.finish()
+    assert len(hub.trackers) == 1, "retry must keep the tracker alive"
+    lines = [json.loads(ln) for ln in
+             (tmp_path / "r.jsonl").read_text().splitlines()]
+    assert [ln["step"] for ln in lines if "step" in ln] == [0, 1, 2]
+
+
+def test_tracker_permanent_outage_disables_not_raises(tmp_path):
+    from pytorchvideo_accelerate_tpu.trainer.tracking import TrackerHub
+
+    hub = TrackerHub("jsonl", str(tmp_path), retries=2)
+    hub.start("r2", {})
+    faults.arm(FaultPlan(0, [FaultSpec("tracker.log", kind="raise")]))
+    hub.log({"x": 1.0}, step=0)  # must not raise
+    faults.disarm()
+    assert hub.trackers == []
+
+
+# --- serving: shed, recover, drain ------------------------------------------
+
+def test_admission_sheds_then_recovers_with_hysteresis():
+    from pytorchvideo_accelerate_tpu.serving.admission import (
+        AdmissionController,
+    )
+
+    ac = AdmissionController(max_queue=10, shed_frac=0.8, recover_frac=0.3,
+                             retry_after_s=1.5)
+    assert ac.admit(0) == (True, 0.0)
+    ok, retry_after = ac.admit(8)
+    assert not ok and retry_after == 1.5 and ac.state() == "degraded"
+    # above the low-water mark: still degraded, but admitting
+    assert ac.admit(5)[0] and ac.state() == "degraded"
+    ac.admit(2)
+    assert ac.state() == "healthy"
+    ac.start_draining()
+    assert ac.state() == "draining" and not ac.admit(0)[0]
+    ac.admit(0)  # draining never un-drains
+    assert ac.state() == "draining"
+
+
+def test_admission_recovers_on_idle_healthz_read():
+    """After a burst ends, clients back off exactly as Retry-After told
+    them to — with no further admit() calls, /healthz state() reads must
+    still drive degraded -> healthy off the live (drained) queue depth."""
+    from pytorchvideo_accelerate_tpu.serving.admission import (
+        AdmissionController,
+    )
+
+    depth = [8]
+    ac = AdmissionController(max_queue=10, shed_frac=0.8, recover_frac=0.3)
+    ac.queue_depth_fn = lambda: depth[0]
+    assert not ac.admit(8)[0] and ac.state() == "degraded"
+    depth[0] = 0  # queue drains, zero traffic arrives
+    assert ac.state() == "healthy"  # the read itself recovered it
+    # but a state() read never un-drains
+    ac.start_draining()
+    assert ac.state() == "draining"
+
+
+def test_serving_overload_shed_and_recovery():
+    """The chaos serve leg IS the test: synthetic overload sheds with
+    Retry-After, an injected flush fault fails one batch (not the
+    thread), the state machine recovers to healthy, drain runs clean."""
+    from pytorchvideo_accelerate_tpu.reliability import chaos
+
+    report = {"findings": [], "legs": {}}
+    chaos.leg_serve(report, seed=42, log=lambda m: None)
+    assert report["findings"] == [], report["findings"]
+    leg = report["legs"]["serve"]
+    assert leg["shed"] > 0 and leg["served"] > 0
+    assert leg["recovered_state"] == "healthy" and leg["drained"]
+    assert leg["stats_shed"] > 0  # the /stats + /metrics counter moved
+
+
+def test_queue_full_error_carries_retry_after():
+    from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
+
+    e = QueueFullError("full", retry_after_s=2.5)
+    assert e.retry_after_s == 2.5
+
+
+def test_stats_shed_split_from_rejected():
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+
+    s = ServingStats(window=8)
+    s.observe_shed("degraded")
+    s.observe_rejected("503")
+    snap = s.snapshot()
+    assert snap["shed"] == 1.0 and snap["rejected_503"] == 1.0
+    assert snap["rejected"] == 1.0, "sheds must NOT inflate rejected"
+    assert "pva_serving_shed_total" in s.registry.render()
+
+
+# --- preemption: SIGTERM -> emergency save -> resume=auto -------------------
+
+def test_sigterm_sets_guard_without_killing():
+    import signal
+
+    from pytorchvideo_accelerate_tpu.reliability.preemption import (
+        PreemptionGuard,
+    )
+
+    g = PreemptionGuard()
+    if not g.install():
+        pytest.skip("not the main thread")
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.monotonic() + 2.0
+        while time.monotonic() < deadline and not g.requested:
+            time.sleep(0.005)
+        assert g.requested and g.reason == "SIGTERM"
+    finally:
+        g.uninstall()
+    # handlers restored: a fresh install sees a clean slate
+    assert not g.requested
+
+
+def test_zz_preempt_resume_round_trip(tmp_path):
+    """The chaos preempt leg IS the test: a real mid-epoch SIGTERM under
+    slow-worker faults → grace path → emergency checkpoint at the
+    consumed step → resume=auto lands exactly there and finishes with
+    the full-run step count (loader position intact — any skip or replay
+    would change the total)."""
+    from pytorchvideo_accelerate_tpu.reliability import chaos
+
+    report = {"findings": [], "legs": {}}
+    chaos.leg_preempt(report, str(tmp_path), seed=42, log=lambda m: None)
+    assert report["findings"] == [], report["findings"]
+    leg = report["legs"]["preempt"]
+    assert leg["preempted"] is True
+    assert 0 < leg["emergency"]["step"] < leg["total_steps"]
+    assert leg["resumed_to"] == leg["total_steps"]
+    # the breadcrumb the doctor reads
+    rec = json.load(open(os.path.join(tmp_path, "run",
+                                      "emergency_checkpoint.json")))
+    assert rec["step"] == leg["emergency"]["step"]
+    assert rec["reason"] == "SIGTERM"
+
+
+# --- doctor + bench surfaces -------------------------------------------------
+
+def test_doctor_reliability_snapshot(tmp_path):
+    from pytorchvideo_accelerate_tpu.reliability.preemption import (
+        record_emergency,
+    )
+    from pytorchvideo_accelerate_tpu.utils.device_doctor import (
+        reliability_snapshot,
+    )
+
+    record_emergency(str(tmp_path), step=17, epoch=1,
+                     checkpoint_dir=str(tmp_path / "checkpoints"),
+                     reason="SIGTERM")
+    faults.arm(FaultPlan(3, [FaultSpec("decode.read", p=0.1)]))
+    snap = reliability_snapshot(str(tmp_path))
+    faults.disarm()
+    assert snap["fault_plan_armed"] is True
+    assert snap["fault_plan"]["seed"] == 3
+    assert snap["emergency_checkpoint"]["step"] == 17
+    assert "retry_counters" in snap
+    # disarmed (production): the plan must read as absent
+    assert reliability_snapshot()["fault_plan_armed"] is False
+
+
+def test_chaos_report_plumbing():
+    from pytorchvideo_accelerate_tpu.reliability import chaos
+
+    report = {"findings": ["leg: boom"], "legs": {"leg": {}},
+              "elapsed_s": 0.1, "seed": 1}
+    assert chaos.finding_count(report) == 1
+    assert "FINDING leg: boom" in chaos.format_report(report)
+    chaos.publish(report)
+    from pytorchvideo_accelerate_tpu.obs import get_registry
+
+    assert get_registry().get("pva_chaos_findings").value() == 1.0
+    chaos.publish({"findings": []})
+    assert get_registry().get("pva_chaos_findings").value() == 0.0

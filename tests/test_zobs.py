@@ -404,7 +404,10 @@ def test_tracker_failure_is_nonfatal_and_disables_offender(tmp_path, caplog):
         TrackerHub,
     )
 
-    hub = TrackerHub("", str(tmp_path))  # empty spec: no auto trackers
+    # retries=1: no retry budget, disable on the first failure (PR 6's
+    # reliability layer retries transient tracker outages by default —
+    # reliability.tracker_retries; see test_zchaos for that path)
+    hub = TrackerHub("", str(tmp_path), retries=1)
     jsonl = JsonlTracker(str(tmp_path))
     boom = _BoomTracker()
     _BoomTracker.calls = 0

@@ -66,7 +66,9 @@ def _post(srv, path, payload):
 def test_healthz_predict_stats_round_trip(server):
     code, health = _get(server, "/healthz")
     assert code == 200
-    assert health["status"] == "ok" and health["model"] == "tiny3d"
+    # "status" is now the admission state machine's verdict
+    # (serving/admission.py): healthy | degraded | draining
+    assert health["status"] == "healthy" and health["model"] == "tiny3d"
     assert health["num_classes"] == CLASSES
 
     rng = np.random.default_rng(0)
