@@ -291,6 +291,217 @@ def bench_trainer(args) -> dict:
             "mfu": res.get("mfu"), "smoke": bool(args.smoke)}
 
 
+# forced-host slice size for the smoke-mode MULTICHIP lane (the same 8 fake
+# CPU devices tier-1 tests mesh semantics on); module-level so tests can
+# shrink it
+MULTICHIP_FORCED_DEVICES = 8
+# bf16 loss-parity tolerance across shard counts (summation-order variance;
+# a real sharding bug is orders of magnitude above it — same rationale as
+# __graft_entry__'s dryrun)
+MULTICHIP_PARITY_RTOL = 2e-2
+
+
+def _multichip_shape(n: int) -> tuple:
+    """(data, model) for the N-device point of the scaling lane: the 2-D
+    layout the tentpole exercises — (2,4) at 8 devices."""
+    if n >= 8 and n % 4 == 0:
+        return (n // 4, 4)
+    if n >= 4 and n % 2 == 0:
+        return (n // 2, 2)
+    return (n, 1)
+
+
+def bench_multichip(args) -> dict:
+    """The MULTICHIP scaling lane: 1 -> N clips/s/chip through the trainer's
+    2-D (data, model) GSPMD backbone, with self-verifying numerics.
+
+    Three probes, one honest record:
+    - PARITY: the same fixed global batch stepped K times on a 1-device
+      mesh and on the N-device (data, model) mesh must produce the same
+      per-step loss trajectory (sharding changes the schedule, not the
+      math) — `mesh_parity` within MULTICHIP_PARITY_RTOL;
+    - SCALING: pipelined clips/s/chip at each mesh point — flat or better
+      from 1 -> N is the healthy reading. Forced-host CPU points are
+      tagged `forced_host` and are NEVER device numbers;
+    - PORTABILITY: a checkpoint written under (1, N) restores under (N, 1)
+      and under a single-device mesh at the identical step, and the next
+      step's loss matches — the mesh-reshape restore contract
+      (docs/PARALLELISM.md runbook).
+
+    Plus one short Trainer.fit() on the N-device mesh so the
+    steady-state-zero recompile contract (`train_recompiles == 0`) is
+    proven under the 2-D layout, and per-chip MFU rides along whenever the
+    XLA flops capture succeeds (whole-program FLOPs / mesh size — model-
+    axis shards attributed once, never double-counted).
+    """
+    import jax
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, OptimConfig, TrainConfig,
+    )
+    from pytorchvideo_accelerate_tpu.utils.bench_setup import (
+        build_step_setup, fetch_loss, xla_flops,
+    )
+
+    devices = jax.devices()
+    n = len(devices)
+    platform = devices[0].platform
+    out: dict = {
+        "n_devices": n,
+        "platform": platform,
+        # smoke mode runs on the forced-host CPU slice by design; a
+        # non-smoke lane landing on CPU means the tunnel lied — suspect
+        "forced_host": bool(args.smoke),
+        "smoke": bool(args.smoke),
+        "suspect": platform == "cpu" and not args.smoke,
+    }
+    data_dim, model_dim = _multichip_shape(n)
+    out["mesh_shape"] = [data_dim, model_dim]
+    model_name = "tiny3d" if args.smoke else "slowfast_r50"
+    frames, crop = (4, 32) if args.smoke else (8, 128)
+    # smallest global batch >= 8 every mesh point divides (lcm, not
+    # doubling: a 12/24/40-device slice has data_dim = 3/6/10, which no
+    # power of two ever divides)
+    GB = math.lcm(8, data_dim)
+    # smoke (forced-host) runs the lane in fp32: the parity probe is a
+    # NUMERICS gate and bf16 summation-order noise compounds across update
+    # steps into false divergence. On device the lane stays bf16 (the
+    # throughput dtype) and parity compares the FIRST step only — the
+    # pre-update forward+loss, where 2e-2 covers reduction-order variance
+    # (the dryrun_multichip precedent).
+    mp = "fp32" if args.smoke else "bf16"
+    out.update(model=model_name, frames=frames, crop=crop, global_batch=GB,
+               mixed_precision=mp)
+    k_parity = 3
+    k_compare = k_parity if mp == "fp32" else 1
+    k_timed = args.steps if not args.smoke else 3
+
+    def make_point(devs, mesh_cfg):
+        # dropout OFF: with the pinned jax's non-partitionable threefry,
+        # in-graph random masks are NOT layout-invariant across mesh
+        # shapes, so a parity probe with dropout compares two different
+        # (both valid) training runs — the dryrun_multichip convention
+        return build_step_setup(
+            model_name, frames=frames, crop=crop, batch_per_chip=1,
+            num_classes=16, global_batch=GB, devices=list(devs),
+            mesh_cfg=mesh_cfg, total_steps=k_parity + k_timed + 4,
+            mixed_precision=mp, overrides={"dropout_rate": 0.0},
+        )
+
+    def run_point(setup, label):
+        """K parity steps (each loss fetched) then a timed pipelined loop."""
+        losses = []
+        state = setup.state
+        gbs = [setup.device_batch(0), setup.device_batch(1)]
+        for i in range(k_parity):
+            state, metrics = setup.step(state, gbs[i % 2], jax.random.key(i))
+            losses.append(fetch_loss(metrics))
+        t0 = time.perf_counter()
+        for i in range(k_timed):
+            state, metrics = setup.step(state, gbs[i % 2],
+                                        jax.random.key(100 + i))
+        fetch_loss(metrics)
+        dt = time.perf_counter() - t0
+        cps = GB * k_timed / dt
+        log(f"[multichip] {label}: losses {[round(v, 4) for v in losses]}, "
+            f"{cps:.2f} clips/s ({cps / setup.n_chips:.2f}/chip)")
+        return losses, cps
+
+    # 1-device reference, then the N-device (data, model) point
+    ref = make_point(devices[:1], MeshConfig(data=1, model=1))
+    ref_losses, ref_cps = run_point(ref, "1-device")
+    curve = {"1": round(ref_cps, 3)}
+    parity_max_rel = 0.0
+    if n > 1:
+        big = make_point(devices, MeshConfig(data=data_dim, model=model_dim))
+        big_losses, big_cps = run_point(
+            big, f"{n}-device ({data_dim},{model_dim})")
+        curve[str(n)] = round(big_cps / n, 3)
+        parity_max_rel = max(
+            abs(a - b) / max(abs(b), 1e-9)
+            for a, b in zip(big_losses[:k_compare], ref_losses[:k_compare]))
+        flops = None
+        try:
+            flops = xla_flops(big.step.lower(
+                big.state, big.device_batch(0), jax.random.key(0)).compile())
+        except Exception as e:
+            log(f"[multichip] flops capture failed: {type(e).__name__}: {e}")
+        if flops:
+            step_s = GB / big_cps
+            tflops_chip = flops / step_s / 1e12 / n
+            out["multichip_tflops_per_chip"] = round(tflops_chip, 3)
+            peak = peak_tflops(devices[0])
+            if peak:
+                out["multichip_mfu"] = round(tflops_chip / peak, 4)
+    out["cps_per_chip"] = curve
+    out["parity_max_rel"] = round(parity_max_rel, 6)
+    out["mesh_parity"] = bool(parity_max_rel <= MULTICHIP_PARITY_RTOL)
+
+    # checkpoint portability: save under (1, N), restore under (N, 1) and
+    # single-chip; the restored state continues with the identical loss
+    if n > 1:
+        import shutil
+        import tempfile
+
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import Checkpointer
+
+        ckpt_dir = tempfile.mkdtemp(prefix="pva_multichip_ckpt_")
+        try:
+            a = make_point(devices, MeshConfig(data=1, model=n))
+            sa = a.state
+            sa, _ = a.step(sa, a.device_batch(0), jax.random.key(0))
+            ckpt = Checkpointer(ckpt_dir, use_async=False)
+            ckpt.save(1, sa)
+            ckpt.wait()
+            _, m2 = a.step(sa, a.device_batch(1), jax.random.key(1))
+            ref_next = fetch_loss(m2)
+            diffs = []
+            for tag, devs, mcfg in (
+                    (f"({n},1)", devices, MeshConfig(data=n, model=1)),
+                    ("single", devices[:1], MeshConfig(data=1, model=1))):
+                b = make_point(devs, mcfg)
+                sb, _, step_b = ckpt.restore(b.state, step=1, mesh=b.mesh)
+                _, mb = b.step(sb, b.device_batch(1), jax.random.key(1))
+                next_b = fetch_loss(mb)
+                rel = abs(next_b - ref_next) / max(abs(ref_next), 1e-9)
+                diffs.append(rel)
+                log(f"[multichip] ckpt (1,{n})->{tag}: step {step_b}, "
+                    f"next loss {next_b:.5f} vs {ref_next:.5f} "
+                    f"(rel {rel:.2e})")
+                if step_b != 1:
+                    diffs.append(float("inf"))
+            ckpt.close()
+            out["ckpt_max_rel"] = round(max(diffs), 6)
+            out["mesh_ckpt_portable"] = bool(
+                max(diffs) <= MULTICHIP_PARITY_RTOL)
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+
+    # Trainer.fit() through the N-device 2-D mesh: the recompile contract
+    # must hold under the (data, model) layout, not just 1-D DP
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    tcfg = TrainConfig(
+        mesh=MeshConfig(data=data_dim, model=model_dim),
+        model=ModelConfig(name=model_name, num_classes=16, dropout_rate=0.0),
+        data=DataConfig(synthetic=True,
+                        synthetic_num_videos=max(4 * data_dim, 8),
+                        num_frames=frames, crop_size=crop, batch_size=2,
+                        num_workers=1, limit_val_batches=1),
+        optim=OptimConfig(num_epochs=1, lr=0.01),
+        mixed_precision="bf16",
+    )
+    res = Trainer(tcfg).fit()
+    out["train_recompiles"] = res.get("train_recompiles")
+    out["trainer_cps_chip"] = round(
+        res.get("clips_per_sec", 0.0) / max(n, 1), 3)
+    if res.get("mfu") is not None and "multichip_mfu" not in out:
+        out["multichip_mfu"] = round(res["mfu"], 4)
+    log(f"[multichip] {json.dumps(out)}")
+    return out
+
+
 def bench_data(args) -> dict:
     """Host input-pipeline microbench (SURVEY §7 hard-part 1): encodes a
     small synthetic video tree, then measures raw cv2 decode vs pre-decoded
@@ -581,22 +792,31 @@ def run_child(target: str, args, smoke: bool, timeout) -> dict:
         return {"error": f"child timeout after {timeout}s", "smoke": smoke}
     if p.returncode != 0:
         return {"error": f"child exited {p.returncode}", "smoke": smoke}
-    for line in reversed((out or "").strip().splitlines()):
-        try:
-            return json.loads(line)
-        except ValueError:
-            continue
-    return {"error": "no JSON from child", "smoke": smoke}
+    from pytorchvideo_accelerate_tpu.utils.forcehost import last_json_line
+
+    res = last_json_line(out)
+    return res if res is not None else {"error": "no JSON from child",
+                                        "smoke": smoke}
 
 
 def child_main(args) -> None:
     """--child entry: run ONE bench and print its JSON as the last line."""
+    if args.child == "__multichip__" and args.smoke:
+        # forced-host slice: must land in XLA_FLAGS before the first device
+        # touch (jax is imported, but the backend only latches the flag at
+        # client init — the dryrun_multichip pattern)
+        from pytorchvideo_accelerate_tpu.utils.forcehost import forced_host_env
+
+        os.environ["XLA_FLAGS"] = forced_host_env(
+            MULTICHIP_FORCED_DEVICES)["XLA_FLAGS"]
     jax = _setup_jax(args.smoke)
     if args.smoke:
         args.steps, args.warmup = min(args.steps, 3), 1
 
     if args.child == "__trainer__":
         res = bench_trainer(args)
+    elif args.child == "__multichip__":
+        res = bench_multichip(args)
     else:
         devices = jax.devices()
         n_chips = len(devices)
@@ -628,6 +848,12 @@ def main():
                     default=True,
                     help="also run Trainer.fit() on synthetic data and report "
                          "its throughput vs the raw step (hot-loop overhead)")
+    ap.add_argument("--multichip", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="MULTICHIP scaling lane: 1->N clips/s/chip through "
+                         "the 2-D (data, model) train mesh, with loss-parity "
+                         "and mesh-reshape checkpoint probes; forced-host "
+                         "CPU devices in smoke mode (never device numbers)")
     ap.add_argument("--data", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="host input-pipeline microbench (decode vs cache vs "
@@ -841,6 +1067,38 @@ def main():
             extras["trainer_error"] = tr.get("error", "unknown")
         flush_partial()
 
+    if args.multichip:
+        # MULTICHIP lane: same child-isolation rules as the model benches
+        # (a wedged 8-way compile loses the lane, not the round). Runs
+        # forced-host (honest CPU parity, never headlined as device
+        # numbers) whenever the round is smoke or the tunnel is down.
+        mc = run_child("__multichip__", args, user_smoke or not device_ok,
+                       _model_timeout(args))
+        extras["multichip"] = mc  # full record -> bench_partial.json
+        if "error" in mc:
+            extras["multichip_error"] = str(mc["error"])[:120]
+        else:
+            # numerics verdicts always ride the headline
+            extras["mesh_parity"] = mc.get("mesh_parity")
+            if "mesh_ckpt_portable" in mc:
+                extras["mesh_ckpt_portable"] = mc["mesh_ckpt_portable"]
+            if mc.get("train_recompiles") is not None:
+                extras["multichip_train_recompiles"] = int(
+                    mc["train_recompiles"])
+            # perf numbers only when trustworthy: a non-smoke lane that
+            # landed on CPU is a lying tunnel, not a scaling curve
+            if mc.get("suspect"):
+                extras["multichip_error"] = (
+                    "no trustworthy device numbers for the multichip lane "
+                    "(cpu fallback); parity verdicts retained")
+            else:
+                extras["multichip_cps_per_chip"] = mc.get("cps_per_chip")
+                extras["multichip_forced_host"] = bool(
+                    mc.get("forced_host"))
+                if mc.get("multichip_mfu") is not None:
+                    extras["multichip_mfu"] = mc["multichip_mfu"]
+        flush_partial()
+
     if args.data:
         # host-side benches run in the parent but bounded: a wedged decode
         # or forked worker must not break the one-JSON-line contract (the
@@ -933,6 +1191,24 @@ def main():
         assert extras.get("chaos_findings") == 0, (
             f"pva-tpu-chaos found {extras.get('chaos_findings')} "
             "unrecovered fault(s) (see docs/RELIABILITY.md)")
+    if user_smoke and args.multichip:
+        # 2-D-mesh contract (docs/PARALLELISM.md): the scaling lane must
+        # produce its parity verdict and curve, parity must HOLD, and the
+        # steady-state-zero recompile contract must survive the (data,
+        # model) layout — not just the 1-D DP path the trainer lane runs
+        for key in ("mesh_parity", "multichip_cps_per_chip"):
+            assert key in extras, (
+                f"multichip smoke ran but produced no {key!r}: "
+                f"{extras.get('multichip_error') or sorted(extras)}")
+        assert extras["mesh_parity"] is True, (
+            "N-device (data, model) mesh diverged from the 1-device loss "
+            f"trajectory: {extras.get('multichip')}")
+        assert extras.get("mesh_ckpt_portable") in (True, None), (
+            f"mesh-reshape checkpoint restore failed: "
+            f"{extras.get('multichip')}")
+        assert extras.get("multichip_train_recompiles") in (0, None), (
+            "steady-state recompiles under the 2-D mesh layout: "
+            f"{extras.get('multichip_train_recompiles')}")
     if user_smoke and args.serve_smoke:
         # smoke mode doubles as the CI check that the serving lane's
         # headline keys didn't silently fall out (same contract as the
@@ -1067,12 +1343,22 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         },
         "detail": "bench_partial.json",
     }
+    # a multichip lane that refused its numbers (cpu fallback) headlines
+    # the refusal INSTEAD of the perf keys — verdicts (parity/portability/
+    # recompiles) still ride; error strings truncate on entry
+    mc_perf = ("multichip_cps_per_chip", "multichip_forced_host",
+               "multichip_mfu")
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "trainer_input_wait_frac", "obs_step_s",
                 "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
-                "tsan_findings", "chaos_findings"):
-        if key in extras:
+                "tsan_findings", "chaos_findings", "mesh_parity",
+                "mesh_ckpt_portable", "multichip_train_recompiles",
+                *mc_perf):
+        if key in extras and not (key in mc_perf
+                                  and "multichip_error" in extras):
             out[key] = extras[key]
+    if "multichip_error" in extras:
+        out["multichip_error"] = str(extras["multichip_error"])[:120]
     # serving lane: request-latency percentiles + batcher fill ratio
     serving = extras.get("serving", {})
     if "error" in serving:
@@ -1118,7 +1404,10 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         for k in ("error", "trainer_error"):
             if k in out:
                 out[k] = out[k][:120]
-    for k in ("probes", "serve_error", "serve_fill_ratio", "serve_p99_ms",
+    for k in ("probes", "multichip_mfu", "multichip_forced_host",
+              "multichip_train_recompiles", "multichip_error",
+              "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
+              "serve_error", "serve_fill_ratio", "serve_p99_ms",
               "serve_p50_ms", "train_recompiles", "obs_h2d_s",
               "obs_input_wait_frac",
               "obs_step_s", "trainer_error", "trainer_input_wait_frac",

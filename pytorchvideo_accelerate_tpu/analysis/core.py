@@ -171,6 +171,7 @@ def default_rules() -> List[Rule]:
     bench smoke gate, and the doctor all lint with identical rules)."""
     from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HostSyncRule
     from pytorchvideo_accelerate_tpu.analysis.rules_lock import LockDisciplineRule
+    from pytorchvideo_accelerate_tpu.analysis.rules_mesh import MeshDisciplineRule
     from pytorchvideo_accelerate_tpu.analysis.rules_recompile import RecompileHazardRule
     from pytorchvideo_accelerate_tpu.analysis.rules_span import SpanDisciplineRule
     from pytorchvideo_accelerate_tpu.analysis.rules_thread import (
@@ -181,7 +182,7 @@ def default_rules() -> List[Rule]:
 
     return [HostSyncRule(), RecompileHazardRule(), LockDisciplineRule(),
             TracerLeakRule(), SpanDisciplineRule(), ThreadFactoryRule(),
-            ThreadJoinRule()]
+            ThreadJoinRule(), MeshDisciplineRule()]
 
 
 def parse_module(source: str, path: str) -> ModuleInfo:

@@ -1,0 +1,103 @@
+"""Rule `mesh-discipline`: keep device placement on the mesh rails.
+
+The 2-D (data, model) train mesh (parallel/mesh.py, docs/PARALLELISM.md)
+only delivers its contracts — layout-settled states (zero steady-state
+recompiles), mesh-portable checkpoints, per-family model-axis rules — when
+every placement in the hot path routes through the `parallel/` seams:
+`make_mesh`/`make_train_mesh` for mesh construction and
+`shard_batch`/`shard_params`/`shard_state` for array placement. A bare
+`jax.device_put(...)` in a hot module commits an array to a layout the
+sharding rules never saw (the exact uncommitted-leaf class of bug the
+`pva_train_recompiles` guard caught in PR 4), and a hand-built
+`Mesh(...)` bypasses the axis-name resolution (`batch_axes`/`model_axis`/
+`cp_axis`) that keeps the code portable across both mesh layouts.
+
+Scope mirrors the host-sync rule: HOT_MODULES only (the steady-state
+train/serve path) — cold modules, tools, and the `parallel/` package
+itself (which IS the rails) place arrays freely. The detection mirrors the
+thread-factory rule: module aliases (`import jax.sharding as js`) and
+from-import as-names (`from jax.sharding import Mesh as M`) cannot launder
+a construction past the gate. Suppressions follow the house syntax:
+`# pva: disable=mesh-discipline -- reason`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+)
+from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HOT_MODULES
+
+# jax modules whose `Mesh` / `device_put` attributes are the flagged ones
+_JAX_MODULES = ("jax", "jax.sharding", "jax.experimental")
+
+
+def _jax_module_aliases(tree: ast.AST) -> Set[str]:
+    """Every local name a jax module is bound to: "jax", "jax.sharding",
+    plus `import jax.sharding as js` / `import jax as j` aliases."""
+    out = set(_JAX_MODULES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in _JAX_MODULES and alias.asname:
+                    out.add(alias.asname)
+    return out
+
+
+def _from_import_aliases(tree: ast.AST) -> Dict[str, str]:
+    """local name -> flagged symbol, for `from jax[.sharding] import X [as Y]`."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in _JAX_MODULES:
+            for alias in node.names:
+                if alias.name in ("Mesh", "device_put"):
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+_ADVICE = {
+    "Mesh": ("construct meshes through `parallel.mesh.make_train_mesh` / "
+             "`make_mesh` so axis names resolve portably "
+             "(batch_axes/model_axis/cp_axis)"),
+    "device_put": ("place arrays through `parallel.sharding` "
+                   "(shard_batch/shard_params/shard_state) so the layout "
+                   "is committed under the mesh rules — a bare placement "
+                   "here is the silent-recompile class of bug the "
+                   "pva_train_recompiles guard exists for"),
+}
+
+
+class MeshDisciplineRule(Rule):
+    name = "mesh-discipline"
+    description = ("bare jax.device_put / jax.sharding.Mesh construction in "
+                   "a hot module — route through parallel/mesh.py + "
+                   "parallel/sharding.py")
+
+    def __init__(self, hot_modules=HOT_MODULES):
+        self.hot_modules = tuple(hot_modules)
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not module.matches(self.hot_modules):
+            return
+        modules = _jax_module_aliases(module.tree)
+        froms = _from_import_aliases(module.tree)
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = call_name(node)
+            sym = None
+            if "." in dn:
+                head, tail = dn.rsplit(".", 1)
+                if head in modules and tail in ("Mesh", "device_put"):
+                    sym = tail
+            elif dn in froms:
+                sym = froms[dn]
+            if sym is not None:
+                yield self.finding(
+                    module, node, f"`{dn}(...)` in a hot module: {_ADVICE[sym]}")

@@ -22,15 +22,24 @@ from typing import Any, Optional, Sequence
 class MeshConfig:
     """Device-mesh shape. Product of explicit axes must divide device count.
 
-    Axis semantics (parallel/mesh.py): ``data`` = data parallel (batch
-    sharding + implicit gradient psum), ``fsdp`` = parameter/optimizer-state
-    sharding (also shards the batch), ``tensor`` = tensor parallelism for
-    transformer blocks, ``context`` = sequence/context parallelism (ring
-    attention / Ulysses over the token axis).  -1 on ``data`` means "use all
-    remaining devices".
+    The trainer runs on the 2-D ``(data, model)`` train mesh
+    (parallel/mesh.py make_train_mesh; docs/PARALLELISM.md): ``data`` = data
+    parallel (batch sharding + implicit gradient psum), ``model`` = the
+    model-parallel axis — transformer families (mvit/videomae) split
+    attention heads and MLP widths over it, the context-parallel lane
+    (``--model.attention ring|ulysses``) shards the token axis over it, and
+    conv families replicate over it.  -1 on ``data`` means "use all
+    remaining devices". Checkpoints are portable across train-mesh shapes.
+
+    The legacy ``fsdp``/``tensor``/``context`` axes select the 4-axis
+    library mesh instead (parallel/ research layout): ``fsdp`` =
+    parameter/optimizer-state sharding (also shards the batch), ``tensor``
+    = tensor parallelism, ``context`` = sequence/context parallelism.
+    ``model`` cannot combine with them.
     """
 
     data: int = -1
+    model: int = 1
     fsdp: int = 1
     tensor: int = 1
     context: int = 1

@@ -147,6 +147,7 @@ def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
         dropout_rate=cfg.dropout_rate,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        shard_mesh=mesh,  # block-boundary activation anchors (GSPMD)
         depthwise_impl=cfg.depthwise_impl,
         remat=cfg.remat,
         dtype=dtype,
@@ -170,6 +171,7 @@ def _videomae_b(cfg: ModelConfig, dtype, mesh=None):
         dropout_rate=cfg.dropout_rate,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        shard_mesh=mesh,  # block-boundary activation anchors (GSPMD)
         remat=cfg.remat,
         dtype=dtype,
     )
@@ -183,6 +185,7 @@ def _videomae_b_pretrain(cfg: ModelConfig, dtype, mesh=None):
         mask_ratio=cfg.mask_ratio,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        shard_mesh=mesh,  # block-boundary activation anchors (GSPMD)
         remat=cfg.remat,
         dtype=dtype,
     )
@@ -202,8 +205,11 @@ def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None):
 
     `mesh`: required for the context-parallel attention backends
     ("ring"/"ulysses") — the attention router opens a `shard_map` region over
-    the mesh's ``context`` axis, so the model stays usable from ordinary
-    auto-sharded (jit) training code.
+    the mesh's context-parallel axis (the library mesh's ``context`` axis /
+    the 2-D train mesh's ``model`` axis), so the model stays usable from
+    ordinary auto-sharded (jit) training code. The transformer families also
+    use it for block-boundary activation sharding constraints
+    (parallel/sharding.constrain_block).
     """
     if cfg.name not in _REGISTRY:
         raise ValueError(f"unknown model {cfg.name!r}; available: {available_models()}")

@@ -927,7 +927,8 @@ def export_checkpoint_params(ckpt_dir: str, dst: str,
 
 # --- entry point used by the Trainer ---------------------------------------
 
-def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
+def load_pretrained(path: str, variables: dict, mesh=None, model: str = "",
+                    tp: bool = True):
     """Merge a converted checkpoint into freshly-initialized variables.
 
     `variables`: {"params": pytree, "batch_stats": pytree} (target shapes).
@@ -1008,10 +1009,14 @@ def load_pretrained(path: str, variables: dict, mesh=None, model: str = ""):
         ),
     }
     if mesh is not None:
+        # `tp` mirrors the trainer's per-family model-axis decision
+        # (parallel/sharding.param_sharding): the merged tree must land in
+        # the SAME layout as the state it replaces, or the swap forces a
+        # recompile (and a resharding copy) on the next step
         from pytorchvideo_accelerate_tpu.parallel.sharding import shard_params
 
-        merged["params"] = shard_params(mesh, merged["params"])
-        merged["batch_stats"] = shard_params(mesh, merged["batch_stats"])
+        merged["params"] = shard_params(mesh, merged["params"], tp=tp)
+        merged["batch_stats"] = shard_params(mesh, merged["batch_stats"], tp=tp)
     return merged, report
 
 

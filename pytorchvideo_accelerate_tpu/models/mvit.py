@@ -33,6 +33,7 @@ from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
 from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
+from pytorchvideo_accelerate_tpu.parallel.sharding import constrain_block
 
 Dtype = Any
 
@@ -216,6 +217,12 @@ class MViT(nn.Module):
     attention_backend: str = "dense"
     context_axis: Optional[str] = None
     context_mesh: Optional[Any] = None
+    # device mesh for block-boundary activation constraints
+    # (parallel/sharding.constrain_block): under the 2-D (data, model) train
+    # mesh the GSPMD partitioner re-anchors on the batch-over-data layout
+    # between blocks instead of drifting through pooled/resharded
+    # intermediates. None (single-device use, conversion parity) = no-op.
+    shard_mesh: Optional[Any] = None
     depthwise_impl: str = "conv"  # conv | shift (ops/depthwise.py)
     remat: bool = False  # per-block jax.checkpoint: boundary activations only
     dtype: Any = jnp.float32
@@ -266,6 +273,7 @@ class MViT(nn.Module):
                 depthwise_impl=self.depthwise_impl,
                 dtype=self.dtype, name=f"block{i}",
             )(x, train)
+            x = constrain_block(x, self.shard_mesh)  # no-op without a mesh
             dim = dim_out
 
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)

@@ -41,6 +41,7 @@ import numpy as np
 from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
+from pytorchvideo_accelerate_tpu.parallel.sharding import constrain_block
 
 Dtype = Any
 
@@ -121,6 +122,11 @@ class VideoMAEEncoder(nn.Module):
     tubelet: Tuple[int, int, int] = (2, 16, 16)
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
+    # device mesh for block-boundary activation constraints
+    # (parallel/sharding.constrain_block): re-anchors the partitioner on the
+    # batch-over-data layout between blocks under the (data, model) train
+    # mesh. None (single-device use, conversion parity) = no-op.
+    shard_mesh: Optional[Any] = None
     remat: bool = False  # per-block jax.checkpoint: boundary activations only
     final_norm: bool = True  # off for mean-pooling classifiers (fc_norm after
     # the pool instead — the official VideoMAE fine-tune arrangement)
@@ -145,6 +151,7 @@ class VideoMAEEncoder(nn.Module):
                 context_mesh=self.context_mesh, dtype=self.dtype,
                 name=f"block{i}",
             )(tokens)
+            tokens = constrain_block(tokens, self.shard_mesh)
         if self.final_norm:
             tokens = nn.LayerNorm(dtype=self.dtype, name="norm")(tokens)
         return tokens, (t, h, w)
@@ -200,6 +207,7 @@ class VideoMAEForPretraining(nn.Module):
     norm_pix: bool = True
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
+    shard_mesh: Optional[Any] = None  # block-boundary constraints (no-op when None)
     remat: bool = False
     dtype: Dtype = jnp.float32
 
@@ -217,7 +225,8 @@ class VideoMAEForPretraining(nn.Module):
         enc, _ = VideoMAEEncoder(
             dim=self.dim, depth=self.depth, num_heads=self.num_heads,
             tubelet=self.tubelet, attention_backend=self.attention_backend,
-            context_mesh=self.context_mesh, remat=self.remat,
+            context_mesh=self.context_mesh, shard_mesh=self.shard_mesh,
+            remat=self.remat,
             dtype=self.dtype, name="encoder",
         )(x, keep_idx)                                   # (B, n_vis, dim)
 
@@ -248,6 +257,7 @@ class VideoMAEForPretraining(nn.Module):
                 context_mesh=self.context_mesh, dtype=self.dtype,
                 name=f"dec_block{i}",
             )(dec_tokens)
+            dec_tokens = constrain_block(dec_tokens, self.shard_mesh)
         dec_tokens = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(dec_tokens)
         pred = nn.Dense(tt * p * p * 3, dtype=jnp.float32, name="dec_pred")(
             dec_tokens[:, enc.shape[1]:].astype(jnp.float32)
@@ -283,6 +293,7 @@ class VideoMAEClassifier(nn.Module):
     dropout_rate: float = 0.0
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
+    shard_mesh: Optional[Any] = None  # block-boundary constraints (no-op when None)
     remat: bool = False
     dtype: Dtype = jnp.float32
 
@@ -291,7 +302,8 @@ class VideoMAEClassifier(nn.Module):
         tokens, _ = VideoMAEEncoder(
             dim=self.dim, depth=self.depth, num_heads=self.num_heads,
             tubelet=self.tubelet, attention_backend=self.attention_backend,
-            context_mesh=self.context_mesh, remat=self.remat,
+            context_mesh=self.context_mesh, shard_mesh=self.shard_mesh,
+            remat=self.remat,
             final_norm=False, dtype=self.dtype, name="encoder",
         )(x)
         feat = tokens.mean(axis=1)

@@ -52,8 +52,11 @@ def dot_product_attention(q, k, v, backend: str = "dense",
     For the context-parallel backends ("ring"/"ulysses") exactly one of two
     calling conventions applies:
     - `mesh=...` — caller is ordinary auto-sharded (jit) code: the router
-      opens a `shard_map` region over the mesh's ``context`` axis around just
-      this attention call (composable with auto sharding everywhere else);
+      opens a `shard_map` region over the mesh's context-parallel axis
+      (``axis_name`` when also given, else resolved from the mesh layout —
+      ``context`` on the library mesh, ``model`` on the 2-D train mesh)
+      around just this attention call (composable with auto sharding
+      everywhere else);
     - `axis_name=...` and no mesh — caller is already inside a `shard_map`
       with that axis bound; q/k/v are local sequence shards.
     """
@@ -72,7 +75,7 @@ def dot_product_attention(q, k, v, backend: str = "dense",
         )
 
         if mesh is not None:
-            return make_ring_attention(mesh)(q, k, v)
+            return make_ring_attention(mesh, axis_name)(q, k, v)
         if axis_name is None:
             raise ValueError("ring attention needs a mesh or the context-axis name")
         return ring_attention(q, k, v, axis_name=axis_name)
@@ -82,7 +85,7 @@ def dot_product_attention(q, k, v, backend: str = "dense",
         )
 
         if mesh is not None:
-            return make_ulysses_attention(mesh)(q, k, v)
+            return make_ulysses_attention(mesh, axis_name)(q, k, v)
         if axis_name is None:
             raise ValueError("ulysses attention needs a mesh or the context-axis name")
         return ulysses_attention(q, k, v, axis_name=axis_name)

@@ -40,6 +40,11 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the pinned jax 0.4.37 ships this as TPUCompilerParams; newer jax
+# renamed it CompilerParams — accept either
+_CompilerParams = getattr(pltpu, "CompilerParams", None) or getattr(
+    pltpu, "TPUCompilerParams")
+
 NEG_INF = -1e30
 LANES = 128  # broadcast width for per-row stats (min f32 lane tile)
 
@@ -171,7 +176,7 @@ def _fwd_call(q, k, v, scale, block_q, block_k, interpret):
             pltpu.VMEM((block_q, LANES), jnp.float32),
             pltpu.VMEM((block_q, LANES), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -225,7 +230,7 @@ def _flash_bhnd_bwd(scale, block_q, block_k, interpret, res, dout):
         in_specs=[q_spec, k_spec, k_spec, q_spec, stat_spec, stat_spec],
         out_specs=q_spec,
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,
@@ -250,7 +255,7 @@ def _flash_bhnd_bwd(scale, block_q, block_k, interpret, res, dout):
             pltpu.VMEM((block_k, D), jnp.float32),
             pltpu.VMEM((block_k, D), jnp.float32),
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=_CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
         interpret=interpret,

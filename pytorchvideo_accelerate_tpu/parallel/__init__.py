@@ -11,15 +11,23 @@ from pytorchvideo_accelerate_tpu.parallel.mesh import (  # noqa: F401
     AXIS_CONTEXT,
     AXIS_DATA,
     AXIS_FSDP,
+    AXIS_MODEL,
     AXIS_TENSOR,
     BATCH_AXES,
+    batch_axes,
+    cp_axis,
     make_mesh,
+    make_train_mesh,
+    model_axis,
 )
 from pytorchvideo_accelerate_tpu.parallel.sharding import (  # noqa: F401
     batch_sharding,
+    constrain_block,
+    family_uses_tp,
     replicated,
     shard_batch,
     shard_params,
+    shard_state,
 )
 from pytorchvideo_accelerate_tpu.parallel.distributed import (  # noqa: F401
     initialize_distributed,

@@ -6,7 +6,22 @@ the TPU design there is no user-visible process group — a `jax.sharding.Mesh`
 over all devices defines every parallelism axis, and XLA lowers the
 collectives onto ICI rings / DCN links from the sharding annotations alone.
 
-Axes:
+Two mesh layouts ship:
+
+TRAIN MESH (the trainer's backbone, `make_train_mesh`) — a named 2-D
+`(data, model)` mesh, the pjit/TPUv4 GSPMD pattern ("Scalable Training of
+Language Models using JAX pjit and TPUv4", PAPERS.md):
+  data   — batch sharding; gradient psum implied by sharded autodiff.
+  model  — the model-parallel axis. How it is spent is a per-model-family
+           decision (parallel/sharding.py): transformer families split
+           attention heads / MLP widths over it (Megatron TP), the
+           context-parallel lane shards the token axis over it
+           (ring/ulysses), and conv families replicate over it.
+Checkpoints are mesh-portable across train-mesh shapes: a run saved under
+(1, N) restores under (N, 1) or single-chip (trainer/checkpoint.py).
+
+LIBRARY MESH (`make_mesh`) — the 4-axis research layout the parallel/
+library and its tests exercise directly:
   data    — pure data parallelism (batch sharding; gradient psum implied by
             sharded autodiff). The only axis the reference exercises (its DDP
             path, SURVEY §2.4).
@@ -17,10 +32,15 @@ Axes:
             backbone, accelerator.py:2506).
   context — sequence/context parallelism: ring attention / Ulysses all-to-all
             over the token axis (accelerate `_prepare_cp` accelerator.py:1658).
+
+Downstream code stays portable across both layouts by resolving axes from
+the mesh itself (`batch_axes` / `model_axis` / `cp_axis`) instead of
+assuming a fixed axis tuple.
 """
 
 from __future__ import annotations
 
+import weakref
 from typing import Optional, Sequence
 
 import jax
@@ -34,13 +54,82 @@ AXIS_DATA = "data"
 AXIS_FSDP = "fsdp"
 AXIS_TENSOR = "tensor"
 AXIS_CONTEXT = "context"
+AXIS_MODEL = "model"
 
 # The global batch dimension is sharded over both DP-like axes, mirroring how
 # FSDP data-sharding composes with DP in the backbone's device-mesh-aware
-# dataloader (accelerate data_loader.py:1127-1163).
+# dataloader (accelerate data_loader.py:1127-1163). Library-mesh constant;
+# mesh-portable code calls `batch_axes(mesh)` instead.
 BATCH_AXES = (AXIS_DATA, AXIS_FSDP)
 
 MESH_AXIS_NAMES = (AXIS_DATA, AXIS_FSDP, AXIS_TENSOR, AXIS_CONTEXT)
+TRAIN_MESH_AXIS_NAMES = (AXIS_DATA, AXIS_MODEL)
+
+# Per-mesh memo store, keyed on mesh IDENTITY (id + liveness guard), never
+# on Mesh equality. Why: jax Mesh.__eq__ compares axis names / shape /
+# device list, so after a mesh-reshape restore an equal-but-distinct Mesh
+# object would keep serving cached values (NamedShardings, shard_map
+# wrappers) closed over the RETIRED mesh — semantically aliased layouts
+# that defeat `sharding.mesh is mesh` identity reasoning. The weakref is a
+# LIVENESS GUARD against id reuse, not the growth bound: cached values
+# (NamedShardings, wrappers) reference their mesh, so an entry keeps its
+# mesh reachable — the store is bounded instead by sweeping dead refs and
+# then evicting oldest-first past _MESH_MEMO_MAX (values are pure caches;
+# eviction of a live mesh's memo only costs a rebuild).
+_mesh_memos: dict = {}  # id(mesh) -> (weakref to mesh, {namespace: {}})
+_MESH_MEMO_MAX = 16  # distinct live meshes a process plausibly juggles
+
+
+def mesh_memo(mesh: Mesh, namespace: str) -> dict:
+    """Mutable memo dict tied to `mesh`'s identity, created on first use.
+    Benign race: concurrent callers may build a value twice; last write
+    wins and both are equivalent."""
+    key = id(mesh)
+    entry = _mesh_memos.get(key)
+    if entry is None or entry[0]() is not mesh:
+        entry = (weakref.ref(mesh), {})
+        _mesh_memos[key] = entry
+        if len(_mesh_memos) > _MESH_MEMO_MAX:
+            for k in [k for k, e in _mesh_memos.items() if e[0]() is None]:
+                del _mesh_memos[k]
+            for k in list(_mesh_memos):
+                if len(_mesh_memos) <= _MESH_MEMO_MAX:
+                    break
+                if k != key:
+                    del _mesh_memos[k]
+    return entry[1].setdefault(namespace, {})
+
+
+def batch_axes(mesh: Mesh) -> tuple:
+    """Axis names the (global) batch dimension shards over, resolved from
+    the mesh itself: ("data", "fsdp") on the library mesh, ("data",) on the
+    2-D train mesh. The portability seam for steps/prefetch/serving code."""
+    return tuple(a for a in BATCH_AXES if a in mesh.axis_names)
+
+
+def model_axis(mesh: Mesh) -> Optional[str]:
+    """Name of the model-parallel (tensor/TP) axis on this mesh, or None:
+    "model" on the train mesh, "tensor" on the library mesh."""
+    if AXIS_MODEL in mesh.axis_names:
+        return AXIS_MODEL
+    if AXIS_TENSOR in mesh.axis_names:
+        return AXIS_TENSOR
+    return None
+
+
+def cp_axis(mesh: Mesh) -> str:
+    """Axis the context-parallel kernels shard the token dim over: the
+    dedicated "context" axis on the library mesh; on the 2-D train mesh the
+    CP lane spends the "model" axis (a per-run choice — the same axis is
+    never simultaneously TP for params and CP for tokens; see
+    parallel/sharding.py and docs/PARALLELISM.md)."""
+    if AXIS_CONTEXT in mesh.axis_names:
+        return AXIS_CONTEXT
+    if AXIS_MODEL in mesh.axis_names:
+        return AXIS_MODEL
+    raise ValueError(
+        f"mesh {tuple(mesh.axis_names)} has no context-parallel axis "
+        "(expected 'context' or 'model')")
 
 
 def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple:
@@ -68,16 +157,13 @@ def resolve_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple:
     return (data, cfg.fsdp, cfg.tensor, cfg.context)
 
 
-def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None) -> Mesh:
-    """Build the global mesh. On TPU, `mesh_utils.create_device_mesh` picks a
-    device ordering so the inner (rightmost) axes land on physically adjacent
-    chips — keeping tensor/context collectives on fast ICI loops and the data
-    axis on the outermost rings, per the scaling-book recipe."""
-    cfg = cfg or MeshConfig()
-    devices = list(devices if devices is not None else jax.devices())
-    shape = resolve_mesh_shape(cfg, len(devices))
+def _device_grid(shape: tuple, devices: list):
+    """Device array for a mesh shape. On TPU, `mesh_utils.create_device_mesh`
+    picks a device ordering so the inner (rightmost) axes land on physically
+    adjacent chips — keeping tensor/context collectives on fast ICI loops and
+    the data axis on the outermost rings, per the scaling-book recipe."""
     try:
-        device_array = mesh_utils.create_device_mesh(shape, devices=devices)
+        return mesh_utils.create_device_mesh(shape, devices=devices)
     except (ValueError, AssertionError) as e:
         # CPU simulation / odd topologies: plain row-major reshape. On a real
         # TPU slice this forfeits the ICI-adjacency-aware ordering — warn so
@@ -90,10 +176,66 @@ def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = No
                 "row-major device order — collective layout may be suboptimal",
                 shape, e,
             )
-        device_array = np.asarray(devices).reshape(shape)
-    return Mesh(device_array, MESH_AXIS_NAMES)
+        return np.asarray(devices).reshape(shape)
+
+
+def make_mesh(cfg: Optional[MeshConfig] = None, devices: Optional[Sequence] = None) -> Mesh:
+    """Build the 4-axis library mesh (data × fsdp × tensor × context)."""
+    cfg = cfg or MeshConfig()
+    devices = list(devices if devices is not None else jax.devices())
+    shape = resolve_mesh_shape(cfg, len(devices))
+    return Mesh(_device_grid(shape, devices), MESH_AXIS_NAMES)
+
+
+def resolve_train_mesh_shape(cfg: MeshConfig, n_devices: int) -> tuple:
+    """Resolve the 2-D (data, model) shape; -1 on `data` infers."""
+    if cfg.model < 1:
+        raise ValueError(f"mesh.model must be >= 1, got {cfg.model}")
+    if cfg.data != -1 and cfg.data < 1:
+        raise ValueError(f"mesh.data must be >= 1 or -1 (infer), got {cfg.data}")
+    data = cfg.data
+    if data == -1:
+        if n_devices % cfg.model != 0:
+            raise ValueError(
+                f"mesh.model={cfg.model} does not divide device count "
+                f"{n_devices}")
+        data = n_devices // cfg.model
+    if data * cfg.model != n_devices:
+        raise ValueError(
+            f"train mesh shape ({data},{cfg.model}) needs "
+            f"{data * cfg.model} devices, have {n_devices}")
+    return (data, cfg.model)
+
+
+def make_train_mesh(cfg: Optional[MeshConfig] = None,
+                    devices: Optional[Sequence] = None) -> Mesh:
+    """The trainer's backbone mesh: named 2-D `(data, model)`.
+
+    `model` is the single model-parallel axis; how it is spent (Megatron TP
+    head/MLP splits, context-parallel token sharding, or plain replication
+    for conv families) is decided per model family by parallel/sharding.py.
+    Defaults (`model=1`, `data=-1`) give pure data parallelism over every
+    device — the reference's DDP layout as a degenerate case.
+
+    Back-compat: a MeshConfig that sets any of the legacy fsdp/tensor/
+    context axes falls through to the 4-axis library mesh (every downstream
+    consumer resolves axes from the mesh, so both layouts train)."""
+    cfg = cfg or MeshConfig()
+    if (cfg.fsdp, cfg.tensor, cfg.context) != (1, 1, 1):
+        if cfg.model > 1:
+            raise ValueError(
+                "mesh.model is the 2-D train-mesh axis and cannot combine "
+                "with the legacy fsdp/tensor/context axes — pick one layout")
+        return make_mesh(cfg, devices)
+    devices = list(devices if devices is not None else jax.devices())
+    shape = resolve_train_mesh_shape(cfg, len(devices))
+    return Mesh(_device_grid(shape, devices), TRAIN_MESH_AXIS_NAMES)
 
 
 def data_shard_count(mesh: Mesh) -> int:
-    """Number of batch shards (= reference `num_processes` for pure DP)."""
-    return mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]
+    """Number of batch shards (= reference `num_processes` for pure DP) —
+    the product of the mesh's batch axes, on either mesh layout."""
+    count = 1
+    for a in batch_axes(mesh):
+        count *= mesh.shape[a]
+    return count

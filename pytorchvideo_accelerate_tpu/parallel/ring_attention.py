@@ -46,7 +46,12 @@ from pytorchvideo_accelerate_tpu.parallel.collectives import (
     axis_size,
     shard_map as _shard_map,
 )
-from pytorchvideo_accelerate_tpu.parallel.mesh import AXIS_CONTEXT, BATCH_AXES
+from pytorchvideo_accelerate_tpu.parallel.mesh import (
+    AXIS_CONTEXT,
+    batch_axes,
+    cp_axis,
+    mesh_memo,
+)
 
 NEG_INF = -1e30
 
@@ -124,25 +129,34 @@ def _pad_tokens(x, mult: int):
     return x
 
 
-def make_cp_attention(mesh: Mesh, local_fn, axis_name: str = AXIS_CONTEXT):
+def make_cp_attention(mesh: Mesh, local_fn,
+                      axis_name: Optional[str] = None):
     """Shared jit-side wrapper for context-parallel attention kernels.
 
     `local_fn(q, k, v, axis_name=..., nk_valid=...)` is a manual-SPMD kernel
     (ring_attention / ulysses_attention). Opens a `shard_map` region over the
-    context axis: the token axis of q/k/v is sharded there and heads/features
-    are replicated w.r.t. ``context``. The batch axis additionally stays
-    sharded over the DP axes when the global batch divides them (the normal
-    training case) and is replicated otherwise (tiny eval batches). Ragged
-    sequence lengths (e.g. MViT's pooled K/V grids) are padded to a multiple
-    of the axis size and masked inside the kernel.
+    context-parallel axis — `axis_name` or, when None, resolved from the
+    mesh layout (the library mesh's ``context`` axis / the 2-D train mesh's
+    ``model`` axis, parallel/mesh.cp_axis): the token axis of q/k/v is
+    sharded there and heads/features are replicated w.r.t. it. The batch
+    axis additionally stays sharded over the mesh's DP axes when the global
+    batch divides them (the normal training case) and is replicated
+    otherwise (tiny eval batches). Ragged sequence lengths (e.g. MViT's
+    pooled K/V grids) are padded to a multiple of the axis size and masked
+    inside the kernel.
     """
+    if axis_name is None:
+        axis_name = cp_axis(mesh)
     cp = mesh.shape[axis_name]
-    dp = mesh.shape[BATCH_AXES[0]] * mesh.shape[BATCH_AXES[1]]
+    daxes = batch_axes(mesh)
+    dp = 1
+    for a in daxes:
+        dp *= mesh.shape[a]
 
     # bounded: distinct (batch_divisible, lengths) combos are few per model
     @functools.lru_cache(maxsize=64)
     def build(batch_divisible: bool, nk_valid: int, nk_padded: int):
-        spec = P(BATCH_AXES if batch_divisible else None, axis_name, None, None)
+        spec = P(daxes if batch_divisible else None, axis_name, None, None)
         mask = None if nk_valid == nk_padded else nk_valid
         return _shard_map(
             lambda q, k, v: local_fn(q, k, v, axis_name=axis_name,
@@ -159,9 +173,16 @@ def make_cp_attention(mesh: Mesh, local_fn, axis_name: str = AXIS_CONTEXT):
     return attn
 
 
-@functools.lru_cache(maxsize=16)
-def make_ring_attention(mesh: Mesh, axis_name: str = AXIS_CONTEXT):
+def make_ring_attention(mesh: Mesh, axis_name: Optional[str] = None):
     """Drop-in ring-attention `attn(q, k, v)` for auto-sharded models under
-    `jit` (see `make_cp_attention`). Memoized (bounded) so every attention
-    layer / retrace reuses one wrapper and its shape cache."""
-    return make_cp_attention(mesh, ring_attention, axis_name)
+    `jit` (see `make_cp_attention`; `axis_name=None` resolves the CP axis
+    from the mesh layout). Memoized on the mesh-identity store (an
+    equality-keyed lru would serve a wrapper closed over a retired mesh
+    after a mesh-reshape restore) so every attention layer / retrace
+    reuses one wrapper and its shape cache."""
+    memo = mesh_memo(mesh, "ring_attention")
+    attn = memo.get(axis_name)
+    if attn is None:
+        attn = memo[axis_name] = make_cp_attention(mesh, ring_attention,
+                                                   axis_name)
+    return attn

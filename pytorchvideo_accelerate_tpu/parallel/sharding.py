@@ -9,40 +9,67 @@ sharded over `fsdp`), and everything else follows from XLA's propagation.
 
 from __future__ import annotations
 
-import functools
 import re
 from typing import Any, Optional
 
 import jax
 import numpy as np
+from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorchvideo_accelerate_tpu.parallel.mesh import (
     AXIS_FSDP,
-    AXIS_TENSOR,
-    BATCH_AXES,
+    batch_axes,
+    mesh_memo,
+    model_axis,
 )
 
 
-@functools.lru_cache(maxsize=64)
 def _cached_sharding(mesh: Mesh, spec: P) -> NamedSharding:
-    """Memoized NamedSharding construction. `shard_batch` runs once per
-    train/eval step (and, with the device prefetcher, on a background
-    thread's critical path), so the {mesh, spec} -> NamedSharding pair is
-    built once per mesh instead of per call. Mesh and PartitionSpec are both
-    hashable; the handful of (mesh, spec) pairs a process ever sees fits
-    comfortably in a small LRU."""
-    return NamedSharding(mesh, spec)
+    """NamedSharding memo on the mesh-identity store (parallel/mesh.py
+    mesh_memo — equality-keyed caching would alias a retired mesh after a
+    mesh-reshape restore). `shard_batch` runs once per train/eval step
+    (and, with the device prefetcher, on a background thread's critical
+    path), so the {mesh, spec} pair is built once per mesh, not per call."""
+    specs = mesh_memo(mesh, "namedshardings")
+    sharding = specs.get(spec)
+    if sharding is None:
+        sharding = specs[spec] = NamedSharding(mesh, spec)
+    return sharding
+
+
+def batch_spec(mesh: Mesh, ndim: int = 1, leading_micro: bool = False) -> P:
+    """PartitionSpec sharding the (global) batch dim over the mesh's DP
+    axes — resolved per mesh layout (("data","fsdp") library mesh /
+    ("data",) train mesh). `leading_micro`: a gradient-accumulation axis
+    precedes the batch dim and stays unsharded."""
+    axes = batch_axes(mesh)
+    lead = (None, axes) if leading_micro else (axes,)
+    return P(*lead, *([None] * (ndim - len(lead))))
 
 
 def batch_sharding(mesh: Mesh) -> NamedSharding:
     """Leading (batch) dim split over the DP axes — the `BatchSamplerShard`
     equivalent, but as a layout annotation instead of an index-stream slicer."""
-    return _cached_sharding(mesh, P(BATCH_AXES))
+    return _cached_sharding(mesh, batch_spec(mesh))
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return _cached_sharding(mesh, P())
+
+
+def constrain_block(x, mesh: Optional[Mesh]):
+    """`with_sharding_constraint` for an activation at a block boundary:
+    batch dim pinned to the mesh's DP axes, everything else unsharded w.r.t.
+    the constraint (XLA still free to propagate inside the block). The
+    GSPMD anchor the transformer trunks drop between blocks so the
+    partitioner re-converges on the (data × model) layout instead of
+    drifting through pooled/resharded intermediates. No-op without a mesh
+    (models built for single-device use/conversion parity)."""
+    if mesh is None:
+        return x
+    return lax.with_sharding_constraint(
+        x, _cached_sharding(mesh, batch_spec(mesh, x.ndim)))
 
 
 def shard_batch(mesh: Mesh, batch: Any, micro_dim: bool = False) -> Any:
@@ -58,8 +85,8 @@ def shard_batch(mesh: Mesh, batch: Any, micro_dim: bool = False) -> Any:
     equivalent of per-rank DataLoader shards feeding DDP.
     """
     sharding = (
-        _cached_sharding(mesh, P(None, BATCH_AXES)) if micro_dim
-        else batch_sharding(mesh)
+        _cached_sharding(mesh, batch_spec(mesh, 2, leading_micro=True))
+        if micro_dim else batch_sharding(mesh)
     )
 
     def place(x):
@@ -90,7 +117,7 @@ def fsdp_spec(shape, fsdp_size: int, min_size: int = 2**16) -> P:
     return P()
 
 
-# --- tensor parallelism (Megatron pattern over the `tensor` axis) ---------
+# --- tensor parallelism (Megatron pattern over the model/tensor axis) -----
 #
 # Column-parallel layers (qkv, mlp_fc1) shard their output-features dim and
 # bias; row-parallel layers (attention out-proj, mlp_fc2) shard the
@@ -102,6 +129,18 @@ def fsdp_spec(shape, fsdp_size: int, min_size: int = 2**16) -> P:
 # models/ (mvit.py / videomae.py ViTBlock): qkv, proj, mlp_fc1, mlp_fc2.
 _TP_COLUMN = frozenset({"qkv", "mlp_fc1"})
 _TP_ROW = frozenset({"proj", "mlp_fc2"})
+
+# per-model-family use of the train mesh's `model` axis
+# (docs/PARALLELISM.md): transformer families carry the qkv/proj/mlp module
+# names the Megatron rules key on, so their attention heads and MLP widths
+# split over `model`; every conv family replicates over it (their
+# parallelism win is `data` + the fsdp library axis, not head splitting).
+_TP_FAMILIES = ("mvit", "videomae")
+
+
+def family_uses_tp(model_name: str) -> bool:
+    """Does this model family spend the `model` axis on Megatron TP?"""
+    return model_name.startswith(_TP_FAMILIES)
 
 
 def _path_names(path) -> tuple:
@@ -141,13 +180,22 @@ def tp_dim(names: tuple, shape: tuple, tensor_size: int) -> Optional[int]:
     return None
 
 
-def param_sharding(mesh: Mesh, params: Any, min_size: int = 2**16) -> Any:
+def param_sharding(mesh: Mesh, params: Any, min_size: int = 2**16,
+                   tp: bool = True) -> Any:
     """Sharding tree for a param/opt-state pytree: replicated under pure DP,
-    fsdp-sharded (ZeRO-3 equivalent) when the fsdp axis is >1, and
-    Megatron-style tensor-sharded over `tensor` for transformer qkv/proj/MLP
-    params (composing with fsdp on a different dim where divisible)."""
-    fsdp_size = mesh.shape[AXIS_FSDP]
-    tensor_size = mesh.shape.get(AXIS_TENSOR, 1)
+    fsdp-sharded (ZeRO-3 equivalent) when the mesh carries an fsdp axis >1,
+    and Megatron-style tensor-sharded over the mesh's model-parallel axis
+    ("model" on the train mesh / "tensor" on the library mesh) for
+    transformer qkv/proj/MLP params (composing with fsdp on a different dim
+    where divisible).
+
+    `tp=False` keeps every param off the model-parallel axis even when the
+    names match — the context-parallel lane's layout (the model axis is
+    spent on token sharding there, never on params; parallel/mesh.cp_axis)
+    and the conv families' replicated-model-axis fallback."""
+    fsdp_size = mesh.shape[AXIS_FSDP] if AXIS_FSDP in mesh.axis_names else 1
+    tp_name = model_axis(mesh)
+    tensor_size = mesh.shape[tp_name] if (tp and tp_name is not None) else 1
 
     def rule(path, x):
         shape = tuple(np.shape(x))
@@ -155,7 +203,7 @@ def param_sharding(mesh: Mesh, params: Any, min_size: int = 2**16) -> Any:
         if d is None:
             return NamedSharding(mesh, fsdp_spec(shape, fsdp_size, min_size))
         spec = [None] * len(shape)
-        spec[d] = AXIS_TENSOR
+        spec[d] = tp_name
         if fsdp_size > 1 and np.prod(shape, dtype=np.int64) >= min_size:
             for other in sorted(range(len(shape)), key=lambda i: -shape[i]):
                 if other != d and shape[other] % fsdp_size == 0:
@@ -166,19 +214,22 @@ def param_sharding(mesh: Mesh, params: Any, min_size: int = 2**16) -> Any:
     return jax.tree_util.tree_map_with_path(rule, params)
 
 
-def shard_params(mesh: Mesh, params: Any, min_size: int = 2**16) -> Any:
+def shard_params(mesh: Mesh, params: Any, min_size: int = 2**16,
+                 tp: bool = True) -> Any:
     """Place a param pytree per `param_sharding`."""
-    shardings = param_sharding(mesh, params, min_size)
+    shardings = param_sharding(mesh, params, min_size, tp=tp)
     return jax.tree.map(jax.device_put, params, shardings)
 
 
-def state_sharding_like(mesh: Mesh, state: Any, min_size: int = 2**16) -> Any:
+def state_sharding_like(mesh: Mesh, state: Any, min_size: int = 2**16,
+                        tp: bool = True) -> Any:
     """Sharding pytree for an arbitrary train-state pytree (params + opt
     state + scalars): scalars/small leaves replicated, big leaves fsdp-ruled."""
-    return param_sharding(mesh, state, min_size)
+    return param_sharding(mesh, state, min_size, tp=tp)
 
 
-def shard_state(mesh: Mesh, state: Any, min_size: int = 2**16) -> Any:
+def shard_state(mesh: Mesh, state: Any, min_size: int = 2**16,
+                tp: bool = True) -> Any:
     """Place a WHOLE train-state pytree (params + opt state + step scalar +
     EMA) on the mesh with committed NamedShardings.
 
@@ -192,5 +243,5 @@ def shard_state(mesh: Mesh, state: Any, min_size: int = 2**16) -> Any:
     Settling the layouts here makes call 2 hit call 1's executable; the
     `pva_train_recompiles` gauge (analysis/recompile_guard.py) is the
     regression tripwire."""
-    shardings = state_sharding_like(mesh, state, min_size)
+    shardings = state_sharding_like(mesh, state, min_size, tp=tp)
     return jax.tree.map(jax.device_put, state, shardings)

@@ -23,7 +23,6 @@ Layouts (cp = context-axis size):
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax.numpy as jnp
@@ -32,7 +31,7 @@ from jax.sharding import Mesh
 
 from pytorchvideo_accelerate_tpu.ops.attention import fused_attention
 from pytorchvideo_accelerate_tpu.parallel.collectives import axis_size
-from pytorchvideo_accelerate_tpu.parallel.mesh import AXIS_CONTEXT
+from pytorchvideo_accelerate_tpu.parallel.mesh import AXIS_CONTEXT, mesh_memo
 
 
 def ulysses_attention(q, k, v, axis_name: str = AXIS_CONTEXT,
@@ -65,11 +64,16 @@ def ulysses_attention(q, k, v, axis_name: str = AXIS_CONTEXT,
     return to_tokens(out)
 
 
-@functools.lru_cache(maxsize=16)
-def make_ulysses_attention(mesh: Mesh, axis_name: str = AXIS_CONTEXT):
+def make_ulysses_attention(mesh: Mesh, axis_name: Optional[str] = None):
     """Drop-in ulysses `attn(q, k, v)` for auto-sharded models under `jit` —
-    same contract as `make_ring_attention` (token axis sharded over
-    ``context``, ragged lengths padded + masked); see `make_cp_attention`."""
+    same contract as `make_ring_attention` (token axis sharded over the
+    mesh's CP axis, ragged lengths padded + masked, memoized on the
+    mesh-identity store); see `make_cp_attention`."""
     from pytorchvideo_accelerate_tpu.parallel.ring_attention import make_cp_attention
 
-    return make_cp_attention(mesh, ulysses_attention, axis_name)
+    memo = mesh_memo(mesh, "ulysses_attention")
+    attn = memo.get(axis_name)
+    if attn is None:
+        attn = memo[axis_name] = make_cp_attention(mesh, ulysses_attention,
+                                                   axis_name)
+    return attn

@@ -20,6 +20,12 @@ Legs (all seeded via one `--seed`, CPU-only, replayable):
   faults armed) takes the grace path — emergency checkpoint, flight
   dump, exit 0 — and `resume=auto` lands on the exact step and finishes
   the run;
+- **preempt_mesh**: the same grace path across a MESH RESHAPE — a
+  forced-host subprocess trains on a (2, 2) (data, model) train mesh,
+  SIGTERMs itself mid-run, and a second subprocess resumes with
+  `resume=auto` on a (4, 1) mesh: it must land on the exact emergency
+  step and finish (the mesh-portable-checkpoint contract,
+  docs/PARALLELISM.md runbook);
 - **serve**: synthetic overload against a micro-batcher + admission
   controller — load sheds with 503/Retry-After semantics before latency
   collapses, an injected flush fault fails one batch (not the thread),
@@ -361,6 +367,109 @@ def leg_preempt(report: dict, tmpdir: str, seed: int, log: Log) -> None:
         f"resumed and finished at {res2.get('steps')}")
 
 
+# the (data, model) shapes the mesh-reshape preemption leg crosses, and the
+# forced-host device count both subprocesses run under
+_MESH_LEG_DEVICES = 4
+_MESH_LEG_TRAIN = (2, 2)
+_MESH_LEG_RESUME = (4, 1)
+
+# subprocess body for both phases of leg_preempt_mesh: train tiny3d on a
+# (data, model) train mesh; in "kill" mode, self-SIGTERM shortly after fit
+# starts (the preemption guard is pre-installed, so the signal takes the
+# grace path wherever it lands — compile or step loop) and report the
+# emergency record; in resume mode, resume=auto on the reshaped mesh and
+# report the step it landed on. One JSON line to stdout (forcehost
+# contract).
+_MESH_LEG_CODE = """
+import json, os, sys, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorchvideo_accelerate_tpu.config import (
+    CheckpointConfig, DataConfig, MeshConfig, ModelConfig, OptimConfig,
+    TrainConfig)
+from pytorchvideo_accelerate_tpu.reliability.preemption import (
+    get_guard, read_emergency_record)
+from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+outdir, kill, data_ax, model_ax, seed = (
+    {outdir!r}, {kill!r} == "kill", {data_ax}, {model_ax}, {seed})
+cfg = TrainConfig(
+    mesh=MeshConfig(data=data_ax, model=model_ax),
+    model=ModelConfig(name="tiny3d", num_classes=4, dropout_rate=0.0),
+    data=DataConfig(synthetic=True, synthetic_num_videos=16, num_frames=4,
+                    crop_size=24, batch_size=2, num_workers=1,
+                    limit_val_batches=1),
+    optim=OptimConfig(num_epochs=2, lr=0.01),
+    checkpoint=CheckpointConfig(output_dir=outdir,
+                                resume_from_checkpoint="" if kill
+                                else "auto"),
+    seed=seed,
+)
+tr = Trainer(cfg)
+found = (tr.checkpointer.latest_step()
+         if (not kill and tr.checkpointer) else None)
+if kill:
+    get_guard().install()  # never race the dump-only default handler
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.5),
+                        os.kill(os.getpid(), __import__("signal").SIGTERM)),
+        daemon=True)
+    t.start()
+res = tr.fit()
+rec = read_emergency_record(outdir)
+out = {{"mesh": [data_ax, model_ax], "preempted": bool(res.get("preempted")),
+        "steps": res.get("steps"), "total": tr.total_steps,
+        "emergency_step": rec and rec.get("step"), "found": found}}
+print("\\n" + json.dumps(out))
+"""
+
+
+def leg_preempt_mesh(report: dict, tmpdir: str, seed: int, log: Log) -> None:
+    """Preemption grace across a mesh reshape: SIGTERM on a (2, 2) train
+    mesh, emergency save, `resume=auto` on (4, 1) lands on the same step
+    and finishes. Both halves run in forced-host subprocesses (this
+    process's device count latched at backend init and cannot change), so
+    the leg exercises the REAL cross-shape restore path — orbax resharding
+    into the new mesh's layouts — not an in-process approximation."""
+    from pytorchvideo_accelerate_tpu.utils.forcehost import run_forced_host
+
+    leg = _leg(report, "preempt_mesh")
+    outdir = os.path.join(tmpdir, "mesh_run")
+
+    def phase(kill: str, shape) -> dict:
+        return run_forced_host(
+            _MESH_LEG_CODE.format(outdir=outdir, kill=kill,
+                                  data_ax=shape[0], model_ax=shape[1],
+                                  seed=seed),
+            _MESH_LEG_DEVICES, timeout=420.0)
+
+    a = phase("kill", _MESH_LEG_TRAIN)
+    leg["train"] = a
+    if not a.get("preempted"):
+        _finding(report, "preempt_mesh",
+                 "SIGTERM did not take the grace path on the (2,2) mesh")
+        return
+    if not a.get("emergency_step"):
+        _finding(report, "preempt_mesh", "no emergency checkpoint record")
+        return
+    b = phase("resume", _MESH_LEG_RESUME)
+    leg["resume"] = b
+    # resume=auto must FIND the exact emergency step (the reshaped restore
+    # re-places every leaf under the new mesh's shardings; a step drift
+    # means it read stale or partial state), then run to completion
+    if b.get("found") != a["emergency_step"]:
+        _finding(report, "preempt_mesh",
+                 f"resume=auto on the reshaped mesh found step "
+                 f"{b.get('found')}, emergency saved {a['emergency_step']}")
+    if b.get("preempted") or (b.get("steps") or 0) < a["emergency_step"]:
+        _finding(report, "preempt_mesh",
+                 f"resume on the reshaped mesh did not complete: {b}")
+        return
+    log(f"[chaos] preempt_mesh: SIGTERM at step {a['emergency_step']} on "
+        f"mesh {a['mesh']}, resume=auto on {b['mesh']} landed on the same "
+        f"step and finished at {b['steps']}")
+
+
 class _StubEngine:
     """Bucket geometry + a host-side forward slow enough to build a queue
     (no jax: the serving leg measures the control plane, not the chip)."""
@@ -531,6 +640,7 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                 (leg_tracker, (report, tmpdir, seed, log)),
                 (leg_serve, (report, seed, log)),
                 (leg_preempt, (report, tmpdir, seed, log)),
+                (leg_preempt_mesh, (report, tmpdir, seed, log)),
         ):
             try:
                 fn(*args)

@@ -175,21 +175,48 @@ class Checkpointer:
                    deadline_s=self.retry_deadline_s)
 
     def restore(
-        self, state_template: Any, step: Optional[int] = None, mesh=None
+        self, state_template: Any, step: Optional[int] = None, mesh=None,
+        tp: bool = True,
     ) -> Tuple[Any, dict, int]:
         """Restore `(state, extra, step)`; `state_template` (a matching
         pytree, e.g. a freshly-initialized TrainState) drives dtypes/shapes.
-        Pass `mesh` to restore arrays directly into their mesh placement
-        (replicated / fsdp-sharded per parallel.sharding rules) — without it,
-        restored arrays are committed to the default device and will clash
-        with mesh-sharded batches inside jit."""
+        Pass `mesh` to restore arrays directly into their mesh placement —
+        without it, restored arrays are committed to the default device and
+        will clash with mesh-sharded batches inside jit.
+
+        MESH-PORTABLE: the restore target's layout comes from the CURRENT
+        mesh, never the one the checkpoint was written under — orbax
+        reshards at read time — so a run saved on a (1, N) train mesh
+        resumes on (N, 1) or single-chip at the same step with identical
+        values (docs/PARALLELISM.md runbook). When a template leaf is
+        already a committed array on this mesh (the trainer's
+        freshly-built, shard_state-settled state), its OWN sharding is the
+        target — that keeps every leaf bit-identical in layout to the
+        no-resume path (per-family tp/cp decisions included), so the first
+        post-resume step hits the same executable a fresh run compiles.
+        Leaves without a usable sharding (host arrays, foreign-mesh relics)
+        fall back to the parallel.sharding rules — `tp` mirrors the
+        caller's per-family model-axis decision there, so a fallback can
+        never TP-shard params a context-parallel or conv-family run keeps
+        replicated."""
         if mesh is not None and state_template is not None:
+            from jax.sharding import NamedSharding as _NS
+
             from pytorchvideo_accelerate_tpu.parallel.sharding import (
                 state_sharding_like,
             )
             import jax.numpy as jnp
 
-            shardings = state_sharding_like(mesh, state_template)
+            computed = state_sharding_like(mesh, state_template, tp=tp)
+
+            def target_sharding(x, fallback):
+                s = getattr(x, "sharding", None)
+                if isinstance(s, _NS) and s.mesh == mesh:
+                    return s
+                return fallback
+
+            shardings = jax.tree.map(target_sharding, state_template,
+                                     computed)
             state_template = jax.tree.map(
                 lambda x, s: jax.ShapeDtypeStruct(
                     jnp.shape(x), jnp.asarray(x).dtype, sharding=s

@@ -34,8 +34,17 @@ from pytorchvideo_accelerate_tpu.parallel.distributed import (
     is_main_process,
     main_print,
 )
-from pytorchvideo_accelerate_tpu.parallel.mesh import data_shard_count, make_mesh
-from pytorchvideo_accelerate_tpu.parallel.sharding import shard_params, shard_state
+from pytorchvideo_accelerate_tpu.parallel.mesh import (
+    cp_axis,
+    data_shard_count,
+    make_train_mesh,
+    model_axis,
+)
+from pytorchvideo_accelerate_tpu.parallel.sharding import (
+    family_uses_tp,
+    shard_params,
+    shard_state,
+)
 from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
 from pytorchvideo_accelerate_tpu.reliability.preemption import (
     get_guard,
@@ -145,11 +154,32 @@ class Trainer:
         )
         set_seed(cfg.seed)
         self.rng = RngManager(cfg.seed)
-        self.mesh = make_mesh(cfg.mesh)
+        # the 2-D (data, model) GSPMD backbone (parallel/mesh.py;
+        # docs/PARALLELISM.md). A legacy fsdp/tensor/context MeshConfig
+        # still resolves to the 4-axis library mesh — every consumer below
+        # resolves axes from the mesh itself.
+        self.mesh = make_train_mesh(cfg.mesh)
+        # how the model axis is spent is a per-family decision
+        # (parallel/sharding.py): transformer families split heads/MLP
+        # widths over it (Megatron TP) — UNLESS the context-parallel lane
+        # is on AND spends that same axis on token sharding (the 2-D train
+        # mesh, where "model" is the CP axis; params replicated) — and
+        # conv families replicate over it. On the library mesh CP has its
+        # own "context" axis, so TP over "tensor" composes with it.
+        self._cp = cfg.model.attention in ("ring", "ulysses")
+        m_axis = model_axis(self.mesh)
+        cp_spends_model_axis = (
+            self._cp and m_axis is not None and cp_axis(self.mesh) == m_axis
+        )
+        self._tp = family_uses_tp(cfg.model.name) and not cp_spends_model_axis
+        m_size = self.mesh.shape[m_axis] if m_axis else 1
+        mode = ("context-parallel" if cp_spends_model_axis
+                else "tensor-parallel" if self._tp else "replicated")
         main_print(
-            f"mesh: {dict(self.mesh.shape)} over {len(jax.devices())} "
+            f"mesh: {dict(self.mesh.shape)} over {self.mesh.size} "
             f"{jax.devices()[0].platform} devices, "
             f"{jax.process_count()} process(es)"
+            + (f"; model axis ({m_size}): {mode}" if m_size > 1 else "")
         )
 
         self._build_data()
@@ -366,8 +396,9 @@ class Trainer:
         )
         self.lr_schedule = build_lr_schedule(cfg.optim, self.total_steps)
 
-        params = shard_params(self.mesh, variables["params"])
-        batch_stats = shard_params(self.mesh, variables.get("batch_stats", {}))
+        params = shard_params(self.mesh, variables["params"], tp=self._tp)
+        batch_stats = shard_params(self.mesh, variables.get("batch_stats", {}),
+                                   tp=self._tp)
         if not 0.0 <= cfg.optim.ema_decay < 1.0:
             raise ValueError(
                 f"optim.ema_decay must be in [0, 1), got "
@@ -381,7 +412,7 @@ class Trainer:
         # state and the SECOND step pays a full silent XLA recompile
         # (found by the pva_train_recompiles guard; parallel/sharding.py
         # shard_state)
-        self.state = shard_state(self.mesh, self.state)
+        self.state = shard_state(self.mesh, self.state, tp=self._tp)
 
         if cfg.model.pretrained and not cfg.model.pretrained_path:
             # unlike the reference there is no runtime hub fetch (zero
@@ -399,7 +430,7 @@ class Trainer:
                 cfg.model.pretrained_path,
                 {"params": self.state.params,
                  "batch_stats": self.state.batch_stats},
-                mesh=self.mesh, model=cfg.model.name,
+                mesh=self.mesh, model=cfg.model.name, tp=self._tp,
             )
             self.state = self.state.replace(
                 params=merged["params"], batch_stats=merged["batch_stats"],
@@ -523,7 +554,7 @@ class Trainer:
                 f"no checkpoint to resume in {self.checkpointer.directory}"
             )
         self.state, extra, step = self.checkpointer.restore(
-            self.state, step=latest, mesh=self.mesh
+            self.state, step=latest, mesh=self.mesh, tp=self._tp
         )
         main_print(f"resumed from checkpoint step {step}")
         for name, obj in self._registered.items():
@@ -993,7 +1024,13 @@ class Trainer:
                     if self._flops_per_step:
                         from pytorchvideo_accelerate_tpu.utils.hw import peak_tflops
 
-                        n_dev = len(jax.devices())
+                        # per-chip = whole-program FLOPs over the MESH's
+                        # device count: flops_per_step is the global cost
+                        # of one step, counted once — dividing by the mesh
+                        # size attributes it across data AND model shards
+                        # without double counting (a mesh smaller than
+                        # jax.devices() must not dilute the number either)
+                        n_dev = self.mesh.size
                         tflops = self._flops_per_step * sps / 1e12 / n_dev
                         last_perf["tflops_per_sec_per_chip"] = tflops
                         peak = peak_tflops(jax.devices()[0])

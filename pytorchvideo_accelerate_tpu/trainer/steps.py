@@ -33,7 +33,7 @@ import optax
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from pytorchvideo_accelerate_tpu.parallel.mesh import BATCH_AXES
+from pytorchvideo_accelerate_tpu.parallel.mesh import batch_axes
 from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
 
 
@@ -75,8 +75,10 @@ def device_normalize_batch(batch: dict, norm) -> dict:
 
 
 def _constrain_batch(batch: dict, mesh, leading_micro: bool) -> dict:
-    """Pin the (global) batch dim to the DP axes inside the graph."""
-    axes = (None, BATCH_AXES) if leading_micro else (BATCH_AXES,)
+    """Pin the (global) batch dim to the mesh's DP axes inside the graph
+    (("data","fsdp") on the library mesh, ("data",) on the 2-D train mesh)."""
+    daxes = batch_axes(mesh)
+    axes = (None, daxes) if leading_micro else (daxes,)
 
     def cons(x):
         spec = P(*axes, *([None] * (x.ndim - len(axes))))

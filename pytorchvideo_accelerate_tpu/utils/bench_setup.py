@@ -53,6 +53,14 @@ def build_step_setup(
     #                        pages are calloc'd, no RNG cost at big batches)
     input_u8: bool = False,  # raw-u8 batches + in-graph normalize (the
     #                          host_cast=u8 production path; supervised only)
+    mesh_cfg=None,  # MeshConfig for a non-default layout (e.g. the 2-D
+    #                 (data, model) shapes the multichip bench sweeps)
+    mixed_precision: str = "bf16",  # "fp32" for numerics probes (the
+    #                 multichip parity lane: bf16 summation-order noise
+    #                 compounds across update steps)
+    global_batch: Optional[int] = None,  # fixed TOTAL batch instead of
+    #                 batch_per_chip * n_chips — the mesh-parity lane needs
+    #                 the identical batch on every mesh shape
 ) -> StepSetup:
     import jax
     import jax.numpy as jnp
@@ -62,8 +70,12 @@ def build_step_setup(
         DataConfig, MeshConfig, ModelConfig, OptimConfig,
     )
     from pytorchvideo_accelerate_tpu.models import create_model
-    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.parallel.mesh import (
+        data_shard_count,
+        make_train_mesh,
+    )
     from pytorchvideo_accelerate_tpu.parallel.sharding import (
+        family_uses_tp,
         shard_batch,
         shard_state,
     )
@@ -76,12 +88,18 @@ def build_step_setup(
     input_u8 = input_u8 and not pretrain  # MAE target needs the f32 clip
     cfg = ModelConfig(name=model_name, num_classes=num_classes,
                       slowfast_alpha=alpha, **(overrides or {}))
-    model = create_model(cfg, "bf16")
+    model = create_model(cfg, mixed_precision)
     if devices is None:
         devices = jax.devices()
     n_chips = len(devices)
-    mesh = make_mesh(MeshConfig(), devices=devices)
-    B = batch_per_chip * n_chips
+    # the trainer's backbone layout (2-D (data, model) train mesh); a
+    # legacy MeshConfig still resolves to the 4-axis library mesh
+    mesh = make_train_mesh(mesh_cfg or MeshConfig(), devices=devices)
+    B = global_batch if global_batch is not None else batch_per_chip * n_chips
+    if B % data_shard_count(mesh):
+        raise ValueError(
+            f"global batch {B} must divide the mesh's "
+            f"{data_shard_count(mesh)} data shards")
 
     if accum > 1 and B % accum:
         raise ValueError(
@@ -132,9 +150,11 @@ def build_step_setup(
     tx = build_optimizer(OptimConfig(), total_steps=total_steps)
     # shard_state, not raw create: uncommitted single-device leaves would
     # make the measured step's SECOND call recompile (layout settling),
-    # corrupting the warmup accounting — same fix as Trainer's
+    # corrupting the warmup accounting — same fix as Trainer's. The tp
+    # flag mirrors the trainer's per-family model-axis decision.
     state = shard_state(mesh, TrainState.create(
-        variables["params"], variables.get("batch_stats", {}), tx))
+        variables["params"], variables.get("batch_stats", {}), tx),
+        tp=family_uses_tp(model_name))
     if pretrain:
         step = make_pretrain_step(model, tx, mesh, accum_steps=accum)
     else:

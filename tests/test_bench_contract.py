@@ -142,6 +142,11 @@ def test_finalize_line_fits_driver_capture():
         "obs_step_s": 0.012345, "obs_input_wait_frac": 0.0123,
         "obs_h2d_s": 0.001234, "train_recompiles": 0, "tsan_findings": 0,
         "chaos_findings": 0,
+        "mesh_parity": True, "mesh_ckpt_portable": True,
+        "multichip_cps_per_chip": {"1": 123.456, "8": 117.89},
+        "multichip_forced_host": True, "multichip_train_recompiles": 0,
+        "multichip_mfu": 0.1234,
+        "multichip_error": "no trustworthy device numbers " + "z" * 200,
         "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
         "error": "watchdog fired: " + "y" * 3000,
         "probe_attempts": [
@@ -202,6 +207,30 @@ def test_finalize_chaos_findings_ride_the_headline():
     assert out["chaos_findings"] == 0
     out = bench.finalize(_model(), {"chaos_findings": 3}, user_smoke=False)
     assert out["chaos_findings"] == 3
+
+
+def test_finalize_multichip_keys_ride_the_headline():
+    """The MULTICHIP scaling lane's verdicts (mesh_parity /
+    mesh_ckpt_portable — the numbers `--smoke` asserts true) and its
+    clearly-labeled curve (cps/chip + forced_host provenance + per-chip
+    MFU) plumb through finalize onto the headline line."""
+    extras = {"mesh_parity": True, "mesh_ckpt_portable": True,
+              "multichip_cps_per_chip": {"1": 10.0, "8": 9.5},
+              "multichip_forced_host": True,
+              "multichip_train_recompiles": 0, "multichip_mfu": 0.21}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["mesh_parity"] is True
+    assert out["mesh_ckpt_portable"] is True
+    assert out["multichip_cps_per_chip"] == {"1": 10.0, "8": 9.5}
+    assert out["multichip_forced_host"] is True
+    assert out["multichip_train_recompiles"] == 0
+    assert out["multichip_mfu"] == 0.21
+    # a suspect lane headlines its refusal, never its numbers
+    out = bench.finalize(
+        _model(), {"mesh_parity": True, "multichip_error": "cpu fallback"},
+        user_smoke=False)
+    assert out["multichip_error"] == "cpu fallback"
+    assert "multichip_cps_per_chip" not in out
 
 
 def test_finalize_serving_lane_keys():
