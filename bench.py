@@ -727,6 +727,171 @@ def bench_transport_crossover(args) -> dict:
     return out
 
 
+# SERVE_FLEET smoke sizing: (replicas, forced CPU devices for the child,
+# offered rps, arrival window s, p99 SLO ms). Module-level so the contract
+# test can shrink it; the SLO is generous for a CPU child that compiles
+# tiny3d buckets while serving — the lane proves the fleet machinery, the
+# absolute numbers are honest smoke numbers.
+FLEET_SMOKE = dict(replicas=2, devices=2, rate_rps=20.0, duration_s=4.0,
+                   slo_p99_ms=2500.0)
+FLEET_FULL = dict(replicas=2, devices=0, rate_rps=100.0, duration_s=10.0,
+                  slo_p99_ms=500.0)
+
+
+def bench_fleet(args) -> dict:
+    """The SERVE_FLEET lane: ≥2 `InferenceEngine` replicas on disjoint
+    meshes behind the fleet router, driven OPEN-loop (Poisson arrivals,
+    heavy-tail view mix) while a blue/green checkpoint hot-swap lands
+    mid-load. Headlines `serve_rps` / `serve_p99_ms_under_load` /
+    `swap_blackout_ms` / `fleet_shed_frac`; a non-smoke run that fell back
+    to CPU refuses to headline (suspect), per the standing bench rule.
+
+    Proof obligations baked into the record (asserted by --smoke):
+    - the open-loop schedule was KEPT (`open_loop_ok`) — otherwise the
+      harness degraded to closed-loop and the rps/p99 numbers are fiction;
+    - zero failed (non-shed) requests across the whole run, INCLUDING the
+      mid-load swap — sheds are policy, failures are bugs;
+    - the swap measurably cut over: post-swap logits differ from pre-swap
+      logits for the same probe clip (params are scaled on export).
+    """
+    import threading
+
+    import jax
+    import numpy as np
+    import optax
+
+    from pytorchvideo_accelerate_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, TrainConfig,
+    )
+    from pytorchvideo_accelerate_tpu.fleet import (
+        LoadGen, LocalReplica, ReplicaPool, Router, Scheduler,
+        heavy_tail_clip_factory, hot_swap,
+    )
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.parallel.mesh import make_mesh
+    from pytorchvideo_accelerate_tpu.serving import (
+        InferenceEngine, ServingStats,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+        export_inference,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+
+    shape = FLEET_SMOKE if args.smoke else FLEET_FULL
+    frames, crop = (4, 32) if args.smoke else (8, 64)
+    num_classes = 16
+    devices = jax.devices()
+    platform = devices[0].platform
+    # the acceptance bar is >= 2 replicas; on a 1-device host they share
+    # the device (distinct engines/executables), on the forced-host slice
+    # and real multi-chip they land on disjoint single-device meshes
+    n_rep = shape["replicas"]
+    cfg = TrainConfig(
+        mesh=MeshConfig(data=1),
+        model=ModelConfig(name="tiny3d", num_classes=num_classes,
+                          dropout_rate=0.0),
+        data=DataConfig(num_frames=frames, crop_size=crop),
+    )
+    model = create_model(cfg.model, "bf16")
+    variables = model.init(
+        jax.random.key(0),
+        np.zeros((1, frames, crop, crop, 3), np.float32))
+    params, bstats = variables["params"], variables.get("batch_stats", {})
+
+    rng = np.random.default_rng(0)
+    base_clip = {"video": rng.standard_normal(
+        (frames, crop, crop, 3)).astype(np.float32)}
+    two_view = {"video": np.stack([base_clip["video"]] * 2)}
+
+    replicas = []
+    for i in range(n_rep):
+        # one device per replica when the slice allows (the forced-host
+        # multi-device CI path); engines share weights, not executables
+        dev = devices[i % len(devices)]
+        mesh = make_mesh(MeshConfig(data=1), devices=[dev])
+        stats = ServingStats(window=2048)
+        engine = InferenceEngine(model, params, bstats, mesh,
+                                 num_classes=num_classes, max_batch_size=4,
+                                 stats=stats, model_name="tiny3d")
+        log(f"[fleet] replica {i} on {dev}: warming buckets "
+            f"{engine.buckets} (1- and 2-view)")
+        engine.warmup(base_clip)
+        engine.warmup(two_view)
+        sched = Scheduler(engine, max_queue=256, stats=stats,
+                          realtime_deadline_ms=shape["slo_p99_ms"] * 4,
+                          batch_max_wait_ms=5.0, name=f"r{i}")
+        replicas.append(LocalReplica(f"r{i}", sched))
+    pool = ReplicaPool(replicas, health_interval_s=0.25)
+    router = Router(pool)
+
+    # the green checkpoint: same model, deterministically different weights
+    # (scaled), exported through the REAL artifact path so the swap
+    # exercises from_artifact -> pre-warm -> cutover end to end
+    import tempfile
+
+    art_dir = tempfile.mkdtemp(prefix="pva_fleet_swap_")
+    green_params = jax.tree.map(lambda x: x * 1.25, params)
+    export_inference(
+        art_dir, TrainState.create(green_params, bstats, optax.sgd(0.1)),
+        config=cfg, meta={"num_classes": num_classes, "model": "tiny3d"})
+
+    pre_logits = np.asarray(
+        router.submit(base_clip).result(timeout=60), np.float32)
+
+    swap_out: dict = {}
+    gen = LoadGen(router.submit, rate_rps=shape["rate_rps"],
+                  duration_s=shape["duration_s"],
+                  clip_factory=heavy_tail_clip_factory(
+                      base_clip, view_mix=((1, 0.9), (2, 0.1))),
+                  seed=0, priority="realtime")
+
+    def swapper():
+        time.sleep(shape["duration_s"] * 0.4)  # mid-load, by construction
+        try:
+            swap_out.update(hot_swap(replicas, art_dir))
+        except Exception as e:  # noqa: BLE001 - a failed swap IS the result
+            swap_out["error"] = f"{type(e).__name__}: {e}"
+
+    st = threading.Thread(target=swapper, daemon=True)
+    st.start()
+    try:
+        report = gen.run()
+        st.join(timeout=300.0)
+        post_logits = np.asarray(
+            router.submit(base_clip).result(timeout=60), np.float32)
+    finally:
+        import shutil
+
+        router.close()
+        shutil.rmtree(art_dir, ignore_errors=True)
+    fleet_snap = router.fleet_snapshot()
+    swapped = not np.allclose(pre_logits, post_logits, atol=1e-6)
+    out = {
+        "serve_rps": report["achieved_rps"],
+        "serve_p99_ms_under_load": report["p99_ms"],
+        "swap_blackout_ms": swap_out.get("swap_blackout_ms"),
+        "fleet_shed_frac": report["shed_frac"],
+        "fleet_failed": int(report["failed"]),
+        "offered_rps": report["offered_rps"],
+        "open_loop_ok": report["open_loop_ok"],
+        "weights_cut_over": bool(swapped),
+        "replicas": n_rep,
+        "slo_p99_ms": shape["slo_p99_ms"],
+        "fleet_requests": fleet_snap["requests"],
+        "router_retries": fleet_snap["router_retries"],
+        "swap": {k: v for k, v in swap_out.items()},
+        "platform": platform,
+        "smoke": bool(args.smoke),
+        # a non-smoke fleet lane on CPU is a lying tunnel, not a serving
+        # measurement — refuse to headline (finalize drops the perf keys)
+        "suspect": platform == "cpu" and not args.smoke,
+    }
+    if "error" in swap_out:
+        out["error"] = f"hot-swap failed: {swap_out['error']}"
+    log(f"[fleet] {json.dumps(out)}")
+    return out
+
+
 # --- parent orchestration ---------------------------------------------------
 
 def probe_device(probe_attempts: list, timeout: int = 240) -> bool:
@@ -809,6 +974,14 @@ def child_main(args) -> None:
 
         os.environ["XLA_FLAGS"] = forced_host_env(
             MULTICHIP_FORCED_DEVICES)["XLA_FLAGS"]
+    if args.child == "__fleet__" and args.smoke and FLEET_SMOKE["devices"]:
+        # SERVE_FLEET multi-device CI: each replica gets its own forced
+        # CPU device, so routing/swap run against genuinely disjoint
+        # meshes (utils/forcehost.py, same latching rule as multichip)
+        from pytorchvideo_accelerate_tpu.utils.forcehost import forced_host_env
+
+        os.environ["XLA_FLAGS"] = forced_host_env(
+            FLEET_SMOKE["devices"])["XLA_FLAGS"]
     jax = _setup_jax(args.smoke)
     if args.smoke:
         args.steps, args.warmup = min(args.steps, 3), 1
@@ -817,6 +990,8 @@ def child_main(args) -> None:
         res = bench_trainer(args)
     elif args.child == "__multichip__":
         res = bench_multichip(args)
+    elif args.child == "__fleet__":
+        res = bench_fleet(args)
     else:
         devices = jax.devices()
         n_chips = len(devices)
@@ -865,6 +1040,14 @@ def main():
                          "a synthetic client; p50/p99 request latency and "
                          "batch-fill ratio on the headline line "
                          "(--no-serve-smoke skips)")
+    ap.add_argument("--fleet", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="SERVE_FLEET lane: >=2 engine replicas behind the "
+                         "fleet router under open-loop load with a "
+                         "mid-load checkpoint hot-swap; headlines "
+                         "serve_rps / serve_p99_ms_under_load / "
+                         "swap_blackout_ms / fleet_shed_frac "
+                         "(--no-fleet skips)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe shapes for harness verification")
     ap.add_argument("--per_model_timeout", type=int, default=900,
@@ -1135,6 +1318,29 @@ def main():
             dp["feed_projection"] = feed_projection(dp)
         flush_partial()
 
+    if args.fleet:
+        # SERVE_FLEET lane: child-isolated like the model benches (a
+        # wedged warmup compile loses the lane, not the round); smoke mode
+        # runs on a forced-host slice so the two replicas get disjoint
+        # devices. A non-smoke run with the tunnel down falls back to a
+        # CPU child, which refuses to headline (suspect) — the standing
+        # no-CPU-numbers-as-device-numbers rule.
+        fl = run_child("__fleet__", args, user_smoke or not device_ok,
+                       _model_timeout(args))
+        extras["fleet"] = fl  # full record -> bench_partial.json
+        if "error" in fl:
+            extras["fleet_error"] = str(fl["error"])[:120]
+        elif fl.get("suspect"):
+            extras["fleet_error"] = (
+                "no trustworthy device numbers for the fleet lane "
+                "(cpu fallback); see bench_partial.json")
+        else:
+            for key in ("serve_rps", "serve_p99_ms_under_load",
+                        "swap_blackout_ms", "fleet_shed_frac"):
+                if fl.get(key) is not None:
+                    extras[key] = fl[key]
+        flush_partial()
+
     if args.serve_smoke:
         # serving lane runs in the parent (CPU-pinned, tiny model) but
         # bounded like the host benches: a wedged compile or stuck batcher
@@ -1217,6 +1423,30 @@ def main():
             assert key in headline, (
                 f"serving smoke ran but headline misses {key!r}: "
                 f"{extras.get('serving')}")
+    if user_smoke and args.fleet:
+        # SERVE_FLEET acceptance (docs/SERVING.md § fleet): the open-loop
+        # harness sustained its arrival rate against >=2 replicas, p99
+        # held the configured SLO, the mid-load hot-swap completed with a
+        # measured blackout, and NOTHING failed non-shed — sheds are the
+        # admission/deadline machinery working, failures are bugs
+        fl = extras.get("fleet", {})
+        assert "fleet_error" not in extras, (
+            f"SERVE_FLEET lane failed: {extras['fleet_error']}: {fl}")
+        for key in ("serve_rps", "serve_p99_ms_under_load",
+                    "swap_blackout_ms", "fleet_shed_frac"):
+            assert extras.get(key) is not None, (
+                f"fleet smoke ran but produced no {key!r}: {fl}")
+        assert fl.get("replicas", 0) >= 2, f"fleet ran <2 replicas: {fl}"
+        assert fl.get("open_loop_ok") is True, (
+            f"loadgen degraded toward closed-loop (schedule slipped): {fl}")
+        assert fl.get("fleet_failed") == 0, (
+            f"fleet load run had non-shed failures: {fl}")
+        assert fl.get("weights_cut_over") is True, (
+            f"mid-load hot-swap did not change served weights: {fl}")
+        assert extras["serve_p99_ms_under_load"] <= fl.get(
+            "slo_p99_ms", float("inf")), (
+            f"serve_p99_ms_under_load {extras['serve_p99_ms_under_load']} "
+            f"ms breaches the {fl.get('slo_p99_ms')} ms SLO: {fl}")
     extras["headline"] = headline  # full record keeps the compact line too
     flush_partial()
     print(json.dumps(headline))
@@ -1348,17 +1578,24 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     # recompiles) still ride; error strings truncate on entry
     mc_perf = ("multichip_cps_per_chip", "multichip_forced_host",
                "multichip_mfu")
+    # fleet-lane perf keys obey the same refusal rule: a fleet_error (cpu
+    # fallback or a failed lane) headlines INSTEAD of the numbers
+    fleet_perf = ("serve_rps", "serve_p99_ms_under_load",
+                  "swap_blackout_ms", "fleet_shed_frac")
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "trainer_input_wait_frac", "obs_step_s",
                 "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
                 "tsan_findings", "chaos_findings", "mesh_parity",
                 "mesh_ckpt_portable", "multichip_train_recompiles",
-                *mc_perf):
-        if key in extras and not (key in mc_perf
-                                  and "multichip_error" in extras):
+                *mc_perf, *fleet_perf):
+        if key in extras and not (
+                (key in mc_perf and "multichip_error" in extras)
+                or (key in fleet_perf and "fleet_error" in extras)):
             out[key] = extras[key]
     if "multichip_error" in extras:
         out["multichip_error"] = str(extras["multichip_error"])[:120]
+    if "fleet_error" in extras:
+        out["fleet_error"] = str(extras["fleet_error"])[:120]
     # serving lane: request-latency percentiles + batcher fill ratio
     serving = extras.get("serving", {})
     if "error" in serving:
@@ -1395,8 +1632,25 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                         "(unreachable tunnel or failed bench; see "
                         "bench_partial.json + .probe_log.jsonl); CPU/smoke "
                         "values are not device numbers")
-    # hard size guarantee: shed optional detail before ever exceeding the
-    # driver's capture window, ending with an unconditional last resort
+    # hard size guarantee: shed optional detail one key at a time before
+    # ever exceeding the driver's capture window; the per-model map and
+    # the truncations are LAST resorts (dropping a lane's optional extras
+    # must never cost the models summary)
+    for k in ("probes", "multichip_mfu", "multichip_forced_host",
+              "multichip_train_recompiles", "multichip_error",
+              "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
+              "fleet_error", "fleet_shed_frac", "swap_blackout_ms",
+              "serve_p99_ms_under_load", "serve_rps",
+              "serve_error", "serve_fill_ratio", "serve_p99_ms",
+              "serve_p50_ms", "train_recompiles", "obs_h2d_s",
+              "obs_input_wait_frac",
+              "obs_step_s", "trainer_error", "trainer_input_wait_frac",
+              "trainer_mfu", "trainer_cps_chip",
+              "trainer_vs_rawstep", "detail", "step_ms_blocked",
+              "tflops_per_sec"):  # drop one by one until it fits
+        if len(json.dumps(out)) <= MAX_LINE_BYTES:
+            break
+        out.pop(k, None)
     if len(json.dumps(out)) > MAX_LINE_BYTES:
         out["models"] = {"dropped": "see bench_partial.json"}
     if len(json.dumps(out)) > MAX_LINE_BYTES:
@@ -1404,19 +1658,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         for k in ("error", "trainer_error"):
             if k in out:
                 out[k] = out[k][:120]
-    for k in ("probes", "multichip_mfu", "multichip_forced_host",
-              "multichip_train_recompiles", "multichip_error",
-              "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
-              "serve_error", "serve_fill_ratio", "serve_p99_ms",
-              "serve_p50_ms", "train_recompiles", "obs_h2d_s",
-              "obs_input_wait_frac",
-              "obs_step_s", "trainer_error", "trainer_input_wait_frac",
-              "trainer_mfu", "trainer_cps_chip",
-              "trainer_vs_rawstep", "detail", "step_ms_blocked",
-              "tflops_per_sec", "models"):  # drop one by one until it fits
-        if len(json.dumps(out)) <= MAX_LINE_BYTES:
-            break
-        out.pop(k, None)
+    if len(json.dumps(out)) > MAX_LINE_BYTES:  # unconditional last resort
+        out.pop("models", None)
     return out
 
 

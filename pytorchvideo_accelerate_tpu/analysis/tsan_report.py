@@ -5,10 +5,12 @@ Three jobs:
 - **Bundled stress scenario** (`run_stress`): arm the sanitizer, then
   exercise every threaded layer the way production does — prefetcher churn
   over a synthetic loader (full epoch + mid-flight break), a concurrent
-  micro-batcher with a mid-flight close, TrackerHub fan-out with a raising
-  tracker (the disable-on-failure path), flight-recorder record/dump
-  re-entrancy, and a forced watchdog stall — and report what the run
-  proved. Zero findings on this scenario is a CI gate (`bench.py --smoke`,
+  micro-batcher with a mid-flight close, the fleet tier (router + replica
+  schedulers under mixed-priority clients with a hot-swap cutover and a
+  membership flap racing the health poller), TrackerHub fan-out with a
+  raising tracker (the disable-on-failure path), flight-recorder
+  record/dump re-entrancy, and a forced watchdog stall — and report what
+  the run proved. Zero findings on this scenario is a CI gate (`bench.py --smoke`,
   `scripts/analyze.sh`), same contract as `pva-tpu-lint`.
 - **Report plumbing** (`publish`/`tsan_snapshot`): findings land in the
   obs registry (`pva_tsan_races`, `pva_tsan_lock_cycles` gauges), the
@@ -131,27 +133,12 @@ def queue_handoff_fixture(rounds: int = 50) -> dict:
 
 # --- the bundled stress scenario --------------------------------------------
 
-class _StubEngine:
-    """MicroBatcher-facing engine double: bucket geometry + a host-side
-    forward, so the batcher/stats layers run full speed without jax."""
-
-    def __init__(self, num_classes: int = 4):
-        import numpy as np
-
-        self._np = np
-        self.buckets = (2, 4)
-        self.num_classes = num_classes
-
-    def bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if b >= n:
-                return b
-        raise ValueError(f"batch of {n} exceeds {self.buckets[-1]}")
-
-    def predict(self, batch):
-        time.sleep(0.001)  # a forward takes time; lets flushes coalesce
-        n = next(iter(batch.values())).shape[0]
-        return self._np.zeros((n, self.num_classes), self._np.float32)
+# batcher/scheduler-facing engine double (bucket geometry + a host-side
+# forward with a small measurable service time, so flushes coalesce and
+# the stats layers run full speed without jax) — the ONE shared stub
+from pytorchvideo_accelerate_tpu.serving.stub import (  # noqa: E402
+    StubEngine as _StubEngine,
+)
 
 
 def _tiny_transform(frames, rng=None):
@@ -237,6 +224,76 @@ def _stress_batcher(watchdog, log: Callable[[str], None]) -> None:
     snap = stats.snapshot()
     log(f"[tsan] batcher churn: {int(snap['requests'])} served, "
         f"{len(errors)} submits hit the close")
+
+
+def _stress_fleet(log: Callable[[str], None]) -> None:
+    """Fleet-tier churn: two stub-engine `Scheduler` replicas behind the
+    pool + router, concurrent mixed-priority clients, a hot-swap cutover
+    racing live launches, membership flaps racing the health poller, and
+    fleet-snapshot readers racing everything — the registered
+    Scheduler/ReplicaPool/Router/LoadGen state under real interleavings."""
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        LocalReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+    from pytorchvideo_accelerate_tpu.obs.registry import Registry
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+
+    # fresh private registries: the process-default Registry predates the
+    # armed window (raw locks -> false positives by construction)
+    replicas = []
+    for i in range(2):
+        stats = ServingStats(window=64, registry=Registry())
+        sched = Scheduler(_StubEngine(), stats=stats, max_queue=64,
+                          batch_max_wait_ms=1.0, name=f"tsan-{i}")
+        replicas.append(LocalReplica(f"tsan-{i}", sched))
+    pool = ReplicaPool(replicas, health_interval_s=0.02,
+                       registry=Registry())
+    router = Router(pool, registry=Registry())
+    clip = {"video": np.zeros((2, 4, 4, 3), np.float32)}
+    served: List[str] = []
+
+    def client(k: int):
+        for i in range(8):
+            try:
+                fut = router.submit(
+                    clip, priority=("batch" if (k + i) % 3 else "realtime"))
+                if i % 2 == 0:
+                    fut.result(timeout=5.0)
+                    served.append("ok")
+            except Exception:  # noqa: BLE001 - close() races late submits
+                return
+
+    def swapper():
+        time.sleep(0.005)
+        try:  # cutover BETWEEN launches, racing the clients
+            replicas[0].scheduler.swap_engine(_StubEngine())
+        except Exception:
+            pass
+        pool.mark_down(replicas[1])  # flap membership under traffic; the
+        time.sleep(0.03)             # poller restores it (health is fine)
+
+    def snapshotter():
+        for _ in range(5):
+            router.fleet_snapshot()
+            time.sleep(0.003)
+
+    ts = [make_thread(target=client, args=(k,), name=f"fleet-client-{k}",
+                      daemon=True) for k in range(3)]
+    ts.append(make_thread(target=swapper, name="fleet-swapper", daemon=True))
+    ts.append(make_thread(target=snapshotter, name="fleet-snapshotter",
+                          daemon=True))
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10.0)
+    router.close()
+    log(f"[tsan] fleet churn: {len(served)} awaited results through a "
+        "hot-swap + membership flap")
 
 
 def _stress_trackers(log: Callable[[str], None]) -> None:
@@ -351,6 +408,7 @@ def run_stress(smoke: bool = True,
                     # live watchdog: its poll thread runs check() every
                     # 20ms concurrently with the legs' heartbeats/churn
                     _stress_batcher(wd, log)
+                    _stress_fleet(log)
                     _stress_trackers(log)
                     _stress_prefetcher(wd, log)
                 finally:

@@ -229,6 +229,39 @@ class ServeConfig:
     # drain-on-SIGTERM budget: stop accepting, flush in-flight futures,
     # then shut down (0 = no drain handler; the PR-3 dump-only behavior)
     drain_grace_s: float = 10.0
+    # batching front on the hot path (fleet/scheduler.py): "edf" (default)
+    # is the continuous-batching scheduler — per-request deadlines,
+    # realtime/batch priority classes, earliest-deadline-first launches,
+    # shed-before-deadline-miss with 503 + Retry-After; "micro" restores
+    # the fixed launch-on-max-or-timeout MicroBatcher policy
+    scheduler: str = "edf"
+    # default deadlines per priority class (explicit per-request
+    # deadline_ms in the /predict body overrides); a request that provably
+    # cannot meet its deadline is shed instead of riding to a 504
+    realtime_deadline_ms: float = 2000.0
+    batch_deadline_ms: float = 10000.0
+
+
+@dataclass
+class FleetConfig:
+    """Replica-pool serving tier (fleet/): router, health gating, hot-swap,
+    load harness defaults (docs/SERVING.md § fleet). `serve.*` configures
+    ONE replica; `fleet.*` configures the tier around N of them."""
+
+    # replicas the bench fleet lane / CI harnesses stand up (production
+    # fleets register real processes with the pool instead)
+    replicas: int = 2
+    # health-poll cadence for pool membership; route-around on an observed
+    # death is immediate, this bounds how fast a DEAD-but-silent replica
+    # leaves the rotation (and how fast a recovered one rejoins)
+    health_interval_s: float = 0.5
+    # per-request re-dispatch budget after a replica dies mid-flight
+    route_retries: int = 2
+    # open-loop load-harness defaults (fleet/loadgen.py, pva-tpu-loadgen)
+    loadgen_rps: float = 50.0
+    loadgen_duration_s: float = 5.0
+    # the SLO the SERVE_FLEET bench lane asserts (p99 over completions)
+    slo_p99_ms: float = 1500.0
 
 
 @dataclass
@@ -306,6 +339,7 @@ class TrainConfig:
     checkpoint: CheckpointConfig = field(default_factory=CheckpointConfig)
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
+    fleet: FleetConfig = field(default_factory=FleetConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
 

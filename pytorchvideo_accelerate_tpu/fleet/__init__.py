@@ -1,0 +1,49 @@
+"""Fleet serving tier: replica pool + router over the serving subsystem.
+
+serving/ turns ONE checkpoint into ONE endpoint; fleet/ turns N of those
+into a production tier (ROADMAP item 2; the decoupled-workers-behind-
+queues shape of the Podracer architecture, PAPERS.md):
+
+- `scheduler.Scheduler` — continuous-batching replacement for the
+  `MicroBatcher` flush policy: per-request deadlines, `realtime`/`batch`
+  priority classes, earliest-deadline-first launches into the engine's
+  compiled buckets, shed-before-deadline-miss (`ShedError` → PR 6's
+  503 + Retry-After);
+- `pool.ReplicaPool` — N replicas (in-process `LocalReplica` engines on
+  disjoint meshes, or `HttpReplica` processes) with health-gated
+  membership driven by each replica's `/healthz` admission state;
+- `router.Router` — least-outstanding-requests routing, replica-shed
+  failover, route-around on replica death (mid-flight requests
+  re-dispatched), per-replica registry labels, fleet-merged stats;
+- `hotswap.hot_swap` — zero-downtime blue/green checkpoint swap with
+  compiled-cache pre-warm and a measured `swap_blackout_ms`;
+- `loadgen.LoadGen` + `pva-tpu-loadgen` — open-loop Poisson load harness
+  with a heavy-tailed clip-size mix and SLO verdicts.
+
+The router speaks the `MicroBatcher` interface, so `InferenceServer` (and
+the whole admission/drain/Retry-After vocabulary) fronts a fleet
+unchanged. See docs/SERVING.md § fleet.
+"""
+
+from pytorchvideo_accelerate_tpu.fleet.hotswap import (  # noqa: F401
+    hot_swap,
+    swap_replica,
+)
+from pytorchvideo_accelerate_tpu.fleet.loadgen import (  # noqa: F401
+    LoadGen,
+    heavy_tail_clip_factory,
+)
+from pytorchvideo_accelerate_tpu.fleet.pool import (  # noqa: F401
+    HttpReplica,
+    LocalReplica,
+    ReplicaDeadError,
+    ReplicaPool,
+    spawn_serving_process,
+)
+from pytorchvideo_accelerate_tpu.fleet.router import Router  # noqa: F401
+from pytorchvideo_accelerate_tpu.fleet.scheduler import (  # noqa: F401
+    BATCH,
+    REALTIME,
+    Scheduler,
+    ShedError,
+)

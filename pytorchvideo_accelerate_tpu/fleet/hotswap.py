@@ -1,0 +1,94 @@
+"""Zero-downtime checkpoint hot-swap: blue/green per replica.
+
+The production question this answers: a new checkpoint lands (a nightly
+fine-tune, a rollback) — how do the serving replicas pick it up without
+dropping requests or serving a single mixed-weights answer?
+
+The sequence, per replica (fleet-wide it runs replica-by-replica, so the
+router keeps the rest of the fleet serving — zero downtime end to end):
+
+1. **green build**: restore the artifact into a FRESH `InferenceEngine` on
+   the replica's own mesh (`InferenceEngine.from_artifact`), sharing the
+   replica's `ServingStats` so the latency window survives the swap;
+2. **pre-warm**: compile the green engine for EVERY (bucket, geometry) the
+   blue engine has ever served (`compiled_keys` is exactly that set) —
+   cutover must never turn first requests into multi-second compile
+   stalls;
+3. **drain-then-swap**: `Scheduler.swap_engine` installs green BETWEEN
+   launches — it blocks on the launch lock until the in-flight launch
+   finishes, so no launch (and therefore no future) ever sees mixed
+   weights. The time it blocks is the measured `swap_blackout_ms`: with
+   pre-warm done, it is bounded by one launch's service time.
+
+Bucket ladders must match (same config → same `serve.max_batch_size` and
+shard count); a swap that would change them is refused — queued padding
+plans assume stable buckets, and that shape change is a restart, not a
+swap. See docs/SERVING.md § hot-swap runbook.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+
+def prewarm_like(green, blue) -> int:
+    """Compile `green` for every geometry `blue` has served; returns the
+    number of compiled keys. Runs while blue is still serving — compiles
+    happen on the caller's thread, launches keep flowing."""
+    n = 0
+    for key in blue.compiled_keys:
+        batch = {name: np.zeros(shape, green.input_dtype)
+                 for name, shape in key}
+        green.predict(batch)
+        n += 1
+    return n
+
+
+def swap_replica(replica, green, *, prewarm: bool = True) -> float:
+    """Blue/green cutover for ONE replica; returns blackout in seconds."""
+    blue = replica.scheduler.current_engine()
+    if prewarm:
+        n = prewarm_like(green, blue)
+        logger.info("hot-swap %s: pre-warmed %d compiled keys",
+                    replica.name, n)
+    return replica.scheduler.swap_engine(green)
+
+
+def hot_swap(replicas: List, artifact: str, *,
+             max_batch_size: Optional[int] = None,
+             prewarm: bool = True) -> Dict[str, float]:
+    """Swap every replica in `replicas` onto the checkpoint at `artifact`,
+    one at a time (the rest of the fleet keeps serving). Returns
+    ``{"swap_blackout_ms": worst-case, "swap_total_s": wall,
+    "per_replica_ms": {...}}``."""
+    from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
+
+    t0 = time.perf_counter()
+    per: Dict[str, float] = {}
+    for replica in replicas:
+        blue = replica.scheduler.current_engine()
+        green = InferenceEngine.from_artifact(
+            artifact, mesh=blue.mesh,
+            max_batch_size=(max_batch_size if max_batch_size is not None
+                            else blue.buckets[-1]),
+            stats=replica.stats)
+        blackout = swap_replica(replica, green, prewarm=prewarm)
+        per[replica.name] = round(blackout * 1e3, 3)
+        logger.info("hot-swap %s: cutover blackout %.2f ms",
+                    replica.name, blackout * 1e3)
+    out = {
+        "swap_blackout_ms": max(per.values()) if per else 0.0,
+        "swap_total_s": round(time.perf_counter() - t0, 3),
+        "per_replica_ms": per,
+    }
+    obs.get_recorder().record("fleet", "hot-swap-complete", **{
+        k: v for k, v in out.items() if k != "per_replica_ms"})
+    return out

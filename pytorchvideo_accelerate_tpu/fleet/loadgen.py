@@ -1,0 +1,326 @@
+"""Open-loop load harness + the `pva-tpu-loadgen` CLI.
+
+The honesty this module exists for: a CLOSED-loop client (submit, wait,
+submit) measures the server at whatever rate the server allows — when the
+server slows down, the client politely slows down with it, and the p99 you
+report is fiction ("coordinated omission"). Production traffic does not
+wait: arrivals keep coming at the offered rate while the queue grows. So
+this harness is OPEN-loop by construction:
+
+- arrivals are a seeded **Poisson process** at ``rate_rps`` (exponential
+  inter-arrival gaps), scheduled against the wall clock — a submission is
+  never delayed because an earlier request is still in flight;
+- if the generator itself falls behind the schedule (a submit blocked, the
+  host stalled), it says so: ``max_arrival_lag_ms`` reports how late the
+  worst arrival fired and ``open_loop_ok`` is False past a tolerance —
+  numbers from a degraded-to-closed-loop run must not read as open-loop;
+- request sizes follow a **heavy-tailed clip mix**: mostly single-view
+  clips with a tail of multi-view requests (the serving tier's free
+  geometry axis), weights configurable — fleets die on their tail
+  geometry, not their median.
+
+Completion futures resolve off the arrival thread; the report classifies
+every request exactly once: completed (latency percentiles computed over
+these), **shed** (the 503 family — `QueueFullError`/`ShedError`, admission
+or deadline sheds doing their job), or **failed** (everything else — the
+number a healthy fleet keeps at zero). SLO verdicts (`slo_p99_ms`) are
+asserted over completions.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
+from pytorchvideo_accelerate_tpu.serving.stats import _percentile
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
+
+logger = get_logger("pva_tpu")
+
+# the heavy-tail view mix: (views, weight) — most requests are one clip,
+# a tail re-runs the multi-view eval protocol per request
+DEFAULT_VIEW_MIX = ((1, 0.85), (2, 0.12), (4, 0.03))
+
+# arrivals later than this against their schedule mean the generator
+# degraded toward closed-loop; the report flags it instead of hiding it
+OPEN_LOOP_LAG_TOLERANCE_MS = 250.0
+
+
+def heavy_tail_clip_factory(base_clip: Dict[str, np.ndarray],
+                            view_mix: Sequence = DEFAULT_VIEW_MIX
+                            ) -> Callable:
+    """Clip factory over one base geometry: returns `factory(rng) -> clip`
+    drawing a view count from the heavy-tailed mix (views=1 keeps the bare
+    rank-4 clip; V>1 stacks the clip into a (V, T, H, W, C) request)."""
+    views = np.asarray([v for v, _ in view_mix], np.int64)
+    weights = np.asarray([w for _, w in view_mix], np.float64)
+    weights = weights / weights.sum()
+
+    def factory(rng: np.random.Generator) -> Dict[str, np.ndarray]:
+        v = int(rng.choice(views, p=weights))
+        if v <= 1:
+            return dict(base_clip)
+        return {k: np.stack([arr] * v) for k, arr in base_clip.items()}
+
+    return factory
+
+
+@shared_state("_done")
+class LoadGen:
+    """One open-loop run against any `submit(clip, **kw) -> Future` front
+    (a `Scheduler`, a `Router`, an `HttpReplica`)."""
+
+    def __init__(self, submit, *, rate_rps: float, duration_s: float,
+                 clip_factory: Callable, seed: int = 0,
+                 priority: Optional[str] = None,
+                 deadline_ms: Optional[float] = None,
+                 grace_s: float = 15.0):
+        if rate_rps <= 0 or duration_s <= 0:
+            raise ValueError("rate_rps and duration_s must be positive")
+        self.submit = submit
+        self.rate_rps = float(rate_rps)
+        self.duration_s = float(duration_s)
+        self.clip_factory = clip_factory
+        self.seed = int(seed)
+        self.priority = priority
+        self.deadline_ms = deadline_ms
+        self.grace_s = float(grace_s)
+        self._lock = make_lock("LoadGen._lock")
+        # (outcome, latency_s) per finished request; outcomes:
+        # "ok" | "shed" | "failed"
+        self._done: List = []
+
+    def _record(self, outcome: str, latency_s: float) -> None:
+        with self._lock:
+            self._done.append((outcome, latency_s))
+
+    def _on_done(self, t_submit: float, future) -> None:
+        latency = time.monotonic() - t_submit
+        err = None
+        try:
+            err = future.exception()
+        except Exception as e:  # cancelled
+            err = e
+        if err is None:
+            self._record("ok", latency)
+        elif isinstance(err, QueueFullError):
+            self._record("shed", latency)
+        else:
+            self._record("failed", latency)
+
+    def run(self) -> Dict[str, float]:
+        """Blocking: generate the arrival schedule, fire it, wait out the
+        stragglers (bounded by `grace_s`), return the report dict."""
+        rng = np.random.default_rng(self.seed)
+        gaps = rng.exponential(1.0 / self.rate_rps,
+                               size=max(int(self.rate_rps
+                                            * self.duration_s * 2), 16))
+        arrivals = np.cumsum(gaps)
+        arrivals = arrivals[arrivals < self.duration_s]
+        kwargs: dict = {}
+        if self.priority is not None:
+            kwargs["priority"] = self.priority
+        if self.deadline_ms is not None:
+            kwargs["deadline_ms"] = self.deadline_ms
+        offered = 0
+        max_lag = 0.0
+        t0 = time.monotonic()
+        for t_arr in arrivals:
+            now = time.monotonic() - t0
+            if now < t_arr:
+                time.sleep(t_arr - now)
+            lag = (time.monotonic() - t0) - t_arr
+            max_lag = max(max_lag, lag)
+            clip = self.clip_factory(rng)
+            offered += 1
+            t_submit = time.monotonic()
+            try:
+                fut = self.submit(clip, **kwargs)
+            except QueueFullError:
+                self._record("shed", 0.0)
+                continue
+            except Exception:  # noqa: BLE001 - a dead front is a failure
+                self._record("failed", 0.0)
+                continue
+            fut.add_done_callback(
+                lambda f, t=t_submit: self._on_done(t, f))
+        wall = time.monotonic() - t0
+        # open loop ends at the schedule; stragglers get a bounded grace
+        grace_deadline = time.monotonic() + self.grace_s
+        while time.monotonic() < grace_deadline:
+            with self._lock:
+                if len(self._done) >= offered:
+                    break
+            time.sleep(0.01)
+        with self._lock:
+            done = list(self._done)
+        lat_ok = sorted(lat for oc, lat in done if oc == "ok")
+        completed = len(lat_ok)
+        shed = sum(1 for oc, _ in done if oc == "shed")
+        failed = sum(1 for oc, _ in done if oc == "failed")
+        lost = offered - len(done)  # never resolved within grace
+        report = {
+            "offered": float(offered),
+            "offered_rps": round(offered / wall, 3) if wall > 0 else 0.0,
+            "completed": float(completed),
+            "achieved_rps": round(completed / wall, 3) if wall > 0 else 0.0,
+            "shed": float(shed),
+            "failed": float(failed + lost),
+            "shed_frac": round(shed / offered, 4) if offered else 0.0,
+            "p50_ms": round(_percentile(lat_ok, 50) * 1e3, 3),
+            "p95_ms": round(_percentile(lat_ok, 95) * 1e3, 3),
+            "p99_ms": round(_percentile(lat_ok, 99) * 1e3, 3),
+            "max_arrival_lag_ms": round(max_lag * 1e3, 3),
+            "open_loop_ok": bool(max_lag * 1e3
+                                 <= OPEN_LOOP_LAG_TOLERANCE_MS),
+            "duration_s": round(wall, 3),
+        }
+        return report
+
+
+def assert_slo(report: Dict[str, float], *, slo_p99_ms: float,
+               max_shed_frac: float = 1.0) -> List[str]:
+    """SLO verdicts as a list of violations (empty = pass): p99 under the
+    SLO, zero non-shed failures, the run genuinely open-loop, and (when
+    bounded) the shed fraction under its budget."""
+    violations = []
+    if report["completed"] <= 0:
+        violations.append("no requests completed")
+    if report["p99_ms"] > slo_p99_ms:
+        violations.append(
+            f"p99 {report['p99_ms']} ms > SLO {slo_p99_ms} ms")
+    if report["failed"] > 0:
+        violations.append(f"{int(report['failed'])} non-shed failures")
+    if not report["open_loop_ok"]:
+        violations.append(
+            f"arrival schedule slipped {report['max_arrival_lag_ms']} ms "
+            "(degraded toward closed-loop; numbers untrustworthy)")
+    if report["shed_frac"] > max_shed_frac:
+        violations.append(
+            f"shed_frac {report['shed_frac']} > budget {max_shed_frac}")
+    return violations
+
+
+def _http_clip_factory(url: str) -> Callable:
+    """Build the clip factory from a live server's /healthz clip spec."""
+    import urllib.request
+
+    with urllib.request.urlopen(url.rstrip("/") + "/healthz",
+                                timeout=10) as r:
+        health = json.loads(r.read())
+    spec = health.get("clip_spec")
+    if not spec:
+        raise SystemExit(
+            "server reports no clip_spec on /healthz; pass explicit "
+            "--frames/--crop geometry")
+    dtype = health.get("input_dtype", "float32")
+    base = {k: np.zeros(tuple(shape), dtype) for k, shape in spec.items()}
+    return heavy_tail_clip_factory(base)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """`pva-tpu-loadgen --url http://host:port --rps 100 --duration 10`.
+
+    Drives a serving endpoint (single replica or a fleet router front)
+    with the open-loop harness and prints ONE JSON report line; exit 0
+    iff the SLO held (p99 under --slo_p99_ms, zero non-shed failures,
+    schedule kept)."""
+    ap = argparse.ArgumentParser(
+        prog="pva-tpu-loadgen",
+        description="open-loop (Poisson) load harness for the serving "
+                    "tier; see docs/SERVING.md § load harness")
+    ap.add_argument("--url", default="",
+                    help="endpoint base URL (e.g. http://127.0.0.1:8100)")
+    ap.add_argument("--rps", type=float, default=50.0,
+                    help="offered arrival rate (Poisson)")
+    ap.add_argument("--duration", type=float, default=10.0,
+                    help="arrival window seconds")
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--priority", choices=("realtime", "batch"),
+                    default=None,
+                    help="priority class (schedulers that support it)")
+    ap.add_argument("--deadline_ms", type=float, default=None)
+    ap.add_argument("--slo_p99_ms", type=float, default=1000.0)
+    ap.add_argument("--max_shed_frac", type=float, default=1.0)
+    ap.add_argument("--selftest", action="store_true",
+                    help="drive an in-process stub fleet instead of --url "
+                         "(harness plumbing check, no jax model)")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    if args.selftest:
+        report = _selftest(args)
+    else:
+        if not args.url:
+            print("pva-tpu-loadgen: --url required (or --selftest)",
+                  file=sys.stderr)
+            return 2
+        from pytorchvideo_accelerate_tpu.fleet.pool import HttpReplica
+
+        # worker pool sized for the OFFERED concurrency (Little's law at
+        # the SLO latency, with headroom): the default 8 workers would
+        # silently cap in-flight requests and degrade the harness to
+        # closed-loop-of-8 without ever tripping the arrival-lag check
+        workers = max(16, min(int(args.rps * max(args.slo_p99_ms, 1000.0)
+                                  / 1e3 * 2), 256))
+        replica = HttpReplica("target", args.url, workers=workers)
+        gen = LoadGen(replica.submit, rate_rps=args.rps,
+                      duration_s=args.duration,
+                      clip_factory=_http_clip_factory(args.url),
+                      seed=args.seed, priority=args.priority,
+                      deadline_ms=args.deadline_ms)
+        report = gen.run()
+        replica.close()
+    violations = assert_slo(report, slo_p99_ms=args.slo_p99_ms,
+                            max_shed_frac=args.max_shed_frac)
+    report["slo_ok"] = not violations
+    for v in violations:
+        print(f"SLO VIOLATION: {v}", file=sys.stderr)
+    print(json.dumps(report))
+    return 0 if not violations else 1
+
+
+def _selftest(args) -> Dict[str, float]:
+    """In-process harness check: 2 stub replicas behind a router."""
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        LocalReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+    from pytorchvideo_accelerate_tpu.serving.stub import StubEngine
+
+    replicas = []
+    for i in range(2):
+        stats = ServingStats(window=512)
+        sched = Scheduler(StubEngine(forward_s=0.002), stats=stats,
+                          max_queue=256, name=f"selftest-{i}")
+        replicas.append(LocalReplica(f"selftest-{i}", sched))
+    pool = ReplicaPool(replicas, health_interval_s=0.2)
+    router = Router(pool)
+    base = {"video": np.zeros((2, 4, 4, 3), np.float32)}
+    try:
+        gen = LoadGen(router.submit, rate_rps=args.rps,
+                      duration_s=min(args.duration, 5.0),
+                      clip_factory=heavy_tail_clip_factory(base),
+                      seed=args.seed, priority=args.priority,
+                      deadline_ms=args.deadline_ms)
+        return gen.run()
+    finally:
+        router.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,406 @@
+"""Continuous-batching scheduler: deadlines, priority classes, EDF launches.
+
+Replaces the `MicroBatcher`'s fixed launch-on-max-or-timeout policy on the
+serving hot path (docs/SERVING.md § fleet). The batcher's policy is the
+right shape for one steady traffic class; a production tier serves a MIX —
+interactive requests that want the next launch and bulk scoring that wants
+full buckets — and the two must not price each other's latency. The
+scheduler's policy, per launch:
+
+- every pending request carries an absolute **deadline** (from its
+  priority class's default or an explicit ``deadline_ms``) and a
+  **priority class**: ``realtime`` (launch-now, work-conserving) or
+  ``batch`` (coalesce toward full buckets until ``batch_max_wait_ms`` or
+  deadline pressure);
+- the next launch is chosen **earliest-deadline-first** (realtime class
+  strictly before batch class), then filled with same-geometry pending
+  requests in EDF order up to the largest compiled bucket — continuous
+  batching: the engine never idles while compatible work is queued, and
+  arrivals keep joining the next launch while the current one runs;
+- **shed-before-deadline-miss**: a request whose deadline has passed — or
+  whose remaining slack is provably smaller than the measured service time
+  (per-bucket EWMA) — fails *immediately* with `ShedError`, a
+  `QueueFullError` subclass, so the HTTP front answers PR 6's
+  ``503 + Retry-After`` instead of burning a launch on an answer the
+  client will 504 before reading.
+
+Same interface as `MicroBatcher` (`submit`/`queue_depth`/`drain`/`close`),
+so `InferenceServer` and the fleet `Router` front either one; padding and
+the masked-row convention are identical (padded rows never resolve into a
+response). A single flush thread serializes launches per engine — the
+accelerator executes one batch at a time anyway — and `swap_engine` slots
+a pre-warmed replacement engine in BETWEEN launches (the hot-swap cutover,
+fleet/hotswap.py): an in-flight launch always runs start-to-finish on one
+engine, so no future can ever see mixed weights.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from concurrent.futures import Future
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
+from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
+from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, clip_key
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+from pytorchvideo_accelerate_tpu.utils.sync import (
+    make_condition,
+    make_lock,
+    make_thread,
+    shared_state,
+)
+
+logger = get_logger("pva_tpu")
+
+REALTIME, BATCH = "realtime", "batch"
+PRIORITIES = (REALTIME, BATCH)
+
+# EWMA smoothing for the per-bucket service-time estimate the shed decision
+# reads: heavy enough to ride out one slow launch, light enough to track a
+# hot-swap's warm-up transient within a few launches
+_SVC_ALPHA = 0.3
+
+
+class ShedError(QueueFullError):
+    """Request shed by the scheduler before a guaranteed deadline miss.
+
+    Subclasses `QueueFullError` so every existing 503-mapping site
+    (serving/server.py, the chaos serve leg, client retry loops) treats a
+    deadline shed exactly like an admission shed: ``503 + Retry-After``,
+    never a 504 after the budget burned."""
+
+
+@dataclass
+class _SchedRequest:
+    clip: Dict[str, np.ndarray]
+    future: Future
+    t_enqueue: float
+    deadline: float  # absolute time.monotonic()
+    priority: str
+    key: tuple  # clip geometry: only same-shaped requests share a launch
+    seq: int = 0
+
+    def rank(self) -> Tuple[int, float, int]:
+        """EDF order, realtime class strictly first; seq breaks ties FIFO."""
+        return (0 if self.priority == REALTIME else 1, self.deadline, self.seq)
+
+
+@shared_state("_pending", "_svc", "engine")
+class Scheduler:
+    """Continuous-batching EDF scheduler over one `InferenceEngine`.
+
+    Thread-safety: `_pending` and `_svc` live under `_lock` (the condition's
+    mutex); `engine` lives under `_launch_lock`, which is held for exactly
+    one launch at a time — `swap_engine` blocks on it, which IS the
+    drain-then-swap sequencing (and its hold time is the measured swap
+    blackout). The two locks are never nested, so no ordering can invert.
+    """
+
+    # the HTTP front forwards per-request priority/deadline only to fronts
+    # that declare support (a plain MicroBatcher ignores both by design)
+    supports_priority = True
+
+    def __init__(self, engine, *, max_queue: int = 256, stats=None,
+                 heartbeat=None, realtime_deadline_ms: float = 500.0,
+                 batch_deadline_ms: float = 5000.0,
+                 batch_max_wait_ms: float = 20.0,
+                 shed_safety: float = 1.2, retry_after_s: float = 1.0,
+                 name: str = "scheduler"):
+        self.engine = engine
+        self.name = name
+        self.stats = stats
+        self.max_queue = max(int(max_queue), 1)
+        self.retry_after_s = float(retry_after_s)
+        self.batch_max_wait_s = max(batch_max_wait_ms, 0.0) / 1e3
+        self.shed_safety = max(float(shed_safety), 1.0)
+        self._default_deadline_s = {
+            REALTIME: max(realtime_deadline_ms, 1.0) / 1e3,
+            BATCH: max(batch_deadline_ms, 1.0) / 1e3,
+        }
+        # bucket geometry is cached as immutables: the launch loop must
+        # never reach through `engine` while holding `_lock` (that would
+        # nest the two locks), and `swap_engine` asserts the replacement
+        # keeps the identical buckets — so these can never go stale
+        self._buckets: Tuple[int, ...] = tuple(engine.buckets)
+        self._cap = self._buckets[-1]
+        self._heartbeat = heartbeat
+        self._lock = make_lock("Scheduler._lock")
+        self._cond = make_condition("Scheduler._cond", lock=self._lock)
+        self._launch_lock = make_lock("Scheduler._launch_lock")
+        self._pending: List[_SchedRequest] = []
+        self._svc: Dict[int, float] = {}  # bucket -> EWMA service seconds
+        self._seq = 0
+        self._closed = threading.Event()
+        self._thread = make_thread(
+            target=self._loop, name=f"pva-fleet-{name}", daemon=True)
+        self._thread.start()
+
+    # --- client side ------------------------------------------------------
+
+    def submit(self, clip: Dict[str, np.ndarray], *,
+               priority: str = REALTIME,
+               deadline_ms: Optional[float] = None) -> Future:
+        """Enqueue ONE clip — leaves (T, H, W, C) or (V, T, H, W, C) — and
+        get a Future resolving to its fp32 logits (num_classes,). A missed
+        queue bound or an unmeetable deadline resolves the future (or
+        raises here) with a `QueueFullError`/`ShedError` → 503."""
+        if priority not in PRIORITIES:
+            raise ValueError(
+                f"priority must be one of {PRIORITIES}, got {priority!r}")
+        clips = {k: np.asarray(v) for k, v in clip.items() if k in CLIP_KEYS}
+        if not clips:
+            raise ValueError("request has neither 'video' nor 'slow'/'fast'")
+        for k, v in clips.items():
+            if v.ndim not in (4, 5):
+                raise ValueError(
+                    f"clip {k!r} must be (T,H,W,C) or (V,T,H,W,C), "
+                    f"got shape {v.shape}")
+        if self._closed.is_set():
+            raise RuntimeError("scheduler is closed")
+        now = time.monotonic()
+        ttl = (self._default_deadline_s[priority]
+               if deadline_ms is None else max(float(deadline_ms), 1.0) / 1e3)
+        req = _SchedRequest(clip=clips, future=Future(), t_enqueue=now,
+                            deadline=now + ttl, priority=priority,
+                            key=clip_key(clips))
+        with self._lock:
+            if self._closed.is_set():
+                raise RuntimeError("scheduler is closed")
+            if len(self._pending) >= self.max_queue:
+                if self.stats is not None:
+                    self.stats.observe_rejected("503")
+                raise QueueFullError(
+                    f"scheduler queue full ({self.max_queue}); retry later",
+                    retry_after_s=self.retry_after_s)
+            self._seq += 1
+            req.seq = self._seq
+            self._pending.append(req)
+            self._cond.notify()
+        return req.future
+
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def drain(self, timeout_s: float = 10.0) -> bool:
+        """Wait for the pending queue to flush (the drain-on-SIGTERM path:
+        stop ADMITTING upstream first, then let in-flight futures resolve)."""
+        deadline = time.monotonic() + max(timeout_s, 0.0)
+        while time.monotonic() < deadline:
+            if self.queue_depth() == 0:
+                return True
+            time.sleep(0.01)
+        return self.queue_depth() == 0
+
+    def close(self) -> None:
+        """Stop the flush thread; pending requests are failed, not dropped
+        silently."""
+        if self._closed.is_set():
+            return
+        self._closed.set()
+        with self._lock:
+            self._cond.notify_all()
+        self._thread.join(timeout=30.0)
+        with self._lock:
+            leftovers, self._pending = self._pending, []
+        for req in leftovers:
+            if not req.future.done():
+                try:
+                    req.future.set_exception(
+                        RuntimeError("scheduler closed"))
+                except Exception:  # lost the race to the flush thread
+                    pass
+
+    # --- hot-swap cutover -------------------------------------------------
+
+    def current_engine(self):
+        """The engine the NEXT launch will use (hot-swap tooling reads the
+        blue engine's mesh/geometry through here, never via a bare attr)."""
+        with self._launch_lock:
+            return self.engine
+
+    def swap_engine(self, new_engine) -> float:
+        """Blue/green cutover: install `new_engine` between launches and
+        return the blackout in SECONDS (time this replica could not launch:
+        waiting out the in-flight launch + the pointer swap).
+
+        The caller pre-warms `new_engine` (compiles every bucket) BEFORE
+        calling — see fleet/hotswap.py; a cold engine would turn the first
+        post-swap launches into compile stalls. Bucket geometry must match:
+        the scheduler's cached bucket ladder (and every queued request's
+        padding plan) is built against it."""
+        if tuple(new_engine.buckets) != self._buckets:
+            raise ValueError(
+                f"hot-swap changes the bucket ladder {self._buckets} -> "
+                f"{tuple(new_engine.buckets)}; restart the replica instead "
+                "(in-flight padding plans assume stable buckets)")
+        t0 = time.perf_counter()
+        with self._launch_lock:
+            self.engine = new_engine
+        blackout = time.perf_counter() - t0
+        obs.get_recorder().record("fleet", "hot-swap", scheduler=self.name,
+                                  blackout_ms=round(blackout * 1e3, 3))
+        return blackout
+
+    # --- flush thread -----------------------------------------------------
+
+    def _bucket_for(self, n: int) -> int:
+        for b in self._buckets:
+            if b >= n:
+                return b
+        return self._buckets[-1]
+
+    def _loop(self) -> None:
+        while True:
+            if self._heartbeat is not None:
+                self._heartbeat()
+            with self._lock:
+                if self._closed.is_set():
+                    break
+                now = time.monotonic()
+                shed = self._reap(now)
+                group = self._collect(now)
+                if group is None and not shed:
+                    self._cond.wait(timeout=self._wait_s(now))
+                    continue
+            # futures resolve OUTSIDE _lock (sheds here, results in
+            # _launch): a done-callback may legally re-enter submit()/
+            # queue_depth(), which would deadlock on the non-reentrant
+            # lock — and launches run unlocked so arrivals keep queueing
+            # into the next launch (the continuous-batching property)
+            for req, err in shed:
+                try:
+                    req.future.set_exception(err)
+                except Exception:
+                    pass
+                if self.stats is not None:
+                    self.stats.observe_shed("deadline")
+            if group is not None:
+                with obs.span("serve_flush"):
+                    self._launch(group)
+
+    def _estimate_s(self, bucket: int) -> float:
+        """Measured service time for `bucket`, falling back to the nearest
+        known bucket (0.0 until the first launch lands — no shedding on
+        guesses)."""
+        if bucket in self._svc:
+            return self._svc[bucket]
+        known = sorted(self._svc)
+        for b in known:
+            if b >= bucket:
+                return self._svc[b]
+        return self._svc[known[-1]] if known else 0.0
+
+    def _reap(self, now: float) -> List[tuple]:
+        """Caller holds `_lock`. Drop cancelled/done requests and pull out
+        every request that can no longer meet its deadline; returns the
+        [(request, ShedError)] list for the CALLER to resolve after
+        releasing the lock — resolving here would run arbitrary
+        done-callbacks under the scheduler's own mutex."""
+        keep: List[_SchedRequest] = []
+        shed: List[tuple] = []
+        for req in self._pending:
+            if req.future.done():
+                continue  # cancelled by the HTTP front's timeout path
+            est = self._estimate_s(self._bucket_for(1)) * self.shed_safety
+            if req.deadline - now <= est:
+                # shed-before-deadline-miss: a late answer is wasted work
+                # AND wasted engine time that delays every request behind it
+                shed.append((req, ShedError(
+                    f"deadline unmeetable (slack "
+                    f"{max(req.deadline - now, 0) * 1e3:.1f} ms < "
+                    f"est service {est * 1e3:.1f} ms); retry later",
+                    retry_after_s=self.retry_after_s)))
+                continue
+            keep.append(req)
+        self._pending = keep  # pva: disable=lock-discipline -- _reap is called only from _loop's `with self._lock` block (caller-holds-lock contract in the docstring)
+        return shed
+
+    def _collect(self, now: float) -> Optional[List[_SchedRequest]]:
+        """Caller holds `_lock`. Pick the next launch (EDF head + its
+        same-geometry cohort) or None when coalescing should continue."""
+        if not self._pending:
+            return None
+        head = min(self._pending, key=_SchedRequest.rank)
+        group = sorted((r for r in self._pending if r.key == head.key),
+                       key=_SchedRequest.rank)[:self._cap]
+        est = self._estimate_s(self._bucket_for(len(group)))
+        launch_now = (
+            head.priority == REALTIME            # work-conserving class
+            or len(group) >= self._cap           # a full largest bucket
+            or now - head.t_enqueue >= self.batch_max_wait_s
+            or head.deadline - now <= est * self.shed_safety * 2.0)
+        if not launch_now:
+            return None
+        launched = set(id(r) for r in group)
+        self._pending = [r for r in self._pending if id(r) not in launched]  # pva: disable=lock-discipline -- _collect is called only from _loop's `with self._lock` block (caller-holds-lock contract in the docstring)
+        return group
+
+    def _wait_s(self, now: float) -> float:
+        """Caller holds `_lock`: sleep until the earliest trigger (batch
+        coalescing deadline or request deadline), bounded for heartbeats."""
+        w = 0.1
+        for req in self._pending:
+            w = min(w, req.t_enqueue + self.batch_max_wait_s - now,
+                    max(req.deadline - now, 0.0))
+        return max(w, 0.001)
+
+    def _launch(self, reqs: List[_SchedRequest]) -> None:
+        # chaos hook: same fault point as the MicroBatcher flush — the
+        # scheduler replaces it on the hot path, and an injected raise must
+        # fail THIS launch's futures (the 500 path), never the thread
+        try:
+            fault_point("serve.flush")
+            reqs = [r for r in reqs
+                    if r.future.set_running_or_notify_cancel()]
+            if not reqs:
+                return
+            n = len(reqs)
+            bucket = self._bucket_for(n)
+            stacked: Dict[str, np.ndarray] = {}
+            for k in reqs[0].clip:
+                rows = np.stack([r.clip[k] for r in reqs])
+                if bucket > n:  # zero rows, masked out below
+                    pad = np.zeros((bucket - n,) + rows.shape[1:],
+                                   rows.dtype)
+                    rows = np.concatenate([rows, pad], axis=0)
+                stacked[k] = rows
+            stacked["mask"] = np.asarray(
+                [1.0] * n + [0.0] * (bucket - n), np.float32)
+            t0 = time.perf_counter()
+            # one engine for the WHOLE launch: swap_engine blocks on this
+            # lock, so a cutover can never interleave with a launch
+            with self._launch_lock:
+                logits = self.engine.predict(stacked)
+            svc = time.perf_counter() - t0
+            done = time.monotonic()
+            latencies = []
+            for i, req in enumerate(reqs):
+                latencies.append(done - req.t_enqueue)
+                # padded rows sliced away here — a response only ever
+                # carries logits[i] for the request that submitted row i
+                try:
+                    req.future.set_result(logits[i])
+                except Exception:
+                    pass  # cancelled between claim and resolve
+            if self.stats is not None:
+                self.stats.observe_batch(n, bucket, latencies)
+            with self._lock:
+                prev = self._svc.get(bucket)
+                self._svc[bucket] = (svc if prev is None else
+                                     (1 - _SVC_ALPHA) * prev
+                                     + _SVC_ALPHA * svc)
+        except Exception as e:  # noqa: BLE001 - fail the requests, not the thread
+            logger.exception("fleet launch failed")
+            for req in reqs:
+                if not req.future.done():
+                    try:
+                        req.future.set_exception(e)
+                    except Exception:
+                        pass

@@ -127,36 +127,67 @@ class Counter(_Metric):
 
 
 class Gauge(_Metric):
-    """Point-in-time value; `set_function` registers a live callback read at
-    render/value time (queue depth, uptime)."""
+    """Point-in-time value, optionally labeled (the fleet router publishes
+    per-replica series: ``pva_fleet_outstanding{replica="r0"}``);
+    `set_function` registers a live callback read at render/value time
+    (queue depth, uptime). Unlabeled gauges keep the original one-sample
+    surface — `set()`/`value()` with no labels."""
 
     kind = "gauge"
 
-    def __init__(self, name: str, help: str = ""):
-        super().__init__(name, help)
-        self._value = 0.0
-        self._fn: Optional[Callable[[], float]] = None
+    def __init__(self, name: str, help: str = "", labelnames: Sequence[str] = ()):
+        super().__init__(name, help, labelnames)
+        self._values: Dict[Tuple[str, ...], float] = {}
+        self._fns: Dict[Tuple[str, ...], Callable[[], float]] = {}
 
-    def set(self, value: float) -> None:
+    def set(self, value: float, **labels: str) -> None:
+        key = self._key(labels)
         with self._lock:
-            self._value = float(value)
+            self._values[key] = float(value)
 
-    def set_function(self, fn: Callable[[], float]) -> None:
+    def set_function(self, fn: Optional[Callable[[], float]],
+                     **labels: str) -> None:
+        """Register a live read callback; `None` deregisters it (owners of
+        short-lived objects MUST clear their closure on close, or the
+        registry pins them alive and scrapes stale values forever)."""
+        key = self._key(labels)
         with self._lock:
-            self._fn = fn
-
-    def value(self) -> float:
-        with self._lock:
-            fn = self._fn
             if fn is None:
-                return self._value
-        try:
+                self._fns.pop(key, None)
+            else:
+                self._fns[key] = fn
+
+    def value(self, **labels: str) -> float:
+        key = self._key(labels)
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                return self._values.get(key, 0.0)
+        try:  # callback runs OUTSIDE the lock: it may itself take locks
             return float(fn())
         except Exception:  # a dying callback must not break the scrape
             return float("nan")
 
+    def samples(self) -> Iterable[Tuple[Dict[str, str], float]]:
+        with self._lock:
+            keys = sorted(set(self._values) | set(self._fns))
+        for key in keys:
+            labels = dict(zip(self.labelnames, key))
+            yield labels, self.value(**labels)
+
     def render(self) -> str:
-        return self.header() + f"{self.name} {_fmt(self.value())}\n"
+        with self._lock:
+            keys = sorted(set(self._values) | set(self._fns))
+        if not keys:
+            if self.labelnames:  # no label combination seen yet
+                return self.header()
+            keys = [()]  # unlabeled gauges render an explicit 0
+        lines = [self.header()]
+        for key in keys:
+            labels = dict(zip(self.labelnames, key))
+            lines.append(f"{self.name}{_label_str(self.labelnames, key)} "
+                         f"{_fmt(self.value(**labels))}\n")
+        return "".join(lines)
 
 
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
@@ -240,8 +271,9 @@ class Registry:
                 labelnames: Sequence[str] = ()) -> Counter:
         return self._get_or_create(Counter, name, help, labelnames)
 
-    def gauge(self, name: str, help: str = "") -> Gauge:
-        return self._get_or_create(Gauge, name, help)
+    def gauge(self, name: str, help: str = "",
+              labelnames: Sequence[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labelnames)
 
     def histogram(self, name: str, help: str = "",
                   buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:

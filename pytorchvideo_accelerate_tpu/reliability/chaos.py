@@ -29,7 +29,13 @@ Legs (all seeded via one `--seed`, CPU-only, replayable):
 - **serve**: synthetic overload against a micro-batcher + admission
   controller — load sheds with 503/Retry-After semantics before latency
   collapses, an injected flush fault fails one batch (not the thread),
-  and the service recovers to `healthy`, then drains clean.
+  and the service recovers to `healthy`, then drains clean;
+- **replica_kill**: two real serving processes (stub engine behind the
+  REAL `InferenceServer` + fleet `Scheduler`) behind the fleet router
+  under open-loop load; one replica is SIGKILLed mid-load — the router
+  must route around it (mid-flight requests re-dispatched, ZERO non-shed
+  failures), pool membership must drop it within the health-check
+  interval, and the surviving replica's p99 must return under the SLO.
 
 Exit codes: 0 clean, 1 findings, 2 usage.
 """
@@ -470,28 +476,10 @@ def leg_preempt_mesh(report: dict, tmpdir: str, seed: int, log: Log) -> None:
         f"step and finished at {b['steps']}")
 
 
-class _StubEngine:
-    """Bucket geometry + a host-side forward slow enough to build a queue
-    (no jax: the serving leg measures the control plane, not the chip)."""
-
-    def __init__(self, forward_s: float = 0.005):
-        import numpy as np
-
-        self._np = np
-        self.forward_s = forward_s
-        self.buckets = (2, 4)
-        self.num_classes = 4
-
-    def bucket_for(self, n: int) -> int:
-        for b in self.buckets:
-            if b >= n:
-                return b
-        raise ValueError(f"batch of {n} exceeds {self.buckets[-1]}")
-
-    def predict(self, batch):
-        time.sleep(self.forward_s)
-        n = next(iter(batch.values())).shape[0]
-        return self._np.zeros((n, self.num_classes), self._np.float32)
+# serving-control-plane engine double (bucket geometry + a host-side
+# forward slow enough to build a queue; no jax — the serving legs measure
+# the control plane, not the chip) — the shared serving/stub.py double
+from pytorchvideo_accelerate_tpu.serving.stub import StubEngine as _StubEngine  # noqa: E402
 
 
 def leg_serve(report: dict, seed: int, log: Log) -> None:
@@ -592,6 +580,161 @@ def leg_serve(report: dict, seed: int, log: Log) -> None:
         f"recovered={recovered!r}, drained={drained}")
 
 
+# subprocess body for leg_replica_kill: the shared stub engine (host-side
+# forward, no model compile) behind the REAL fleet Scheduler +
+# InferenceServer, so the leg's HTTP surface, shed mapping, and /healthz
+# state are production code. One JSON line {{"url": ...}} to stdout once
+# bound, then serve.
+_REPLICA_SRV_CODE = """
+import json
+from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+from pytorchvideo_accelerate_tpu.serving.server import InferenceServer
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+from pytorchvideo_accelerate_tpu.serving.stub import StubEngine
+
+engine = StubEngine(forward_s={forward_s})
+engine.model_name = "chaos-stub"
+stats = ServingStats(window=512)
+sched = Scheduler(engine, stats=stats, max_queue=128,
+                  realtime_deadline_ms=10000.0)
+srv = InferenceServer(engine, sched, stats, host="127.0.0.1", port=0,
+                      request_timeout_s=30.0)
+host, port = srv.address
+print(json.dumps({{"url": "http://%s:%d" % (host, port)}}), flush=True)
+srv.serve_forever(drain_on_sigterm=False)
+"""
+
+# SLO the surviving replica's post-kill probe burst must hold: the stub
+# forward is ~5 ms, so 500 ms p99 means "recovered", not "fast hardware"
+_KILL_RECOVERY_SLO_MS = 500.0
+
+
+def _read_url_line(proc, timeout_s: float = 90.0) -> str:
+    """First stdout line of a replica subprocess, with a deadline (a
+    replica that never binds must fail the leg, not hang the scenario)."""
+    box: dict = {}
+
+    def read():
+        box["line"] = proc.stdout.readline()
+
+    t = make_thread(target=read, name="chaos-replica-read", daemon=True)
+    t.start()
+    t.join(timeout=timeout_s)
+    line = box.get("line") or ""
+    if not line.strip():
+        raise RuntimeError(
+            f"replica subprocess produced no URL within {timeout_s}s "
+            f"(exit={proc.poll()})")
+    return json.loads(line)["url"]
+
+
+def leg_replica_kill(report: dict, seed: int, log: Log) -> None:
+    """SIGKILL one of two serving processes mid-load: the fleet router
+    routes around it with zero non-shed failures, membership drops it
+    within the health interval, and recovered p99 holds the SLO."""
+    import signal as _signal
+    import subprocess
+
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.fleet.loadgen import (
+        LoadGen,
+        heavy_tail_clip_factory,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        HttpReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+
+    leg = _leg(report, "replica_kill")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    procs: List[subprocess.Popen] = []
+    router = None
+    health_interval_s = 0.25
+    try:
+        for _ in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 _REPLICA_SRV_CODE.format(forward_s=0.005)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True))
+        replicas = [HttpReplica(f"kill-{i}", _read_url_line(p),
+                                pid=p.pid, timeout_s=20.0)
+                    for i, p in enumerate(procs)]
+        pool = ReplicaPool(replicas, health_interval_s=health_interval_s)
+        router = Router(pool, retries=3)
+
+        clip = {"video": np.zeros((2, 4, 4, 3), np.float32)}
+        kill_at: dict = {}
+
+        def killer():
+            time.sleep(0.8)  # mid-load, by construction
+            kill_at["t"] = time.monotonic()
+            os.kill(procs[0].pid, _signal.SIGKILL)
+
+        kt = make_thread(target=killer, name="chaos-replica-kill",
+                         daemon=True)
+        kt.start()
+        load = LoadGen(router.submit, rate_rps=40.0, duration_s=2.5,
+                       clip_factory=heavy_tail_clip_factory(clip),
+                       seed=seed).run()
+        kt.join(timeout=5.0)
+        # membership: the dead replica must leave the routable set within
+        # (about) one health interval of the kill — route-around on the
+        # request path is immediate, this checks the poller's verdict too
+        deadline = time.monotonic() + 3.0
+        while time.monotonic() < deadline and len(pool.routable()) != 1:
+            time.sleep(0.02)
+        detected_s = time.monotonic() - kill_at.get("t", time.monotonic())
+        routable = len(pool.routable())
+        # recovery: a fresh probe burst rides the survivor only; its p99
+        # must be back under the SLO (no lingering dead-replica timeouts)
+        probe = LoadGen(router.submit, rate_rps=40.0, duration_s=1.0,
+                        clip_factory=heavy_tail_clip_factory(clip),
+                        seed=seed + 1).run()
+        snap = router.fleet_snapshot()
+        leg.update(load={k: load[k] for k in
+                         ("offered", "completed", "failed", "shed",
+                          "p99_ms", "open_loop_ok")},
+                   probe={k: probe[k] for k in
+                          ("completed", "failed", "p99_ms")},
+                   routable=routable,
+                   detected_s=round(detected_s, 3),
+                   router_retries=snap.get("router_retries"))
+        if load["failed"] > 0:
+            _finding(report, "replica_kill",
+                     f"{int(load['failed'])} non-shed failures under the "
+                     "kill (route-around must re-dispatch)")
+        if routable != 1:
+            _finding(report, "replica_kill",
+                     f"dead replica still routable ({routable} routable "
+                     f"after {detected_s:.2f}s; interval "
+                     f"{health_interval_s}s)")
+        if probe["failed"] > 0 or probe["completed"] <= 0:
+            _finding(report, "replica_kill",
+                     f"post-kill probe burst unhealthy: {probe}")
+        if probe["p99_ms"] > _KILL_RECOVERY_SLO_MS:
+            _finding(report, "replica_kill",
+                     f"recovered p99 {probe['p99_ms']} ms > "
+                     f"{_KILL_RECOVERY_SLO_MS} ms SLO")
+        log(f"[chaos] replica_kill: {int(load['completed'])} served "
+            f"through the kill ({int(load['failed'])} failed, "
+            f"{snap.get('router_retries')} re-dispatched), dead replica "
+            f"out in {detected_s:.2f}s, recovered p99 "
+            f"{probe['p99_ms']} ms")
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10.0)
+            except Exception:
+                pass
+
+
 def leg_sigterm_plumbing(report: dict, log: Log) -> None:
     """The raw signal path: a real SIGTERM to the installed guard sets the
     request (and does NOT kill), outside any trainer."""
@@ -639,6 +782,7 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                 (leg_ckpt, (report, tmpdir, seed, log)),
                 (leg_tracker, (report, tmpdir, seed, log)),
                 (leg_serve, (report, seed, log)),
+                (leg_replica_kill, (report, seed, log)),
                 (leg_preempt, (report, tmpdir, seed, log)),
                 (leg_preempt_mesh, (report, tmpdir, seed, log)),
         ):

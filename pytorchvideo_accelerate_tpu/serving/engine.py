@@ -62,18 +62,31 @@ def _round_up(n: int, multiple: int) -> int:
 
 
 def compute_buckets(max_batch_size: int, shards: int) -> Tuple[int, ...]:
-    """Padding targets: shard-count multiples doubling up to max_batch_size.
+    """Padding targets: shard-aligned sizes on a doubling ladder up to
+    max_batch_size.
 
     Every bucket must divide over the mesh's batch axes (shard_batch places
-    the batch dim across `data*fsdp` devices), so the smallest bucket is the
-    shard count itself; doubling keeps the compiled-executable count
-    logarithmic in max_batch_size."""
+    the batch dim across `data*fsdp` devices), and the ladder must be
+    shard-aligned AND terminating on ANY shard count — so each rung is the
+    smallest shard multiple >= a power-of-two TARGET (the `lcm`-style
+    rounding `bench_multichip` uses for its global batch, PR 7) instead of
+    a raw double of the previous rung: raw power-of-two doubling never
+    lands on a 3/6/10-shard multiple (the data dims of 12/24/40-device
+    slices), which is exactly how PR 7 found the non-terminating variant
+    of this loop. Doubling targets keep the compiled-executable count
+    logarithmic in max_batch_size; duplicate rungs (every target below the
+    shard count rounds up to it) collapse."""
+    shards = max(int(shards), 1)
     top = _round_up(max(max_batch_size, 1), shards)
     buckets = []
-    b = shards
-    while b < top:
-        buckets.append(b)
-        b *= 2
+    target = 1
+    while True:
+        b = _round_up(target, shards)
+        if b >= top:
+            break
+        if not buckets or b != buckets[-1]:
+            buckets.append(b)
+        target *= 2
     buckets.append(top)
     return tuple(buckets)
 

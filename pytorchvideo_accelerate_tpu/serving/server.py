@@ -16,9 +16,12 @@ Endpoints:
 Deliberately stdlib (`http.server.ThreadingHTTPServer`): zero new
 dependencies, and the concurrency story is honest — handler threads only
 parse JSON and block on a batcher future; all accelerator work is
-serialized behind the MicroBatcher's single flush thread. Error mapping:
-bad request -> 400, shed/queue full -> 503 (+ Retry-After), request budget
-exceeded -> 504 (+ Retry-After).
+serialized behind a single flush thread (the continuous-batching
+`fleet/scheduler.Scheduler` by default — deadlines, priority classes,
+EDF launches; `--serve.scheduler micro` restores the MicroBatcher
+policy). Error mapping: bad request -> 400, shed/queue full -> 503
+(+ Retry-After; deadline sheds resolve the future the same way), request
+budget exceeded -> 504 (+ Retry-After).
 
 Degradation (serving/admission.py, docs/RELIABILITY.md): a
 healthy/degraded/draining state machine sits in front of the batcher —
@@ -66,6 +69,11 @@ class _Handler(BaseHTTPRequestHandler):
         self.send_response(code)
         self.send_header("Content-Type", "application/json")
         self.send_header("Content-Length", str(len(body)))
+        if self.close_connection:
+            # say it explicitly (shed-before-body-read leaves the request
+            # stream unread, so this connection cannot be reused): clients
+            # must not wait on a keep-alive that will never come
+            self.send_header("Connection", "close")
         for k, v in (headers or {}).items():
             self.send_header(k, v)
         self.end_headers()
@@ -140,12 +148,21 @@ class _Handler(BaseHTTPRequestHandler):
                 raise ValueError(
                     "body needs 'video' (or 'slow'+'fast') nested lists")
             srv.check_geometry(clip)
+            # per-request scheduling hints (fleet/scheduler.py): forwarded
+            # only to deadline-aware fronts — a plain MicroBatcher treats
+            # every request the same by design, so the keys are ignored
+            kwargs = {}
+            if getattr(srv.batcher, "supports_priority", False):
+                if "priority" in body:
+                    kwargs["priority"] = str(body["priority"])
+                if "deadline_ms" in body:
+                    kwargs["deadline_ms"] = float(body["deadline_ms"])
         except (ValueError, TypeError, KeyError) as e:
             srv.stats.observe_rejected("400")
             self._reply(400, {"error": f"bad request: {e}"})
             return
         try:
-            future = srv.batcher.submit(clip)
+            future = srv.batcher.submit(clip, **kwargs)
         except QueueFullError as e:
             # the batcher already counted this one (cause "503")
             self._reject(503, str(e), e.retry_after_s)
@@ -172,6 +189,14 @@ class _Handler(BaseHTTPRequestHandler):
             self._reject(
                 504, f"request exceeded {srv.request_timeout_s}s budget",
                 srv.admission.retry_after_s)
+            return
+        except QueueFullError as e:
+            # shed AFTER admission: the continuous-batching scheduler's
+            # shed-before-deadline-miss (fleet/scheduler.ShedError) or a
+            # fleet router with no routable capacity resolves the FUTURE
+            # with the shed — same 503 + Retry-After contract as a
+            # submit-time shed, never a 500 and never a burned 504 budget
+            self._reject(503, str(e), e.retry_after_s)
             return
         except Exception as e:  # noqa: BLE001 - batch failure surfaced per-request
             srv.stats.observe_error()
@@ -364,10 +389,28 @@ def build_server(cfg) -> InferenceServer:
         logger.info("warmup: compiling buckets %s for %s",
                     engine.buckets, {k: v.shape for k, v in sample.items()})
         engine.warmup(sample)
-    batcher = MicroBatcher(
-        engine, max_wait_ms=s.max_wait_ms, max_queue=s.max_queue,
-        stats=stats, retry_after_s=s.retry_after_s,
-        heartbeat=(watchdog.beat_fn("serve_batcher") if watchdog else None))
+    heartbeat = watchdog.beat_fn("serve_batcher") if watchdog else None
+    if s.scheduler == "edf":
+        # the continuous-batching scheduler (fleet/scheduler.py) is the
+        # default hot path: deadlines + priority classes + EDF launches +
+        # shed-before-deadline-miss; serve.max_wait_ms becomes the
+        # batch-class coalescing dial (realtime is work-conserving)
+        from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+
+        batcher = Scheduler(
+            engine, max_queue=s.max_queue, stats=stats,
+            realtime_deadline_ms=s.realtime_deadline_ms,
+            batch_deadline_ms=s.batch_deadline_ms,
+            batch_max_wait_ms=s.max_wait_ms,
+            retry_after_s=s.retry_after_s, heartbeat=heartbeat)
+    elif s.scheduler == "micro":
+        batcher = MicroBatcher(
+            engine, max_wait_ms=s.max_wait_ms, max_queue=s.max_queue,
+            stats=stats, retry_after_s=s.retry_after_s,
+            heartbeat=heartbeat)
+    else:
+        raise SystemExit(
+            f"unknown --serve.scheduler {s.scheduler!r} (edf | micro)")
     stats.queue_depth_fn = batcher.queue_depth
     admission = AdmissionController(
         max_queue=s.max_queue, shed_frac=s.shed_queue_frac,

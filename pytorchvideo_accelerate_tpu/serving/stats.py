@@ -137,6 +137,66 @@ class ServingStats:
     def observe_compile(self) -> None:
         self._c_compiles.inc()
 
+    def window(self) -> tuple:
+        """Raw rolling-window samples, for cross-replica merging:
+        ``(latencies [(done_ts, latency_s)], fills [(n_real, bucket)])``.
+        Percentiles of per-replica percentiles would be wrong (a hot
+        replica's tail vanishes into a cool replica's median); the fleet
+        router pools the raw samples instead (`merge`)."""
+        with self._lock:
+            return list(self._lat), list(self._fills)
+
+    def snapshot_labels(self, label: str) -> Dict[str, float]:
+        """Per-replica snapshot with every key prefixed ``label/`` — the
+        tracker-facing twin of the registry's replica labels, so one
+        TrackerHub.log call can carry the whole fleet without collisions."""
+        return {f"{label}/{k}": v for k, v in self.snapshot().items()}
+
+    @staticmethod
+    def merge(stats_list: Sequence["ServingStats"],
+              extra: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+        """Fleet-level aggregate across per-replica ServingStats.
+
+        Percentiles are computed over the POOLED raw latency windows
+        (`window()`), never by averaging per-replica percentiles; counters
+        sum. Sheds are counted exactly once, where they happened: each
+        replica's `shed` counts requests ITS admission/deadline machinery
+        shed, and router-level sheds (requests that never reached any
+        replica) arrive via `extra` (e.g. ``{"router_shed": n}``) and are
+        deliberately NOT folded into the summed `shed` — folding them in
+        would double-count every shed the router already re-tried against
+        a second replica's admission door."""
+        keys = ("requests", "batches", "errors", "rejected",
+                "rejected_400", "rejected_503", "rejected_504", "shed",
+                "compiled_buckets")
+        out: Dict[str, float] = {k: 0.0 for k in keys}
+        lat: list = []
+        fills: list = []
+        for st in stats_list:
+            snap = st.snapshot()
+            for k in keys:
+                out[k] += snap.get(k, 0.0)
+            w_lat, w_fills = st.window()
+            lat.extend(w_lat)
+            fills.extend(w_fills)
+        vals = sorted(v for _, v in lat)
+        out["p50_ms"] = round(_percentile(vals, 50) * 1e3, 3)
+        out["p95_ms"] = round(_percentile(vals, 95) * 1e3, 3)
+        out["p99_ms"] = round(_percentile(vals, 99) * 1e3, 3)
+        real = sum(n for n, _ in fills)
+        padded = sum(b for _, b in fills)
+        out["batch_fill_ratio"] = (round(real / padded, 4) if padded
+                                   else 0.0)
+        lat.sort(key=lambda s: s[0])
+        if len(lat) >= 2 and lat[-1][0] > lat[0][0]:
+            out["throughput_rps"] = round(
+                (len(lat) - 1) / (lat[-1][0] - lat[0][0]), 3)
+        else:
+            out["throughput_rps"] = 0.0
+        out["replicas"] = float(len(stats_list))
+        out.update(extra or {})
+        return out
+
     def snapshot(self) -> Dict[str, float]:
         with self._lock:
             lat = list(self._lat)

@@ -147,6 +147,9 @@ def test_finalize_line_fits_driver_capture():
         "multichip_forced_host": True, "multichip_train_recompiles": 0,
         "multichip_mfu": 0.1234,
         "multichip_error": "no trustworthy device numbers " + "z" * 200,
+        "serve_rps": 123.456, "serve_p99_ms_under_load": 87.654,
+        "swap_blackout_ms": 12.345, "fleet_shed_frac": 0.0123,
+        "fleet_error": "no trustworthy device numbers " + "w" * 200,
         "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
         "error": "watchdog fired: " + "y" * 3000,
         "probe_attempts": [
@@ -231,6 +234,29 @@ def test_finalize_multichip_keys_ride_the_headline():
         user_smoke=False)
     assert out["multichip_error"] == "cpu fallback"
     assert "multichip_cps_per_chip" not in out
+
+
+def test_finalize_fleet_lane_keys_ride_the_headline():
+    """The SERVE_FLEET lane's four headline keys (achieved rps, p99 under
+    open-loop load, hot-swap blackout, shed fraction — the numbers
+    `--smoke` asserts) plumb through finalize; a suspect/failed lane
+    headlines fleet_error INSTEAD of the numbers (the multichip refusal
+    rule)."""
+    extras = {"serve_rps": 118.2, "serve_p99_ms_under_load": 42.5,
+              "swap_blackout_ms": 7.25, "fleet_shed_frac": 0.031}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["serve_rps"] == 118.2
+    assert out["serve_p99_ms_under_load"] == 42.5
+    assert out["swap_blackout_ms"] == 7.25
+    assert out["fleet_shed_frac"] == 0.031
+
+    out = bench.finalize(
+        _model(), {**extras, "fleet_error": "cpu fallback"},
+        user_smoke=False)
+    assert out["fleet_error"] == "cpu fallback"
+    for key in ("serve_rps", "serve_p99_ms_under_load",
+                "swap_blackout_ms", "fleet_shed_frac"):
+        assert key not in out
 
 
 def test_finalize_serving_lane_keys():

@@ -44,6 +44,23 @@ def test_compute_buckets_doubling_from_shard_count():
     assert compute_buckets(9, 2) == (2, 4, 8, 10)
 
 
+def test_compute_buckets_shard_aligned_on_non_power_of_two_meshes():
+    """The PR 7 lcm lesson applied to serving: 12/24/40-device slices have
+    3/6/10 batch shards, which no raw power-of-two double ever lands on —
+    every rung must still be a shard multiple and the ladder must cover
+    max_batch_size and TERMINATE (the non-terminating doubling variant is
+    exactly what bench_multichip shipped before the lcm fix)."""
+    assert compute_buckets(8, 3) == (3, 6, 9)
+    assert compute_buckets(64, 12) == (12, 24, 36, 72)
+    for shards in (3, 6, 10, 12, 24):
+        for max_batch in (1, 8, 64):
+            buckets = compute_buckets(max_batch, shards)
+            assert all(b % shards == 0 for b in buckets), (shards, buckets)
+            assert buckets[-1] >= max_batch
+            assert list(buckets) == sorted(set(buckets))  # strict ladder
+            assert len(buckets) <= 10  # still logarithmic, never runaway
+
+
 def test_multiview_logits_helper_matches_manual_mean():
     """The extracted helper (shared by evaluate() and the engine) must be
     the per-view mean of the folded forward."""
