@@ -246,7 +246,7 @@ def bench_trainer(args) -> dict:
     import jax
 
     from pytorchvideo_accelerate_tpu.config import (
-        DataConfig, ModelConfig, OptimConfig, TrainConfig,
+        DataConfig, GuardConfig, ModelConfig, OptimConfig, TrainConfig,
     )
     from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
 
@@ -258,16 +258,21 @@ def bench_trainer(args) -> dict:
                         num_frames=frames, crop_size=crop, batch_size=bsz,
                         num_workers=2, limit_val_batches=1),
         optim=OptimConfig(num_epochs=2),  # epoch 1 excludes compile
+        # guard ARMED: the lane doubles as the proof that the self-healing
+        # machinery (in-graph skip branch + per-step observation) keeps
+        # train_recompiles == 0 and reports zero verdicts on a clean run
+        guard=GuardConfig(enabled=True),
         mixed_precision="bf16",
     )
     tr = Trainer(cfg)
     res = tr.fit()
     # perf-dict contract: the span-sourced obs keys (obs/ telemetry spine,
-    # default-on) and the legacy prefetch keys must be present — the smoke
-    # run doubles as the CI check that neither instrumentation silently
-    # fell out of fit()
+    # default-on), the legacy prefetch keys, and the guard verdicts must
+    # be present — the smoke run doubles as the CI check that none of the
+    # instrumentation silently fell out of fit()
     for key in ("input_wait_frac", "steps_per_sec", "obs_step_s",
-                "obs_input_wait_frac", "obs_h2d_s", "train_recompiles"):
+                "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
+                "guard_rollbacks", "quarantined_clips"):
         assert key in res, f"fit() perf dict missing {key!r}: {sorted(res)}"
     # steady-state: train-section wall time of the post-compile epoch only
     # (excludes compile, eval, checkpointing — the quantity the raw-step
@@ -288,6 +293,10 @@ def bench_trainer(args) -> dict:
             # pva_train_recompiles gauge; analysis/recompile_guard) —
             # anything but 0 means mid-training XLA compile stalls
             "train_recompiles": res["train_recompiles"],
+            # self-healing guard verdicts (reliability/guard.py): rollback
+            # and quarantine counts — a clean run reports 0 for both
+            "guard_rollbacks": res["guard_rollbacks"],
+            "quarantined_clips": res["quarantined_clips"],
             "mfu": res.get("mfu"), "smoke": bool(args.smoke)}
 
 
@@ -1239,6 +1248,12 @@ def main():
                 # on this jax (reported as unknown, never a lying 0)
                 r = tr["train_recompiles"]
                 extras["train_recompiles"] = None if r is None else int(r)
+            for key in ("guard_rollbacks", "quarantined_clips"):
+                # self-healing-guard verdicts (reliability/guard.py) —
+                # asserted 0 in --smoke: a clean synthetic run that rolls
+                # back or quarantines is a guard false positive
+                if tr.get(key) is not None:
+                    extras[key] = int(tr[key])
             raw = (results.get("slowfast_r50") or {}).get(
                 "clips_per_sec_per_chip")
             # only a same-mode comparison is meaningful
@@ -1383,6 +1398,18 @@ def main():
             f"steady-state recompiles detected: {extras['train_recompiles']} "
             "jit cache entries compiled after warmup (see "
             "docs/STATIC_ANALYSIS.md, rule `recompile`)")
+        # self-healing contract (docs/RELIABILITY.md § divergence
+        # runbook): the guard runs ARMED in the trainer lane; on a clean
+        # synthetic run it must report zero rollbacks and zero
+        # quarantined clips — anything else is a guard false positive
+        for key in ("guard_rollbacks", "quarantined_clips"):
+            assert key in extras, (
+                f"trainer smoke ran with the guard armed but produced no "
+                f"{key!r}: "
+                f"{extras.get('trainer_error') or sorted(extras)}")
+            assert extras[key] == 0, (
+                f"guard reported {key}={extras[key]} on a clean smoke "
+                "run (false positive; see docs/RELIABILITY.md)")
     if user_smoke:
         # dynamic-sanitizer contract, the third leg alongside lint-clean
         # and train_recompiles == 0: the bundled pva-tpu-tsan stress pass
@@ -1585,6 +1612,7 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "trainer_input_wait_frac", "obs_step_s",
                 "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
+                "guard_rollbacks", "quarantined_clips",
                 "tsan_findings", "chaos_findings", "mesh_parity",
                 "mesh_ckpt_portable", "multichip_train_recompiles",
                 *mc_perf, *fleet_perf):
@@ -1642,7 +1670,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               "fleet_error", "fleet_shed_frac", "swap_blackout_ms",
               "serve_p99_ms_under_load", "serve_rps",
               "serve_error", "serve_fill_ratio", "serve_p99_ms",
-              "serve_p50_ms", "train_recompiles", "obs_h2d_s",
+              "serve_p50_ms", "guard_rollbacks", "quarantined_clips",
+              "train_recompiles", "obs_h2d_s",
               "obs_input_wait_frac",
               "obs_step_s", "trainer_error", "trainer_input_wait_frac",
               "trainer_mfu", "trainer_cps_chip",

@@ -319,6 +319,50 @@ class ReliabilityConfig:
 
 
 @dataclass
+class GuardConfig:
+    """Self-healing training (reliability/guard.py TrainGuard —
+    docs/RELIABILITY.md § divergence runbook): in-graph nonfinite
+    skip-batch, EWMA anomaly detection on loss/grad_norm, a last-known-good
+    checkpoint ring with automatic rollback past the offending data span,
+    replay bundles, and bad-sample quarantine. Disarmed (the default) the
+    step graph carries no skip branch and the step loop does one None
+    check — structurally zero overhead."""
+
+    enabled: bool = False
+    # policy: which anomaly signals escalate. "nonfinite" (NaN/inf loss or
+    # grad norm), "spike" (EWMA z-score excursion on loss/grad_norm), or
+    # "both". The in-graph skip-batch always covers nonfinite updates when
+    # the guard is enabled, regardless of policy.
+    policy: str = "both"
+    # LKG cadence/ring: an async orbax save to <output_dir>/guard_lkg every
+    # `lkg_every_steps` healthy steps; the ring keeps `lkg_keep` entries
+    # (orbax max_to_keep pruning). LKG only advances when no anomaly was
+    # observed within the cadence window.
+    lkg_every_steps: int = 50
+    lkg_keep: int = 3
+    # EWMA spike detector shape: upward z-score threshold, smoothing
+    # factor, and the observation budget during which spikes never fire
+    # (young statistics + warmup loss cliffs must not false-positive)
+    spike_zscore: float = 6.0
+    ewma_alpha: float = 0.05
+    warmup_steps: int = 20
+    # escalation ladder: anomalies below `rollback_after` consecutive
+    # observations are skips (recorded; the in-graph skip already
+    # protected the state); at the threshold the guard rolls back to the
+    # LKG and fast-forwards the loader past the offending span; more than
+    # `max_rollbacks` rollbacks raises GuardHalt (a rollback loop means
+    # data or optimizer trouble — see the runbook)
+    rollback_after: int = 2
+    max_rollbacks: int = 2
+    # bad-sample quarantine (data/manifest.Quarantine): decode failures
+    # per clip before the path is quarantined to the persisted
+    # <output_dir>/quarantine.json sidecar the sampler excludes. 0 = off.
+    # Counts at most one failure per clip per run (the in-run substitution
+    # memory), so budget > 1 means "failed in that many runs/sessions".
+    quarantine_budget: int = 3
+
+
+@dataclass
 class TrackingConfig:
     """Metric logging (reference `run.py:227-231, 267-274, 306-315`)."""
 
@@ -342,6 +386,7 @@ class TrainConfig:
     fleet: FleetConfig = field(default_factory=FleetConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
+    guard: GuardConfig = field(default_factory=GuardConfig)
 
     seed: int = 42  # run.py:138 set_seed(42); run.py:355 exposes --seed
     # write a params-only (EMA-resolved) serving artifact to this path and

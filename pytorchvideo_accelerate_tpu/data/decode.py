@@ -30,6 +30,16 @@ DECODE_ERRORS = ((IOError, OSError, ValueError, RuntimeError, cv2.error)
                  else (IOError, OSError, ValueError, RuntimeError))
 
 
+class CorruptVideoError(IOError):
+    """The decoder's own verdict that the FILE is bad (container won't
+    open, zero frames in a valid span) — as opposed to an ambient OSError
+    from flaky storage. Still an IOError, so it rides DECODE_ERRORS into
+    the same retry/substitution machinery; the bad-sample quarantine
+    (`data/manifest.py Quarantine`) counts these against the per-clip
+    failure budget that eventually sidelines a deterministically-corrupt
+    clip instead of letting it kill every epoch at the same index."""
+
+
 @dataclass
 class VideoMeta:
     fps: float
@@ -44,7 +54,7 @@ def probe(path: str) -> VideoMeta:
     cap = cv2.VideoCapture(path)
     try:
         if not cap.isOpened():
-            raise IOError(f"cannot open video: {path}")
+            raise CorruptVideoError(f"cannot open video: {path}")
         fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
         frame_count = int(cap.get(cv2.CAP_PROP_FRAME_COUNT))
         return VideoMeta(fps=float(fps), frame_count=frame_count)
@@ -68,7 +78,7 @@ def decode_span(path: str, start_sec: float, end_sec: float,
     cap = cv2.VideoCapture(path)
     try:
         if not cap.isOpened():
-            raise IOError(f"cannot open video: {path}")
+            raise CorruptVideoError(f"cannot open video: {path}")
         fps = cap.get(cv2.CAP_PROP_FPS) or 30.0
         start_frame = max(int(round(start_sec * fps)), 0)
         end_frame = max(int(round(end_sec * fps)), start_frame + 1)
@@ -83,7 +93,7 @@ def decode_span(path: str, start_sec: float, end_sec: float,
                 break
             frames.append(cv2.cvtColor(frame_bgr, cv2.COLOR_BGR2RGB))
         if not frames:
-            raise IOError(
+            raise CorruptVideoError(
                 f"no frames decoded from {path} in [{start_sec:.2f}, {end_sec:.2f})s"
             )
         return np.stack(frames)

@@ -11,13 +11,26 @@ README documents (README.md:17: `data_dir/{train,val}/{class}/*.mp4`).
 per line, space- or comma-separated) — how Kinetics/SSv2 splits are
 commonly distributed — so users migrating with existing .csv/.txt split
 files don't have to restructure their storage into class directories.
+
+`Quarantine` is the bad-sample sideline: real Kinetics-scale trees always
+carry a few deterministically-corrupt files, and before PR 9 those cost a
+retry + substitution *every epoch, at the same clip, forever* — or worse,
+raised through after `_MAX_CONSECUTIVE_FAILURES` and killed a multi-day
+run. Now each clip has a failure budget; exhausting it moves the path into
+a persisted JSON sidecar that the sampler excludes (deterministic
+substitute indices — epoch geometry unchanged), the epoch continues, the
+`pva_data_quarantined_total{site=}` counter ticks, and `pva-tpu-doctor`
+lists the quarantined set.
 """
 
 from __future__ import annotations
 
+import json
 import os
 from dataclasses import dataclass
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
+
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 
 VIDEO_EXTENSIONS = (".mp4", ".avi", ".mkv", ".webm", ".mov", ".m4v")
 
@@ -44,6 +57,118 @@ class Manifest:
 
     def __len__(self) -> int:
         return len(self.entries)
+
+
+class Quarantine:
+    """Persisted per-clip failure budget + the quarantined-path sidecar.
+
+    `record(path, error)` counts one decode-layer failure against `path`;
+    the `budget`-th failure quarantines it: the path lands in the sidecar
+    JSON (atomic write — a kill mid-update can't corrupt the list), the
+    `pva_data_quarantined_total{site=}` counter ticks, and every sampler/
+    source consulting `contains()` / the exclusion helpers skips the clip
+    from then on (including the NEXT run: the sidecar is read back at
+    construction). Thread-safe — decode-pool workers record concurrently.
+
+    The budget exists so one transient NFS blip never sidelines a healthy
+    clip: only repeated failures (a deterministically corrupt file fails
+    every epoch) cross it. `budget=1` quarantines on first failure.
+    """
+
+    def __init__(self, sidecar_path: str, budget: int = 3,
+                 site: str = "decode"):
+        self.sidecar_path = sidecar_path
+        self.budget = max(int(budget), 1)
+        self.site = site
+        self._lock = make_lock("Quarantine._lock")
+        self._failures: Dict[str, int] = {}
+        self._quarantined: Dict[str, str] = {}  # path -> last error head
+        if sidecar_path and os.path.exists(sidecar_path):
+            try:
+                with open(sidecar_path) as f:
+                    data = json.load(f)
+                self._quarantined = dict(data.get("quarantined", {}))
+                self._failures = {k: int(v) for k, v in
+                                  data.get("failures", {}).items()}
+            except (OSError, ValueError):
+                # an unreadable sidecar starts fresh — quarantine is an
+                # optimization, never a reason to refuse to train
+                self._quarantined, self._failures = {}, {}
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._quarantined)
+
+    def contains(self, path: str) -> bool:
+        with self._lock:
+            return path in self._quarantined
+
+    def paths(self) -> set:
+        with self._lock:
+            return set(self._quarantined)
+
+    def snapshot(self) -> dict:
+        """Doctor/report view: quarantined paths with evidence + pending
+        failure counts still under budget."""
+        with self._lock:
+            return {"budget": self.budget,
+                    "quarantined": dict(self._quarantined),
+                    "failures_under_budget": {
+                        p: c for p, c in self._failures.items()
+                        if p not in self._quarantined}}
+
+    def record(self, path: str, error: Optional[BaseException] = None) -> bool:
+        """Count one failure; returns True when this call NEWLY quarantined
+        the path (callers log/count exactly once)."""
+        head = f"{type(error).__name__}: {error}"[:200] if error else ""
+        with self._lock:
+            if path in self._quarantined:
+                return False
+            n = self._failures.get(path, 0) + 1
+            self._failures[path] = n
+            if n < self.budget:
+                newly = False
+            else:
+                self._quarantined[path] = head
+                newly = True
+            payload = {"budget": self.budget,
+                       "failures": dict(self._failures),
+                       "quarantined": dict(self._quarantined)}
+            # persisted UNDER the lock: two concurrent records could
+            # otherwise land their atomic writes out of snapshot order and
+            # the stale writer would win, losing a failure count (cold
+            # path — a decode failure already cost retries + a warning)
+            self._persist(payload)
+        if newly:
+            self._publish(path, head)
+        return newly
+
+    def _persist(self, payload: dict) -> None:
+        if not self.sidecar_path:
+            return
+        try:
+            from pytorchvideo_accelerate_tpu.reliability.atomic import (
+                atomic_write_json,
+            )
+
+            atomic_write_json(self.sidecar_path, payload)
+        except OSError:  # pragma: no cover - sideline must not kill decode
+            pass
+
+    def _publish(self, path: str, head: str) -> None:
+        try:
+            from pytorchvideo_accelerate_tpu.obs import (
+                get_recorder,
+                get_registry,
+            )
+
+            get_registry().counter(
+                "pva_data_quarantined_total",
+                "clips quarantined after exhausting the failure budget, "
+                "by site", labelnames=("site",)).inc(site=self.site)
+            get_recorder().warn("clip quarantined", path=path, error=head)
+        except Exception:  # pragma: no cover - telemetry stays optional
+            pass
 
 
 def from_list(list_path: str, root: str = "") -> Manifest:

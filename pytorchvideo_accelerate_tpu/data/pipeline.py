@@ -38,8 +38,12 @@ from pytorchvideo_accelerate_tpu import obs
 from pytorchvideo_accelerate_tpu.reliability.retry import retry_call
 from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 from pytorchvideo_accelerate_tpu.data import decode as decode_mod
-from pytorchvideo_accelerate_tpu.data.manifest import Manifest
-from pytorchvideo_accelerate_tpu.data.samplers import random_clip, uniform_clips
+from pytorchvideo_accelerate_tpu.data.manifest import Manifest, Quarantine
+from pytorchvideo_accelerate_tpu.data.samplers import (
+    random_clip,
+    substitute_indices,
+    uniform_clips,
+)
 
 logger = logging.getLogger(__name__)
 
@@ -114,6 +118,14 @@ class VideoClipSource(ClipSource):
     label always comes from the video actually decoded. Only DECODE
     failures substitute — transform errors propagate (a transform bug must
     not silently skew the data distribution).
+
+    With a `quarantine` (`data/manifest.Quarantine`), every exhausted-retry
+    failure also counts against that clip's persisted failure budget;
+    past it the path is quarantined — excluded at the SAMPLER level
+    (`quarantined_indices()` feeds `samplers.substitute_indices`, so the
+    clip never reaches the decode pool again, this run or the next) —
+    instead of paying the retry + substitution dance every epoch or, after
+    `_MAX_CONSECUTIVE_FAILURES`, raising through and killing the run.
     """
 
     def __init__(
@@ -126,6 +138,7 @@ class VideoClipSource(ClipSource):
         num_clips: int = 1,
         decode_retries: int = 2,
         retry_base_delay_s: float = 0.05,
+        quarantine: Optional[Quarantine] = None,
     ):
         self.manifest = manifest
         self.transform = transform
@@ -143,6 +156,7 @@ class VideoClipSource(ClipSource):
         # in-graph (reference uniform-sampler tiling, run.py:163)
         self.num_clips = max(num_clips, 1) if not training else 1
         self.num_classes = manifest.num_classes
+        self.quarantine = quarantine
         self._meta_cache: Dict[str, decode_mod.VideoMeta] = {}
         self._meta_lock = make_lock("VideoClipSource._meta_lock")
         self._failed: set = set()
@@ -151,6 +165,16 @@ class VideoClipSource(ClipSource):
 
     def __len__(self) -> int:
         return len(self.manifest)
+
+    def quarantined_indices(self) -> set:
+        """Manifest indices of quarantined paths — the sampler-exclusion
+        input (`ClipLoader._epoch_indices` remaps them onto clean clips
+        via `samplers.substitute_indices`). Empty without a quarantine."""
+        if self.quarantine is None or len(self.quarantine) == 0:
+            return set()
+        bad = self.quarantine.paths()
+        return {i for i, e in enumerate(self.manifest.entries)
+                if e.path in bad}
 
     def _meta(self, path: str) -> decode_mod.VideoMeta:
         with self._meta_lock:
@@ -176,6 +200,11 @@ class VideoClipSource(ClipSource):
             entry = self.manifest.entries[idx]
             with self._meta_lock:
                 known_bad = entry.path in self._failed
+            if not known_bad and self.quarantine is not None:
+                # quarantined clips are skipped without a decode attempt;
+                # normally the sampler already excluded them, this covers
+                # direct get() callers and just-quarantined paths mid-epoch
+                known_bad = self.quarantine.contains(entry.path)
             if not known_bad:
                 # only DECODE failures are substitutable; the read_span
                 # wrapper tags them so a transform bug raising ValueError
@@ -199,6 +228,10 @@ class VideoClipSource(ClipSource):
                 def mark_failed(e):
                     with self._meta_lock:
                         self._failed.add(entry.path)
+                    if self.quarantine is not None:
+                        # one exhausted-retry failure against the persisted
+                        # budget; crossing it sidelines the clip for good
+                        self.quarantine.record(entry.path, e)
                     logger.warning(
                         "skipping unreadable video %s (%s: %s); substituting",
                         entry.path, type(e).__name__, e)
@@ -357,7 +390,18 @@ class ClipLoader:
         if self.shuffle:
             rng = np.random.default_rng((self.seed, 0xDA7A, epoch))
             rng.shuffle(idx)
-        return idx[self.process_index :: self.process_count]
+        idx = idx[self.process_index :: self.process_count]
+        # bad-sample quarantine (data/manifest.Quarantine): sources that
+        # track quarantined clips get them remapped onto clean ones HERE,
+        # so a sidelined clip never reaches the decode pool and epoch
+        # geometry (batch count, loader positions) stays unchanged
+        quarantined = getattr(self.source, "quarantined_indices", None)
+        if quarantined is not None:
+            bad = quarantined()
+            if bad:
+                idx = substitute_indices(idx, bad, len(self.source),
+                                         self.seed, epoch)
+        return idx
 
     @property
     def samples_per_yield(self) -> int:

@@ -81,7 +81,16 @@ class _Metric:
 
 
 class Counter(_Metric):
-    """Monotonic counter, optionally labeled (e.g. rejected{cause="503"})."""
+    """Monotonic counter, optionally labeled (e.g. rejected{cause="503"}).
+
+    Labels are the REQUIRED shape for families of related counts — the
+    retry counters (`pva_retry_*{op=}`), fault fires
+    (`pva_fault_injected_total{point=}`), guard ladder events
+    (`pva_guard_events_total{action=}`), and quarantines
+    (`pva_data_quarantined_total{site=}`) all label one family instead of
+    minting name-mangled metric names per site; `total()` is the
+    cross-label aggregate the `/stats`-style surfaces read. Same label
+    surface as `Gauge` (tests/test_zguard.py locks it)."""
 
     kind = "counter"
 

@@ -19,15 +19,24 @@ its next heartbeat, so a wedged-then-recovered component can report again
 while a permanently wedged one doesn't spam a dump per poll tick.
 Components that finish cleanly call `clear(name)` so an idle-but-healthy
 phase (between epochs, a drained prefetcher) is not a stall.
+
+Sections (`with watchdog.section(name, detail)`) add ATTRIBUTION: while a
+component is inside a section, a stall on it is reported as wedged inside
+that detail string — how a straggling/wedged mesh collective (the
+`parallel/hangcheck.py` collective-hang detector wraps every watched
+collective in one, detail carrying the op + host index) is distinguished
+from a merely slow input pipeline. Section exit CLEARS the component:
+"no collective in flight" is idle, never a stall.
 """
 
 from __future__ import annotations
 
+import contextlib
 import sys
 import threading
 import time
 import traceback
-from typing import Callable, List, Optional
+from typing import Callable, Dict, List, Optional, Tuple
 
 from pytorchvideo_accelerate_tpu.utils.sync import (
     make_lock,
@@ -36,7 +45,7 @@ from pytorchvideo_accelerate_tpu.utils.sync import (
 )
 
 
-@shared_state("stall_count", "last_stalled", "_thread")
+@shared_state("stall_count", "last_stalled", "last_attribution", "_thread")
 class Watchdog:
     """No-progress detector over named heartbeats."""
 
@@ -55,10 +64,14 @@ class Watchdog:
         self._lock = make_lock("Watchdog._lock")
         self._beats = {}   # name -> last monotonic heartbeat
         self._fired = set()  # names already dumped for the current stall
+        self._sections: Dict[str, Tuple[str, float]] = {}  # name -> (detail, t)
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.stall_count = 0
         self.last_stalled: List[str] = []
+        # stalled name -> (detail, seconds inside) for components that were
+        # inside a section when they stalled (the collective-hang verdict)
+        self.last_attribution: Dict[str, Tuple[str, float]] = {}
 
     # --- component side ---------------------------------------------------
 
@@ -78,6 +91,25 @@ class Watchdog:
         with self._lock:
             self._beats.pop(name, None)
             self._fired.discard(name)
+            self._sections.pop(name, None)
+
+    @contextlib.contextmanager
+    def section(self, name: str, detail: str = ""):
+        """Attributed progress window: heartbeat + mark `name` as inside
+        `detail` on entry; a stall while open reports the detail (who is
+        wedged in WHAT — a `psum` on host 3, not just "no progress").
+        Exit clears the component entirely: a name that is only expected
+        to progress while inside sections (a collective) is idle-healthy
+        between them."""
+        now = time.monotonic()
+        with self._lock:
+            self._beats[name] = now
+            self._fired.discard(name)
+            self._sections[name] = (detail, now)
+        try:
+            yield
+        finally:
+            self.clear(name)
 
     # --- lifecycle --------------------------------------------------------
 
@@ -130,14 +162,25 @@ class Watchdog:
     def _fire(self, stalled: List[str]) -> None:
         # written on the poll thread, read by tests/operators from others —
         # same lock as the beat table (pva-tpu-lint lock-discipline)
+        now = time.monotonic()
         with self._lock:
             self.stall_count += 1
             self.last_stalled = list(stalled)
+            attribution = {
+                name: (detail, round(now - t, 3))
+                for name, (detail, t) in self._sections.items()
+                if name in stalled}
+            self.last_attribution = attribution
         lines = [
             f"[watchdog] NO PROGRESS from {', '.join(stalled)} for "
             f"> {self.timeout_s:g}s — dumping all-thread stacks + flight "
             "record before an external timeout kills the process blind",
         ]
+        for name, (detail, age) in attribution.items():
+            # the collective-hang verdict: wedged INSIDE an attributed
+            # operation, not merely quiet between them
+            lines.append(f"[watchdog] {name} wedged inside '{detail}' "
+                         f"for {age:g}s")
         if self.collector is not None:
             open_spans = self.collector.current_stacks()
             if open_spans:
@@ -148,8 +191,12 @@ class Watchdog:
             lines.append("".join(traceback.format_stack(frame)).rstrip())
         print("\n".join(lines), file=sys.stderr, flush=True)
         if self.recorder is not None:
-            self.recorder.record("watchdog", "stall", stalled=list(stalled),
-                                 timeout_s=self.timeout_s)
+            self.recorder.record(
+                "watchdog", "stall", stalled=list(stalled),
+                timeout_s=self.timeout_s,
+                **({"attribution": {n: f"{d} ({a:g}s)"
+                                    for n, (d, a) in attribution.items()}}
+                   if attribution else {}))
             path = None
             if self.output_dir:
                 import os

@@ -81,12 +81,22 @@ def ppermute_ring(x: Any, axis_name, shift: int = 1) -> Any:
 
 
 def host_allgather(x: Any) -> Any:
-    """Out-of-band cross-process gather (DCN), for host-side logging only."""
-    if jax.process_count() == 1:
-        return jax.tree.map(lambda a: jnp.asarray(a)[None], x)
-    from jax.experimental import multihost_utils
+    """Out-of-band cross-process gather (DCN), for host-side logging only.
 
-    return multihost_utils.process_allgather(x)
+    Watched: a straggler host wedges every peer inside this call, so it
+    runs under the collective-hang detector's attributed section
+    (`parallel/hangcheck.py`) — a stall dumps `host_allgather host=i/n`
+    instead of anonymous silence."""
+    from pytorchvideo_accelerate_tpu.parallel.hangcheck import (
+        collective_section,
+    )
+
+    with collective_section("host_allgather"):
+        if jax.process_count() == 1:
+            return jax.tree.map(lambda a: jnp.asarray(a)[None], x)
+        from jax.experimental import multihost_utils
+
+        return multihost_utils.process_allgather(x)
 
 
 def host_broadcast(x: Any) -> Any:
@@ -98,10 +108,23 @@ def host_broadcast(x: Any) -> Any:
     as numpy arrays on EVERY process — including single-process runs, so dev
     and pod behavior can't diverge. str/bytes leaves (which psum-based
     broadcast can't carry) are broadcast as length then a uint8 buffer and
-    come back as str/bytes."""
+    come back as str/bytes.
+
+    Watched (`parallel/hangcheck.py`): the resume-time broadcast is the
+    classic place a pod wedges when one host's checkpoint scan hangs —
+    the hang detector attributes it per host instead of letting the
+    external timeout kill blind."""
     from jax.experimental import multihost_utils
 
-    bcast = multihost_utils.broadcast_one_to_all
+    from pytorchvideo_accelerate_tpu.parallel.hangcheck import (
+        collective_section,
+    )
+
+    bcast_raw = multihost_utils.broadcast_one_to_all
+
+    def bcast(v):
+        with collective_section("host_broadcast"):
+            return bcast_raw(v)
 
     leaves, treedef = jax.tree.flatten(x)
     out = list(leaves)

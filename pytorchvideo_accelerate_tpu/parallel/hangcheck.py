@@ -1,0 +1,89 @@
+"""Collective-hang detection for the multichip lane.
+
+A wedged `psum` looks exactly like a slow input pipeline from the outside:
+the process sits idle, the external `timeout -k` eventually kills it blind,
+and the postmortem can't say whether a straggler host, a dead ICI link, or
+a starved decode pool was at fault. The obs watchdog (PR 3) already knows
+*that* nothing progressed; this module tells it *where*: every host-side
+point where the trainer blocks on a mesh collective — the step dispatch
+when the queue pushes back, the epoch-end value fetch, the out-of-band
+host collectives in `parallel/collectives.py` — wraps itself in
+`collective_section(op)`, an attributed `Watchdog.section` whose detail
+carries the op name and this host's process index. On a stall the
+watchdog's dump then reads
+
+    [watchdog] collective wedged inside 'epoch_sync host=3/16 step=1200' ...
+
+— per-host attribution BEFORE the external kill, distinguishing a wedged
+collective from slow input (whose stall attributes to the prefetchers'
+components instead). See docs/RELIABILITY.md § collective hangs.
+
+Disarmed (no watchdog installed — the default), `collective_section` costs
+two module-global reads (the watchdog slot and the `collective.sync` fault
+point) and yields straight through: the `utils/sync.py` / `faults.py`
+zero-overhead discipline. The `collective.sync` fault point (kind
+``delay``) is how `pva-tpu-chaos`'s wedged-collective leg manufactures the
+straggler deterministically.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Optional
+
+from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
+
+# the watchdog component every watched collective reports under — one name,
+# so "the collective layer is wedged" is a single verdict with the op in
+# the section detail (per-host: each process runs its own watchdog)
+COMPONENT = "collective"
+
+_watchdog = None
+
+
+def install_collective_watch(watchdog) -> None:
+    """Route watched collectives through `watchdog.section` (the trainer
+    installs its obs watchdog here; None-safe no-op)."""
+    global _watchdog
+    _watchdog = watchdog
+
+
+def uninstall_collective_watch() -> None:
+    global _watchdog
+    _watchdog = None
+
+
+def current_watchdog() -> Optional[object]:
+    return _watchdog
+
+
+def host_tag() -> str:
+    """`host=i/n` attribution — the ONE formatting of per-host identity
+    every watched collective (and the trainer's step-dispatch section)
+    shares, so stall dumps parse uniformly. Lazy: only built when a
+    watchdog is live, and never dies of a pre-init backend."""
+    try:
+        import jax
+
+        return f"host={jax.process_index()}/{jax.process_count()}"
+    except Exception:  # pragma: no cover - pre-init / jax-free callers
+        return "host=?"
+
+
+@contextmanager
+def collective_section(op: str, **info):
+    """Attributed window around one host-blocking collective operation.
+
+    The `collective.sync` fault point fires INSIDE the section (after the
+    watchdog has marked it open) so an injected ``delay`` is
+    indistinguishable from a real straggler to the detector — the chaos
+    leg's whole point."""
+    wd = _watchdog
+    if wd is None:
+        fault_point("collective.sync")
+        yield
+        return
+    extra = "".join(f" {k}={v}" for k, v in info.items())
+    with wd.section(COMPONENT, f"{op} {host_tag()}{extra}"):
+        fault_point("collective.sync")
+        yield

@@ -26,6 +26,19 @@ Legs (all seeded via one `--seed`, CPU-only, replayable):
   `resume=auto` on a (4, 1) mesh: it must land on the exact emergency
   step and finish (the mesh-portable-checkpoint contract,
   docs/PARALLELISM.md runbook);
+- **quarantine**: a deterministically-corrupt clip (seeded garbage bytes)
+  exhausts its persisted failure budget across runs, lands in the
+  quarantine sidecar, and the NEXT run's sampler excludes it with zero
+  decode attempts — every epoch still delivers full batches;
+- **guard_nan**: seeded NaN poisoning of two consecutive dispatches — the
+  in-graph skip absorbs the first, the TrainGuard ladder rolls the second
+  back to the last-known-good step with the loader fast-forwarded past
+  the poisoned span, the run finishes with finite loss, and the replay
+  bundle is loadable + byte-deterministic;
+- **collective_hang**: a wedged mesh `psum` (injected delay inside the
+  watched section, forced-host child) trips the watchdog DURING the
+  wedge with per-host, per-op attribution — evidence before the external
+  kill;
 - **serve**: synthetic overload against a micro-batcher + admission
   controller — load sheds with 503/Retry-After semantics before latency
   collapses, an injected flush fault fails one batch (not the thread),
@@ -735,6 +748,298 @@ def leg_replica_kill(report: dict, seed: int, log: Log) -> None:
                 pass
 
 
+def leg_guard_nan(report: dict, tmpdir: str, seed: int, log: Log) -> None:
+    """NaN spike mid-epoch (seeded ``nan`` faults at `step.dispatch`): the
+    in-graph skip absorbs the first poisoned step, the second crosses the
+    ladder → auto-rollback to the last-known-good step with the loader
+    fast-forwarded PAST the poisoned span; the run completes with finite
+    loss and leaves a loadable, byte-stable replay bundle
+    (reliability/guard.py; docs/RELIABILITY.md § divergence runbook)."""
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.config import (
+        CheckpointConfig, DataConfig, GuardConfig, ModelConfig, OptimConfig,
+        TrainConfig,
+    )
+    from pytorchvideo_accelerate_tpu.reliability.guard import (
+        dump_replay_bundle,
+        load_replay_bundle,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+    leg = _leg(report, "guard_nan")
+    outdir = os.path.join(tmpdir, "guard_run")
+    cfg = TrainConfig(
+        model=ModelConfig(name="tiny3d", num_classes=4, dropout_rate=0.0),
+        data=DataConfig(synthetic=True, synthetic_num_videos=16,
+                        num_frames=4, crop_size=24, batch_size=2,
+                        num_workers=1, limit_val_batches=1),
+        optim=OptimConfig(num_epochs=2, lr=0.01),
+        checkpoint=CheckpointConfig(output_dir=outdir),
+        # LKG every 3 steps so one exists before the injected anomaly;
+        # warmup high = the spike detector stays quiet and the NONFINITE
+        # path alone drives the ladder (deterministic leg)
+        guard=GuardConfig(enabled=True, lkg_every_steps=3, lkg_keep=2,
+                          rollback_after=2, max_rollbacks=2,
+                          warmup_steps=1000),
+        seed=seed,
+    )
+    tr = Trainer(cfg)
+    guard = tr.train_guard
+    # poison the 6th and 7th dispatches: consecutive nonfinite steps —
+    # skip at streak 1, rollback at streak 2 (guard.rollback_after)
+    faults.arm(FaultPlan(seed, [FaultSpec("step.dispatch", kind="nan",
+                                          at_hits=(5, 6), max_fires=2)]))
+    try:
+        res = tr.fit()
+    finally:
+        faults.disarm()
+    fires = [e for e in faults.fault_history()
+             if e["point"] == "step.dispatch"]
+    leg.update(fires=len(fires), rollbacks=res.get("guard_rollbacks"),
+               skips=guard.skips, steps=res.get("steps"),
+               train_loss=res.get("train_loss"))
+    if len(fires) != 2:
+        _finding(report, "guard_nan",
+                 f"expected 2 injected nan dispatches, got {len(fires)}")
+    if res.get("guard_rollbacks") != 1:
+        _finding(report, "guard_nan",
+                 f"expected exactly 1 rollback, got "
+                 f"{res.get('guard_rollbacks')}")
+        return
+    rb = guard.last_rollback
+    leg["rollback"] = {k: rb[k] for k in ("lkg_step", "anomaly_step",
+                                          "resume_position")}
+    if rb["lkg_step"] >= rb["anomaly_step"]:
+        _finding(report, "guard_nan",
+                 f"rollback target {rb['lkg_step']} not before the "
+                 f"anomaly step {rb['anomaly_step']}")
+    if rb["lkg_step"] not in (3, 4, 5):
+        _finding(report, "guard_nan",
+                 f"LKG step {rb['lkg_step']} outside the healthy window "
+                 "before the injected anomalies (steps 6-7)")
+    # loader position intact: the resume position is the anomalous
+    # batch's CONSUMED position (post-batch == step index in epoch 0),
+    # i.e. the poisoned span is skipped, nothing else is
+    if rb["resume_position"] != {"epoch": 0,
+                                 "position": rb["anomaly_step"]}:
+        _finding(report, "guard_nan",
+                 f"loader not fast-forwarded past the poisoned span: "
+                 f"{rb['resume_position']} (anomaly step "
+                 f"{rb['anomaly_step']})")
+    if res.get("preempted") or not np.isfinite(res.get("train_loss",
+                                                       float("nan"))):
+        _finding(report, "guard_nan",
+                 f"run did not recover to a finite loss: {res}")
+    # the replay bundle is the repro artifact: loadable, carries the
+    # poison, and the writer is byte-deterministic (same input → same
+    # bytes, the property that makes a bundle replayable evidence)
+    meta, arrs = load_replay_bundle(rb["bundle"])
+    if np.isfinite(arrs["video"]).all():
+        _finding(report, "guard_nan",
+                 "replay bundle batch does not carry the injected NaNs")
+    if meta["verdict"]["kind"] != "nonfinite":
+        _finding(report, "guard_nan",
+                 f"bundle verdict {meta['verdict']} is not nonfinite")
+    a = dump_replay_bundle(os.path.join(tmpdir, "redump_a"), arrs,
+                           {"step": meta["step"]})
+    b = dump_replay_bundle(os.path.join(tmpdir, "redump_b"), arrs,
+                           {"step": meta["step"]})
+    for fname in sorted(os.listdir(a)):
+        with open(os.path.join(a, fname), "rb") as fa, \
+                open(os.path.join(b, fname), "rb") as fb:
+            if fa.read() != fb.read():
+                _finding(report, "guard_nan",
+                         f"replay bundle not byte-deterministic: {fname}")
+    log(f"[chaos] guard_nan: {len(fires)} poisoned steps -> "
+        f"{guard.skips} skip(s) + rollback to step {rb['lkg_step']}, "
+        f"resumed past position {rb['resume_position']['position']}, "
+        f"finished at step {res.get('steps')} with finite loss")
+
+
+def leg_quarantine(report: dict, tmpdir: str, seed: int, log: Log) -> None:
+    """A deterministically-corrupt clip (seeded garbage bytes — the
+    decode.read failure that kills the same index every epoch): the
+    failure budget fills across runs sharing the persisted sidecar, the
+    clip is quarantined, every epoch still delivers full batches, and the
+    next run's sampler excludes the clip without a single decode
+    attempt."""
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.data.manifest import (
+        Quarantine,
+        scan_directory,
+    )
+    from pytorchvideo_accelerate_tpu.data.pipeline import (
+        ClipLoader,
+        VideoClipSource,
+    )
+    from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+    from pytorchvideo_accelerate_tpu.obs import get_registry
+
+    leg = _leg(report, "quarantine")
+    root = os.path.join(tmpdir, "qvideos")
+    if not _write_video_tree(root, n_per_class=3):
+        leg["skipped"] = "no mp4 codec on this host"
+        log("[chaos] quarantine: skipped (no codec)")
+        return
+    bad_path = os.path.join(root, "class0", "v0.mp4")
+    rng = np.random.default_rng(seed)
+    with open(bad_path, "wb") as f:  # corrupt-bytes, seeded
+        f.write(rng.integers(0, 256, 4096, dtype=np.uint8).tobytes())
+    sidecar = os.path.join(tmpdir, "quarantine.json")
+    tf = make_transform(training=True, num_frames=4, crop_size=24,
+                        min_short_side_scale=26, max_short_side_scale=30)
+    counter = get_registry().counter(
+        "pva_data_quarantined_total", "", labelnames=("site",))
+    before = counter.value(site="decode")
+
+    def run(epoch: int):
+        """One fresh 'run' over the tree: new source + loader, shared
+        persisted sidecar (budget counts at most one failure per run)."""
+        q = Quarantine(sidecar, budget=2)
+        src = VideoClipSource(scan_directory(root), tf, clip_duration=0.2,
+                              training=True, seed=seed, decode_retries=1,
+                              retry_base_delay_s=0.001, quarantine=q)
+        loader = ClipLoader(src, global_batch_size=2, shuffle=True,
+                            num_workers=1, seed=seed)
+        try:
+            batches = sum(1 for _ in loader.epoch(epoch))
+        finally:
+            loader.close()
+        return q, src, loader, batches
+
+    q1, src1, loader1, b1 = run(0)   # failure 1 of 2: under budget
+    q2, _src2, _l2, b2 = run(1)      # failure 2: quarantined + persisted
+    q3, src3, loader3, b3 = run(2)   # excluded: zero decode attempts
+    want = loader1.batches_per_epoch()
+    leg.update(batches=[b1, b2, b3], want=want,
+               quarantined=sorted(q3.paths()),
+               counter_delta=counter.value(site="decode") - before)
+    if not (b1 == b2 == b3 == want):
+        _finding(report, "quarantine",
+                 f"epochs under a corrupt clip yielded {[b1, b2, b3]} "
+                 f"batches, want {want} each")
+    if q1.contains(bad_path):
+        _finding(report, "quarantine",
+                 "clip quarantined on its FIRST failure (budget=2 should "
+                 "absorb one transient)")
+    if not q2.contains(bad_path):
+        _finding(report, "quarantine",
+                 "second failing run did not quarantine the clip")
+    if not q3.contains(bad_path):
+        _finding(report, "quarantine",
+                 "sidecar did not persist across runs (round-trip lost)")
+    bad_idx = next(i for i, e in enumerate(src3.manifest.entries)
+                   if e.path == bad_path)
+    plan = loader3._epoch_indices(3)
+    if bad_idx in plan:
+        _finding(report, "quarantine",
+                 "sampler still schedules the quarantined clip")
+    if len(plan) != len(src3.manifest):
+        _finding(report, "quarantine",
+                 "exclusion changed epoch geometry (batch count drift)")
+    if counter.value(site="decode") - before != 1:
+        _finding(report, "quarantine",
+                 f"pva_data_quarantined_total moved by "
+                 f"{counter.value(site='decode') - before}, want 1")
+    log(f"[chaos] quarantine: corrupt clip sidelined after 2 failing "
+        f"runs, {b1}/{b2}/{b3} of {want} batches delivered, sampler "
+        f"excludes index {bad_idx}")
+
+
+# forced-host child for leg_collective_hang: a REAL mesh psum wedged by an
+# injected delay inside the watched section; the watchdog (tiny timeout)
+# must fire DURING the wedge with per-host attribution. One JSON line to
+# stdout (forcehost contract).
+_HANG_LEG_CODE = """
+import json, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+from jax.sharding import PartitionSpec as P
+from pytorchvideo_accelerate_tpu.config import MeshConfig
+from pytorchvideo_accelerate_tpu.obs.watchdog import Watchdog
+from pytorchvideo_accelerate_tpu.parallel import collectives, hangcheck
+from pytorchvideo_accelerate_tpu.parallel.mesh import make_train_mesh
+from pytorchvideo_accelerate_tpu.reliability import faults
+
+evidence = {{}}
+wd = Watchdog({timeout}, on_stall=lambda names: evidence.update(
+    stalled=list(names),
+    attribution={{n: list(v)
+                  for n, v in dict(wd.last_attribution or {{}}).items()}}))
+wd.start()
+hangcheck.install_collective_watch(wd)
+mesh = make_train_mesh(MeshConfig(data={devices}, model=1))
+f = jax.jit(collectives.shard_map(
+    lambda x: collectives.psum(x, "data"), mesh=mesh,
+    in_specs=P("data"), out_specs=P()))
+x = np.ones(({devices},), np.float32)
+warm = float(np.asarray(f(x)).ravel()[0])  # compile outside the wedge
+faults.arm(faults.FaultPlan({seed}, [faults.FaultSpec(
+    "collective.sync", kind="delay", delay_s={wedge},
+    at_hits=(0,), max_fires=1)]))
+t0 = time.monotonic()
+try:
+    with hangcheck.collective_section("psum", step=1):
+        out = float(np.asarray(f(x)).ravel()[0])
+finally:
+    faults.disarm()
+elapsed = time.monotonic() - t0
+wd.stop()
+print("\\n" + json.dumps({{
+    "stalled": evidence.get("stalled"),
+    "attribution": evidence.get("attribution"),
+    "elapsed_s": round(elapsed, 3),
+    "fires": len(faults.fault_history()), "psum": out, "warm": warm}}))
+"""
+
+_HANG_LEG_DEVICES = 4
+_HANG_LEG_TIMEOUT_S = 0.3
+_HANG_LEG_WEDGE_S = 1.5
+
+
+def leg_collective_hang(report: dict, seed: int, log: Log) -> None:
+    """Wedged mesh collective in a forced-host child: an injected delay
+    inside the watched `psum` section must trip the watchdog DURING the
+    wedge (section exit clears the component, so evidence means it fired
+    while stuck) with attribution naming the op and the host — the
+    evidence an external kill would otherwise destroy."""
+    from pytorchvideo_accelerate_tpu.utils.forcehost import run_forced_host
+
+    leg = _leg(report, "collective_hang")
+    out = run_forced_host(
+        _HANG_LEG_CODE.format(timeout=_HANG_LEG_TIMEOUT_S,
+                              devices=_HANG_LEG_DEVICES,
+                              wedge=_HANG_LEG_WEDGE_S, seed=seed),
+        _HANG_LEG_DEVICES, timeout=300.0)
+    leg.update(out)
+    if out.get("fires") != 1:
+        _finding(report, "collective_hang",
+                 f"expected 1 injected collective wedge, got "
+                 f"{out.get('fires')}")
+    if out.get("stalled") != ["collective"]:
+        _finding(report, "collective_hang",
+                 f"watchdog did not fire on the wedged collective: "
+                 f"stalled={out.get('stalled')}")
+        return
+    detail = (out.get("attribution") or {}).get("collective", ["", 0])[0]
+    if "psum" not in detail or "host=" not in detail:
+        _finding(report, "collective_hang",
+                 f"stall not attributed to the collective per host: "
+                 f"{detail!r}")
+    if out.get("elapsed_s", 0) < _HANG_LEG_WEDGE_S:
+        _finding(report, "collective_hang",
+                 f"wedge did not actually hold the section "
+                 f"({out.get('elapsed_s')}s < {_HANG_LEG_WEDGE_S}s)")
+    if out.get("psum") != float(_HANG_LEG_DEVICES):
+        _finding(report, "collective_hang",
+                 f"psum returned {out.get('psum')} after the wedge")
+    log(f"[chaos] collective_hang: watchdog attributed the wedge to "
+        f"{detail!r} before the {_HANG_LEG_WEDGE_S}s delay released")
+
+
 def leg_sigterm_plumbing(report: dict, log: Log) -> None:
     """The raw signal path: a real SIGTERM to the installed guard sets the
     request (and does NOT kill), outside any trainer."""
@@ -779,10 +1084,13 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                 (leg_replay, (report, seed, log)),
                 (leg_sigterm_plumbing, (report, log)),
                 (leg_decode, (report, tmpdir, seed, log)),
+                (leg_quarantine, (report, tmpdir, seed, log)),
                 (leg_ckpt, (report, tmpdir, seed, log)),
                 (leg_tracker, (report, tmpdir, seed, log)),
                 (leg_serve, (report, seed, log)),
                 (leg_replica_kill, (report, seed, log)),
+                (leg_collective_hang, (report, seed, log)),
+                (leg_guard_nan, (report, tmpdir, seed, log)),
                 (leg_preempt, (report, tmpdir, seed, log)),
                 (leg_preempt_mesh, (report, tmpdir, seed, log)),
         ):

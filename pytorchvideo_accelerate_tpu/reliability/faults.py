@@ -8,7 +8,8 @@ points are planted at the real hazard sites of the data→train→serve path —
     ckpt.write       reliability/atomic.py checkpoint/artifact write dies
     tracker.log      trainer/tracking.py   tracker backend outage
     serve.flush      serving/batcher.py    inference batch failure
-    step.dispatch    trainer/loop.py       slow/failing step dispatch
+    step.dispatch    trainer/loop.py       slow/failing/NaN step dispatch
+    collective.sync  parallel/hangcheck.py wedged/straggler mesh collective
 
 — and cost ONE module-global read when disarmed (the default, always in
 production): `fault_point()` loads `_plan`, sees None, returns. Armed (a
@@ -27,7 +28,19 @@ Fault kinds:
   a truncated artifact through the atomic writer;
 - ``kill_thread``: raise `InjectedThreadKill` (a BaseException, so it
   escapes ordinary `except Exception` recovery and takes the worker down
-  the way a real thread death would).
+  the way a real thread death would);
+- ``nan``: non-raising — `fault_point` RETURNS ``"nan"`` and the call site
+  interprets it (trainer/loop.py poisons the dispatched batch with NaNs:
+  the numeric-divergence scenario the TrainGuard's skip/rollback ladder
+  recovers from; see reliability/guard.py).
+
+`fault_point()` returns the fired kind for non-raising kinds (``"delay"``
+after sleeping, ``"nan"`` immediately) and `None` when nothing fired, so
+value-interpreting sites stay one `==` away from the disarmed fast path.
+A spec with `path_substr` set fires only at hits whose call-site `path`
+contains it — how a chaos leg pins a deterministic per-clip failure (the
+corrupt video that dies at the same clip every epoch) without touching
+the other files.
 
 Every fire increments `pva_fault_injected_total{point=...}` in the obs
 registry and lands in the flight-recorder ring, so a chaos run's crash
@@ -63,17 +76,19 @@ class FaultSpec:
 
     `at_hits` (exact 0-based hit indices) wins over `p` (per-hit fire
     probability, decided by a deterministic per-hit RNG). `max_fires`
-    bounds total fires (0 = unlimited)."""
+    bounds total fires (0 = unlimited). `path_substr` (when set) gates the
+    spec to hits whose call-site `path` contains it — a per-file fault."""
 
     point: str
-    kind: str = "raise"  # raise | delay | partial_write | kill_thread
+    kind: str = "raise"  # raise | delay | partial_write | kill_thread | nan
     p: float = 1.0
     at_hits: Tuple[int, ...] = ()
     max_fires: int = 0
     delay_s: float = 0.01
     message: str = ""
+    path_substr: str = ""
 
-    _KINDS = ("raise", "delay", "partial_write", "kill_thread")
+    _KINDS = ("raise", "delay", "partial_write", "kill_thread", "nan")
 
     def __post_init__(self):
         if self.kind not in self._KINDS:
@@ -84,7 +99,7 @@ class FaultSpec:
     def to_dict(self) -> dict:
         return {"point": self.point, "kind": self.kind, "p": self.p,
                 "at_hits": list(self.at_hits), "max_fires": self.max_fires,
-                "delay_s": self.delay_s}
+                "delay_s": self.delay_s, "path_substr": self.path_substr}
 
 
 def _hit_roll(seed: int, point: str, hit: int) -> float:
@@ -126,15 +141,20 @@ class FaultPlan:
 
     # --- the armed hit path -------------------------------------------------
 
-    def _decide(self, point: str) -> Optional[Tuple[FaultSpec, int]]:
+    def _decide(self, point: str,
+                path: Optional[str] = None) -> Optional[Tuple[FaultSpec, int]]:
         """Number this hit and pick the firing spec (if any) — pure
-        bookkeeping under the lock; the action happens outside it."""
+        bookkeeping under the lock; the action happens outside it. Hit
+        numbering is per point regardless of `path`, so a `path_substr`
+        spec never perturbs the sequence other specs see."""
         with self._lock:
             hit = self._hits.get(point, 0)
             self._hits[point] = hit + 1
             for spec in self.specs.get(point, ()):
                 fires = self._fires.get(id(spec), 0)
                 if spec.max_fires and fires >= spec.max_fires:
+                    continue
+                if spec.path_substr and spec.path_substr not in (path or ""):
                     continue
                 if spec.at_hits:
                     fire = hit in spec.at_hits
@@ -149,16 +169,20 @@ class FaultPlan:
             return None
 
     def hit(self, point: str, path: Optional[str] = None,
-            write_path: Optional[str] = None) -> None:
-        decision = self._decide(point)
+            write_path: Optional[str] = None) -> Optional[str]:
+        decision = self._decide(point, path)
         if decision is None:
-            return
+            return None
         spec, hit = decision
         _publish_fire(point, spec.kind, hit)
         msg = spec.message or f"injected {spec.kind} at {point} (hit {hit})"
         if spec.kind == "delay":
             time.sleep(spec.delay_s)
-            return
+            return "delay"
+        if spec.kind == "nan":
+            # non-raising: the CALL SITE interprets it (e.g. trainer/loop.py
+            # poisons the dispatched batch) — the registry only decides
+            return "nan"
         if spec.kind == "partial_write":
             # truncation ONLY on a write_path the call site declared as
             # in-flight scratch (atomic.py's tmp file). `path` is evidence
@@ -227,15 +251,17 @@ def fault_history() -> List[dict]:
 
 
 def fault_point(name: str, path: Optional[str] = None,
-                write_path: Optional[str] = None) -> None:
-    """A named hazard site. Disarmed: one global read, immediate return.
-    Armed: number the hit and maybe fire (see module docstring).
+                write_path: Optional[str] = None) -> Optional[str]:
+    """A named hazard site. Disarmed: one global read, immediate return
+    (of None). Armed: number the hit and maybe fire (see module
+    docstring); non-raising kinds return the fired kind string so
+    value-interpreting sites (``nan`` poisoning) can act on it.
 
     `write_path` is the in-flight SCRATCH file at a write site
-    (`partial_write` truncates it before raising); `path` is evidence only
-    — read sites pass the source file they were reading, and it is never
-    mutated."""
+    (`partial_write` truncates it before raising); `path` is evidence AND
+    the `path_substr` match target at read sites — the source file is
+    never mutated."""
     plan = _plan
     if plan is None:
-        return
-    plan.hit(name, path, write_path)
+        return None
+    return plan.hit(name, path, write_path)

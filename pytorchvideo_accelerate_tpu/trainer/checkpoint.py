@@ -228,25 +228,71 @@ class Checkpointer:
             step = self.latest_step()
             if step is None:
                 raise FileNotFoundError(f"no checkpoint found in {self.directory}")
-        try:
-            restored = self._mgr.restore(
-                int(step),
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardRestore(state_template),
-                    extra=ocp.args.JsonRestore(),
-                ),
-            )
-        except (ValueError, KeyError) as e:
-            # a structure/shape mismatch here usually means the checkpoint
-            # was written by an older model layout (e.g. the 0.4 videomae_b/
-            # mvit_b param-tree change) — say so instead of the raw orbax error
-            raise RuntimeError(
-                f"checkpoint at {self.directory} step {step} does not match "
-                "the current model's parameter tree. If it was written by an "
-                "older version (<0.4 changed videomae_b/mvit_b layouts), "
-                "re-convert the original weights or retrain; see MIGRATING.md "
-                "'Checkpoint layout changes'."
-            ) from e
+        # A truncated/partially-deleted step dir (files swept by a quota
+        # job, a torn network mount) used to surface as a raw orbax
+        # traceback and kill the resume. Instead: walk BACK through older
+        # steps — losing the newest interval is recoverable, losing the
+        # run is not — warning (+ flight-ring event) per unreadable step,
+        # and only error cleanly when no intact step remains.
+        candidates = sorted((s for s in (self._mgr.all_steps() or ())
+                             if s <= int(step)), reverse=True) or [int(step)]
+        if candidates[0] != int(step):
+            logger.warning(
+                "requested checkpoint step %s not present in %s; trying "
+                "latest earlier step %s", step, self.directory,
+                candidates[0])
+        restored = None
+        first_err: Optional[BaseException] = None
+        for i, s in enumerate(candidates):
+            try:
+                restored = self._mgr.restore(
+                    int(s),
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardRestore(state_template),
+                        extra=ocp.args.JsonRestore(),
+                    ),
+                )
+            except Exception as e:  # noqa: BLE001 - classified below
+                first_err = first_err or e
+                if i + 1 < len(candidates):
+                    logger.warning(
+                        "checkpoint step %s in %s is unreadable (%s: %s); "
+                        "falling back to step %s",
+                        s, self.directory, type(e).__name__,
+                        str(e)[:200], candidates[i + 1])
+                    try:
+                        from pytorchvideo_accelerate_tpu.obs import (
+                            get_recorder,
+                        )
+
+                        get_recorder().warn(
+                            "checkpoint fallback", step=int(s),
+                            next_step=int(candidates[i + 1]),
+                            error=f"{type(e).__name__}: {e}"[:200])
+                    except Exception:  # pragma: no cover - obs optional
+                        pass
+                    continue
+                if isinstance(first_err, (ValueError, KeyError)):
+                    # a structure/shape mismatch usually means an older
+                    # model layout (0.4 changed videomae_b/mvit_b trees) —
+                    # say so instead of the raw orbax error
+                    raise RuntimeError(
+                        f"checkpoint at {self.directory} step {step} does "
+                        "not match the current model's parameter tree. If "
+                        "it was written by an older version (<0.4 changed "
+                        "videomae_b/mvit_b layouts), re-convert the "
+                        "original weights or retrain; see MIGRATING.md "
+                        "'Checkpoint layout changes'."
+                    ) from first_err
+                raise RuntimeError(
+                    f"no intact checkpoint step in {self.directory}: "
+                    f"step {step} (and every older step) failed to "
+                    f"restore; first error: {type(first_err).__name__}: "
+                    f"{first_err}"
+                ) from first_err
+            else:
+                step = s
+                break
         # Re-materialize every restored leaf into a fresh XLA-owned buffer
         # (.copy() preserves sharding). Orbax hands back arrays backed by
         # tensorstore-owned host memory; with the persistent compilation
@@ -266,6 +312,12 @@ class Checkpointer:
 
     def all_steps(self):
         return sorted(self._mgr.all_steps())
+
+    def delete(self, step: int) -> None:
+        """Drop one step from the manager (the TrainGuard's LKG ring
+        replaces a revisited step index after a rollback; retention
+        pruning itself stays orbax's `max_to_keep`)."""
+        self._mgr.delete(int(step))
 
     def wait(self) -> None:
         self._mgr.wait_until_finished()

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import os
 import time
+from contextlib import nullcontext
 from typing import Dict, Optional
 
 import jax
@@ -45,7 +46,17 @@ from pytorchvideo_accelerate_tpu.parallel.sharding import (
     shard_params,
     shard_state,
 )
+from pytorchvideo_accelerate_tpu.parallel.hangcheck import (
+    collective_section,
+    host_tag as hangcheck_host_tag,
+    install_collective_watch,
+    uninstall_collective_watch,
+)
 from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
+from pytorchvideo_accelerate_tpu.reliability.guard import (
+    TrainGuard,
+    poison_batch,
+)
 from pytorchvideo_accelerate_tpu.reliability.preemption import (
     get_guard,
     record_emergency,
@@ -117,6 +128,12 @@ class Trainer:
                     recorder=obs.get_recorder(),
                     collector=obs.get_collector(),
                 ).start()
+                # collective-hang detection (parallel/hangcheck.py): every
+                # watched mesh-collective boundary — step dispatch under
+                # queue push-back, epoch-end value fetch, host collectives
+                # — reports through an attributed watchdog section, so a
+                # wedged psum dumps per-host evidence instead of silence
+                install_collective_watch(self.watchdog)
         if cfg.cpu:
             jax.config.update("jax_platforms", "cpu")
         if cfg.device_init_timeout > 0 and not cfg.cpu:
@@ -201,6 +218,18 @@ class Trainer:
                 retry_deadline_s=cfg.reliability.retry_deadline_s,
             )
 
+        # self-healing guard (reliability/guard.py; docs/RELIABILITY.md
+        # § divergence runbook): LKG ring + anomaly rollback + replay
+        # bundles. None when disarmed — the step loop then does one
+        # `is None` check (structural zero overhead).
+        self.train_guard: Optional[TrainGuard] = None
+        if cfg.guard.enabled:
+            self.train_guard = TrainGuard(
+                cfg.guard, output_dir=cfg.checkpoint.output_dir,
+                mesh=self.mesh, tp=self._tp, config_dict=cfg.to_dict(),
+                seed=cfg.seed)
+            self.train_guard.quarantine = self.quarantine
+
         # user-registered checkpoint participants (reference
         # `accelerator.register_for_checkpointing`, run.py:199)
         self._registered: dict = {}
@@ -222,6 +251,11 @@ class Trainer:
     def _build_data(self) -> None:
         cfg = self.cfg
         d = cfg.data
+        # bad-sample quarantine sidecar (data/manifest.Quarantine): built
+        # for the real-video path when the guard is on; train and val
+        # sources share it so a clip that corrupts either split is
+        # sidelined for both
+        self.quarantine = None
         is_slowfast = cfg.model.name.startswith("slowfast")
         # host-side cast to the compute dtype: halves clip bytes end to end
         # (worker -> shm ring -> host RAM -> HBM). For the supervised models
@@ -299,9 +333,19 @@ class Trainer:
             )
             num_classes = self.train_source.num_classes
         else:
+            if cfg.guard.enabled and cfg.guard.quarantine_budget > 0:
+                from pytorchvideo_accelerate_tpu.data.manifest import (
+                    Quarantine,
+                )
+
+                self.quarantine = Quarantine(
+                    os.path.join(cfg.checkpoint.output_dir,
+                                 "quarantine.json"),
+                    budget=cfg.guard.quarantine_budget)
             video_retry_kw = dict(
                 decode_retries=cfg.reliability.decode_retries,
                 retry_base_delay_s=cfg.reliability.retry_base_delay_s,
+                quarantine=self.quarantine,
             )
             if d.train_list or d.val_list:
                 if not (d.train_list and d.val_list):
@@ -476,6 +520,7 @@ class Trainer:
                 debug_asserts=cfg.debug_asserts,
                 ema_decay=cfg.optim.ema_decay,
                 health_metrics=self.obs_on,
+                guard_skip=cfg.guard.enabled,
             )
             self.eval_step = make_pretrain_eval_step(self.model, self.mesh)
         else:
@@ -490,6 +535,7 @@ class Trainer:
                 cutmix_alpha=cfg.optim.cutmix_alpha,
                 ema_decay=cfg.optim.ema_decay,
                 health_metrics=self.obs_on,
+                guard_skip=cfg.guard.enabled,
             )
             self.eval_step = make_eval_step(
                 self.model, self.mesh,
@@ -591,7 +637,10 @@ class Trainer:
         if self.checkpointer is not None:
             self.checkpointer.close()
             self.checkpointer = None
+        if self.train_guard is not None:
+            self.train_guard.close()
         if self.watchdog is not None:
+            uninstall_collective_watch()
             self.watchdog.stop()
             self.watchdog = None
         self.train_loader.close()
@@ -655,6 +704,28 @@ class Trainer:
         main_print(
             f"preempted ({reason or 'requested'}): emergency checkpoint at "
             f"step {step}; resume with --resume_from_checkpoint auto")
+
+    def _guard_rollback(self, action) -> None:
+        """Execute a TrainGuard rollback verdict: restore the last-known-
+        good state through the mesh-portable restore path and fast-forward
+        the loader PAST the offending span (the anomalous batch's consumed
+        `LoaderState` — replaying the same span into the same divergence
+        would be a rollback loop by construction)."""
+        state, step = self.train_guard.restore(self.state, action)
+        self.state = state
+        self.train_loader.state = LoaderState.from_dict(
+            action.resume_position)
+        if self.obs_on:
+            obs.get_recorder().record(
+                "guard", "rollback", lkg_step=step,
+                resume=dict(action.resume_position), reason=action.reason)
+        main_print(
+            f"guard: rolled back to last-known-good step {step} "
+            f"({action.reason}); loader fast-forwarded to epoch "
+            f"{self.train_loader.state.epoch} position "
+            f"{self.train_loader.state.position}"
+            + (f"; replay bundle: {action.bundle_path}"
+               if action.bundle_path else ""))
 
     def _run_eval(self, epoch: int) -> tuple:
         """One pass over the val loader with in-graph masked metric sums
@@ -832,14 +903,25 @@ class Trainer:
         if guard is not None:
             guard.install()
         preempted = False
+        # self-healing guard (reliability/guard.py): observed one step
+        # behind dispatch (the deferred-fetch discipline), escalating
+        # skip -> rollback-to-LKG -> GuardHalt; None = one check per step
+        tguard = self.train_guard
+        hang_watch = self.watchdog  # collective-hang attribution source
+        host_tag = hangcheck_host_tag() if hang_watch is not None else ""
         window_t0 = time.perf_counter()
         try:
-            for epoch in range(starting_epoch, cfg.optim.num_epochs):
+            # while (not for): a guard rollback restores an EARLIER
+            # (state, loader) position mid-epoch and re-enters the same —
+            # or a previous — epoch from the fast-forwarded position
+            epoch = starting_epoch
+            while epoch < cfg.optim.num_epochs:
                 if use_tqdm:
                     progress.set_description_str(f"Epoch: {epoch}")
                 epoch_loss = MeanLoss()
                 t_epoch = time.time()
                 train_steps_this_epoch = 0
+                rolled_back = False
                 self.train_prefetch.pop_wait()  # epoch-scoped accounting
                 # discard inter-epoch spans (epoch-end ckpt save, teardown):
                 # they precede this epoch's first window and would otherwise
@@ -860,19 +942,37 @@ class Trainer:
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling = True
                     # chaos hook: "delay" = a slow dispatch, "raise" = a
-                    # failing one. Disarmed: one global read.
-                    fault_point("step.dispatch")
+                    # failing one, "nan" = poison the dispatched batch
+                    # (the numeric divergence the guard ladder recovers
+                    # from). Disarmed: one global read.
+                    if fault_point("step.dispatch") == "nan":
+                        global_batch = poison_batch(global_batch)
                     # "step" span = dispatch time; under async dispatch it
                     # absorbs compute only when the dispatch queue pushes
                     # back (or at compile), which is exactly the reading
-                    # that matters for the per-window breakdown
-                    with obs.span("step"):
-                        with jax.profiler.StepTraceAnnotation(
-                                "train", step_num=gstep):
-                            self.state, metrics = self.train_step(
-                                self.state, global_batch,
-                                self.rng.step_key(gstep)
-                            )
+                    # that matters for the per-window breakdown. With a
+                    # watchdog live, STEADY-STATE dispatches also run
+                    # inside an attributed "collective" section: queue
+                    # push-back from a wedged mesh collective then dumps
+                    # per-host evidence instead of anonymous silence. The
+                    # first dispatch (the legitimate minutes-long XLA
+                    # compile) is deliberately unwatched — attributing it
+                    # would be the exact wedged-collective misverdict this
+                    # detector exists to prevent; any LATER slow dispatch
+                    # is either a real wedge or a recompile the
+                    # recompile guard flags anyway.
+                    with (hang_watch.section(
+                            "collective",
+                            f"step_dispatch {host_tag} gstep={gstep}")
+                          if hang_watch is not None
+                          and recompile_guard.armed else nullcontext()):
+                        with obs.span("step"):
+                            with jax.profiler.StepTraceAnnotation(
+                                    "train", step_num=gstep):
+                                self.state, metrics = self.train_step(
+                                    self.state, global_batch,
+                                    self.rng.step_key(gstep)
+                                )
                     gstep += 1
                     train_steps_this_epoch += 1
                     if not recompile_guard.armed:
@@ -886,6 +986,18 @@ class Trainer:
                         # doesn't stall the pipeline
                         with obs.span("log"):
                             deferred.flush()
+                    if tguard is not None:
+                        # observe the PREVIOUS step's metrics (retired
+                        # behind the dispatch above — never a pipeline
+                        # stall) and stash this one; a rollback verdict
+                        # breaks out, GuardHalt raises through
+                        action = tguard.step(
+                            gstep, metrics, global_batch,
+                            self.train_loader.state, self.state)
+                        if action is not None:
+                            self._guard_rollback(action)
+                            rolled_back = True
+                            break
                     if self._flops_per_step is None:
                         # unconditional (not tracking-gated): fit()'s return
                         # dict and the bench harness both need FLOPs/step
@@ -942,15 +1054,38 @@ class Trainer:
                     self._emergency_save(
                         epoch, reason=guard.reason if guard else "")
                     break
-                if metrics is not None:
+                if metrics is not None and not rolled_back:
                     # value-fetch sync, never block_until_ready (acked
                     # early by forwarding backends — would end the epoch
-                    # timer with work still queued; bench_setup.fetch_loss)
+                    # timer with work still queued; bench_setup.fetch_loss).
+                    # Watched: a straggler host wedges HERE, so the hang
+                    # detector attributes the fetch per host.
                     with obs.span("sync"):
-                        fetch_loss(metrics)
+                        with collective_section("epoch_sync", step=gstep):
+                            fetch_loss(metrics)
                 if deferred is not None:
                     with obs.span("log"):
                         deferred.flush()
+                if tguard is not None and not rolled_back:
+                    # the last step of the epoch is still pending in the
+                    # guard; an anomaly there must not slip into the next
+                    # epoch's LKG window unobserved
+                    action = tguard.flush(self.state,
+                                          self.train_loader.state)
+                    if action is not None:
+                        self._guard_rollback(action)
+                        rolled_back = True
+                if rolled_back:
+                    # resume from the restored LKG: the loader already
+                    # points PAST the offending span; restart window/span
+                    # accounting so the next epoch's breakdown stays pure
+                    gstep = int(self.state.step)  # pva: disable=host-sync -- anomaly-recovery path, once per rollback
+                    metrics = None
+                    drain_spans()
+                    epoch_spans.clear()
+                    window_t0 = time.perf_counter()
+                    epoch = self.train_loader.state.epoch
+                    continue
                 epoch_train_times.append(time.time() - t_epoch)
                 # time the step loop spent blocked waiting for the next
                 # device batch — the number that proves (or disproves) the
@@ -1011,6 +1146,10 @@ class Trainer:
                     # the key stays present so consumers see "unknown"
                     # instead of a missing-key failure, and never a lying 0
                     last_perf["train_recompiles"] = recompile_guard.sample()
+                    if tguard is not None:
+                        # guard verdicts ride the perf dict -> bench
+                        # headline; a clean run asserts both are 0
+                        last_perf.update(tguard.perf_keys())
                     if self.obs_on:
                         # the generalized, span-sourced successors of PR 1's
                         # one-off input_wait plumbing — the keys bench.py
@@ -1057,6 +1196,7 @@ class Trainer:
                                  name=f"params@epoch{epoch}")
                 if self.checkpointing_steps == "epoch":
                     self._save("epoch", epoch)
+                epoch += 1
 
         except BaseException as e:
             # the flight recorder's whole purpose: the recent span/metric
@@ -1088,6 +1228,8 @@ class Trainer:
             self._save("final", cfg.optim.num_epochs - 1)
         if self.checkpointer:
             self.checkpointer.close()
+        if tguard is not None:
+            tguard.close()  # fence the LKG ring's async saves
         if use_tqdm:
             progress.close()
         self.train_loader.close()

@@ -140,6 +140,7 @@ def _make_update_step(
     debug_asserts: bool = False,
     ema_decay: float = 0.0,
     health_metrics: bool = False,
+    guard_skip: bool = False,
 ) -> Callable:
     """Shared machinery of the supervised and self-supervised steps.
 
@@ -148,7 +149,17 @@ def _make_update_step(
     wrapper passes batch_stats/correct/count through untouched. Gradient
     accumulation is an in-graph `lax.scan` over the leading micro-batch axis
     syncing ONCE per effective step; the returned step is jitted with state
-    donation (params update in place in HBM)."""
+    donation (params update in place in HBM).
+
+    `guard_skip` (reliability/guard.py TrainGuard): a step whose loss or
+    grad norm is nonfinite discards its own update IN-GRAPH — every state
+    leaf keeps its old value via `jnp.where`, only the step counter
+    advances — so a single NaN batch can never poison params/EMA/optimizer
+    state while the (one-step-delayed, pipelining-preserving) host
+    detector decides whether to escalate. A data-dependent select on a
+    static predicate shape: no recompile, one extra `metrics["skipped"]`
+    flag. Off (the default): the branch is not traced at all —
+    structurally zero overhead."""
 
     def step(state: TrainState, batch: dict, key) -> tuple:
         if debug_asserts:
@@ -186,6 +197,25 @@ def _make_update_step(
                 lambda e, p: e * ema_decay + p.astype(e.dtype)
                 * (1.0 - ema_decay),
                 state.ema_params, new_params)
+        grad_norm = optax.global_norm(grads)
+        skipped = None
+        if guard_skip:
+            # in-graph skip-batch (TrainGuard): a nonfinite loss or grad
+            # norm means this update is poison — keep every old leaf
+            # (params, BN stats, optimizer state, EMA), advance only the
+            # step counter so host/step bookkeeping stays aligned
+            ok = jnp.isfinite(loss) & jnp.isfinite(grad_norm)
+
+            def _keep(new, old):
+                return jnp.where(ok, new, old)
+
+            new_params = jax.tree.map(_keep, new_params, state.params)
+            new_stats = jax.tree.map(_keep, new_stats, state.batch_stats)
+            new_opt_state = jax.tree.map(_keep, new_opt_state,
+                                         state.opt_state)
+            if new_ema is not None:
+                new_ema = jax.tree.map(_keep, new_ema, state.ema_params)
+            skipped = 1.0 - ok.astype(jnp.float32)
         new_state = state.replace(
             step=state.step + 1,
             params=new_params,
@@ -193,7 +223,9 @@ def _make_update_step(
             opt_state=new_opt_state,
             ema_params=new_ema,
         )
-        metrics = {"loss": loss, "grad_norm": optax.global_norm(grads)}
+        metrics = {"loss": loss, "grad_norm": grad_norm}
+        if skipped is not None:
+            metrics["skipped"] = skipped
         if health_metrics:
             # training-health gauges computed IN-GRAPH (obs/: a few extra
             # reductions XLA fuses into the update — cheap on device, and
@@ -230,6 +262,7 @@ def make_train_step(
     cutmix_alpha: float = 0.0,
     ema_decay: float = 0.0,
     health_metrics: bool = False,
+    guard_skip: bool = False,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
     (state, metrics)` (see `_make_update_step`). `device_normalize`:
@@ -336,7 +369,8 @@ def make_train_step(
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
                              with_accuracy=True, debug_asserts=debug_asserts,
                              ema_decay=ema_decay,
-                             health_metrics=health_metrics)
+                             health_metrics=health_metrics,
+                             guard_skip=guard_skip)
 
 
 def make_pretrain_step(
@@ -348,6 +382,7 @@ def make_pretrain_step(
     debug_asserts: bool = False,
     ema_decay: float = 0.0,
     health_metrics: bool = False,
+    guard_skip: bool = False,
 ) -> Callable:
     """Build the VideoMAE self-supervised step: `step(state, batch, key) ->
     (state, metrics)`. No labels; batch_stats pass through unchanged (pure-LN
@@ -367,7 +402,8 @@ def make_pretrain_step(
     return _make_update_step(grad_fn, tx, mesh, accum_steps, lr_schedule,
                              with_accuracy=False, debug_asserts=debug_asserts,
                              ema_decay=ema_decay,
-                             health_metrics=health_metrics)
+                             health_metrics=health_metrics,
+                             guard_skip=guard_skip)
 
 
 def make_pretrain_eval_step(model, mesh) -> Callable:

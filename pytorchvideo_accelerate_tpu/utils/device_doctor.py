@@ -367,6 +367,24 @@ def reliability_snapshot(output_dir: str = "") -> dict:
     return out
 
 
+def guard_snapshot(output_dir: str = "") -> dict:
+    """Self-healing-guard health (reliability/guard.py TrainGuard —
+    docs/RELIABILITY.md § divergence runbook): LKG step + ring contents,
+    rollback/skip counts, the last anomaly verdict, the quarantine list,
+    and — given the run's output_dir — the on-disk replay bundles and
+    quarantine sidecar a second shell reads for a wedged/dead run."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.reliability.guard import (
+            guard_snapshot as _snap,
+        )
+
+        out.update(_snap(output_dir))
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def diagnose(timeout_s: int = 120, skip_init: bool = False,
              variants: bool = False, obs_dir: str = "") -> dict:
     rec = {
@@ -379,6 +397,7 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "lint": lint_snapshot(),
         "tsan": tsan_snapshot(),
         "reliability": reliability_snapshot(obs_dir),
+        "guard": guard_snapshot(obs_dir),
     }
     if not skip_init:
         rec["verbose_init"] = verbose_init_attempt(timeout_s)

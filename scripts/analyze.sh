@@ -4,8 +4,10 @@
 # stress pass (lockset races + lock-order cycles over the threaded
 # data/train/serve layers), then the pva-tpu-chaos fault-injection
 # scenario (retry/preemption/shedding recovery asserted under seeded
-# faults). Exit codes: 0 clean, 1 findings, 2 usage — CI gates on
-# nonzero. Extra args pass through to the lint step only
+# faults — including the PR-9 self-healing legs: guard_nan NaN-rollback,
+# corrupt-clip quarantine, and the wedged-collective hang detector).
+# Exit codes: 0 clean, 1 findings, 2 usage — CI gates on nonzero.
+# Extra args pass through to the lint step only
 # (e.g. `scripts/analyze.sh --select host-sync`).
 set -euo pipefail
 ROOT="$(cd "$(dirname "$0")/.." && pwd)"

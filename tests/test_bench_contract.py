@@ -141,7 +141,7 @@ def test_finalize_line_fits_driver_capture():
         "trainer_vs_rawstep": 0.934, "trainer_mfu": 0.1234,
         "obs_step_s": 0.012345, "obs_input_wait_frac": 0.0123,
         "obs_h2d_s": 0.001234, "train_recompiles": 0, "tsan_findings": 0,
-        "chaos_findings": 0,
+        "chaos_findings": 0, "guard_rollbacks": 0, "quarantined_clips": 0,
         "mesh_parity": True, "mesh_ckpt_portable": True,
         "multichip_cps_per_chip": {"1": 123.456, "8": 117.89},
         "multichip_forced_host": True, "multichip_train_recompiles": 0,
@@ -210,6 +210,23 @@ def test_finalize_chaos_findings_ride_the_headline():
     assert out["chaos_findings"] == 0
     out = bench.finalize(_model(), {"chaos_findings": 3}, user_smoke=False)
     assert out["chaos_findings"] == 3
+
+
+def test_finalize_guard_keys_ride_the_headline():
+    """The self-healing-guard verdicts (guard_rollbacks /
+    quarantined_clips, sourced from fit()'s perf dict with the guard
+    armed in the trainer lane; reliability/guard.py) plumb through
+    finalize onto the headline line — the numbers `--smoke` asserts 0."""
+    out = bench.finalize(
+        _model(), {"guard_rollbacks": 0, "quarantined_clips": 0},
+        user_smoke=False)
+    assert out["guard_rollbacks"] == 0
+    assert out["quarantined_clips"] == 0
+    out = bench.finalize(
+        _model(), {"guard_rollbacks": 2, "quarantined_clips": 5},
+        user_smoke=False)
+    assert out["guard_rollbacks"] == 2
+    assert out["quarantined_clips"] == 5
 
 
 def test_finalize_multichip_keys_ride_the_headline():

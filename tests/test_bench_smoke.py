@@ -50,6 +50,7 @@ def test_bench_trainer_smoke_propagates_input_wait(stubbed):
         "input_wait_s": 0.02, "input_wait_frac": 0.02, "mfu": 0.1,
         "obs_step_s": 0.25, "obs_input_wait_frac": 0.02,
         "obs_h2d_s": 0.01, "train_recompiles": 0,
+        "guard_rollbacks": 0, "quarantined_clips": 0,
     }
     res = stubbed.bench_trainer(argparse.Namespace(smoke=True))
     assert res["smoke"] is True
@@ -60,6 +61,11 @@ def test_bench_trainer_smoke_propagates_input_wait(stubbed):
     assert res["obs_h2d_s"] == 0.01
     # the steady-state recompile count (analysis/recompile_guard) too
     assert res["train_recompiles"] == 0
+    # the self-healing-guard verdicts (reliability/guard.py): the lane
+    # runs guard-ARMED and forwards both counts to the headline
+    assert res["guard_rollbacks"] == 0
+    assert res["quarantined_clips"] == 0
+    assert _StubTrainer.last_cfg.guard.enabled is True
     assert res["trainer_cps_chip"] > 0.0
     # and the smoke geometry really was requested (CPU-sized shapes)
     assert _StubTrainer.last_cfg.data.crop_size == stubbed.SMOKE_TRAINER_SHAPE[1]
@@ -94,6 +100,16 @@ def test_bench_trainer_smoke_asserts_perf_keys(stubbed):
     }
     with pytest.raises(AssertionError, match="train_recompiles"):
         stubbed.bench_trainer(argparse.Namespace(smoke=True))
+    # and for the self-healing-guard verdicts (guard runs armed here)
+    _StubTrainer.result = {
+        "steps": 8, "epoch_train_times": [2.0, 1.0], "train_loss": 0.5,
+        "steps_per_sec": 4.0, "input_wait_s": 0.02,
+        "input_wait_frac": 0.02, "obs_step_s": 0.25,
+        "obs_input_wait_frac": 0.02, "obs_h2d_s": 0.01,
+        "train_recompiles": 0,  # guard_rollbacks missing
+    }
+    with pytest.raises(AssertionError, match="guard_rollbacks"):
+        stubbed.bench_trainer(argparse.Namespace(smoke=True))
 
 
 @pytest.mark.slow
@@ -120,5 +136,9 @@ def test_bench_trainer_smoke_real_fit(monkeypatch, tmp_path):
     assert res["obs_step_s"] > 0.0
     assert 0.0 <= res["obs_input_wait_frac"] <= 1.0
     # the steady-state-zero recompile contract on a REAL fit: after the
-    # first step's compile, the train step's jit cache must not grow
+    # first step's compile, the train step's jit cache must not grow —
+    # including the guard's in-graph skip branch (the lane runs armed)
     assert res["train_recompiles"] == 0
+    # a clean run reports zero guard verdicts (false-positive contract)
+    assert res["guard_rollbacks"] == 0
+    assert res["quarantined_clips"] == 0

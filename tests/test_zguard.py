@@ -1,0 +1,534 @@
+"""Self-healing guard (reliability/guard.py) + PR-9 satellites: EWMA spike
+detector edge cases (no false positives on warmup / LR-drop loss cliffs),
+the LKG ring + escalation ladder, quarantine persistence, replay-bundle
+determinism, the in-graph nonfinite skip, labeled counters, the truncated-
+checkpoint fallback, and collective-hang attribution. Late-alphabet name on
+purpose: tier-1 is timeout-bound and early-alphabet tests must stay cheap.
+The end-to-end recovery paths live in pva-tpu-chaos (guard_nan /
+quarantine / collective_hang legs); this file pins the units.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.config import GuardConfig, parse_cli
+from pytorchvideo_accelerate_tpu.reliability.guard import (
+    GuardHalt,
+    SpikeDetector,
+    TrainGuard,
+    dump_replay_bundle,
+    guard_snapshot,
+    load_replay_bundle,
+    poison_batch,
+)
+
+
+# --- EWMA spike detector ----------------------------------------------------
+
+class TestSpikeDetector:
+    def test_warmup_loss_cliff_is_quiet(self):
+        """Early training: loss falls fast and the statistics are young —
+        nothing may fire inside the warmup budget."""
+        d = SpikeDetector(alpha=0.1, zscore=4.0, warmup=20)
+        for i in range(20):
+            assert d.update(5.0 * 0.8 ** i) is None
+
+    def test_lr_drop_cliff_down_is_healthy(self):
+        """An LR-schedule drop slashes the loss DOWNWARD — an improvement,
+        never an anomaly (upward-only excursions fire)."""
+        d = SpikeDetector(alpha=0.1, zscore=4.0, warmup=5)
+        rng = np.random.default_rng(0)
+        for _ in range(40):
+            assert d.update(2.0 + float(rng.normal()) * 0.05) is None
+        assert d.update(0.4) is None  # the cliff
+        assert d.update(0.45) is None
+
+    def test_upward_spike_fires(self):
+        d = SpikeDetector(alpha=0.1, zscore=4.0, warmup=5)
+        rng = np.random.default_rng(1)
+        for _ in range(40):
+            d.update(1.0 + float(rng.normal()) * 0.05)
+        assert d.update(25.0) == "spike"
+
+    def test_spike_not_absorbed_into_baseline(self):
+        """An anomalous value must not drag the EWMA up after itself —
+        the spike's tail has to keep firing."""
+        d = SpikeDetector(alpha=0.5, zscore=3.0, warmup=2)
+        for _ in range(20):
+            d.update(1.0)
+        for v in (1.1, 0.9, 1.05, 0.95) * 3:  # establish variance
+            d.update(v)
+        mean = d.mean
+        assert d.update(50.0) == "spike"
+        assert d.mean == mean
+        assert d.update(50.0) == "spike"
+
+    def test_nonfinite_always_fires_even_in_warmup(self):
+        d = SpikeDetector(warmup=100)
+        assert d.update(float("nan")) == "nonfinite"
+        assert d.update(float("inf")) == "nonfinite"
+        assert d.n == 0  # never absorbed
+
+
+# --- replay bundles ---------------------------------------------------------
+
+class TestReplayBundle:
+    def test_byte_deterministic_and_round_trips(self, tmp_path):
+        import jax.numpy as jnp
+
+        batch = {"video": jnp.arange(24, dtype=jnp.bfloat16).reshape(2, 3, 4),
+                 "label": np.int32([1, 2])}
+        meta = {"step": 7, "seed": 42}
+        a = dump_replay_bundle(str(tmp_path / "a"), batch, meta)
+        b = dump_replay_bundle(str(tmp_path / "b"), batch, meta)
+        for fname in sorted(os.listdir(a)):
+            with open(os.path.join(a, fname), "rb") as fa, \
+                    open(os.path.join(b, fname), "rb") as fb:
+                assert fa.read() == fb.read(), fname
+        got_meta, arrays = load_replay_bundle(a)
+        assert got_meta["step"] == 7
+        # bf16 widened value-exactly, provenance recorded
+        assert arrays["video"].dtype == np.float32
+        assert got_meta["arrays"]["video"]["source_dtype"] == "bfloat16"
+        np.testing.assert_array_equal(
+            arrays["video"],
+            np.arange(24, dtype=np.float32).reshape(2, 3, 4))
+        np.testing.assert_array_equal(arrays["label"], [1, 2])
+
+    def test_redump_replaces_atomically(self, tmp_path):
+        path = str(tmp_path / "bundle")
+        dump_replay_bundle(path, {"x": np.ones(3)}, {"step": 1})
+        dump_replay_bundle(path, {"y": np.zeros(2)}, {"step": 2})
+        meta, arrays = load_replay_bundle(path)
+        assert meta["step"] == 2 and set(arrays) == {"y"}
+        assert not [d for d in os.listdir(tmp_path) if ".tmp" in d]
+
+
+# --- quarantine -------------------------------------------------------------
+
+class TestQuarantine:
+    def test_budget_then_persistence_round_trip(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.data.manifest import Quarantine
+
+        sidecar = str(tmp_path / "q.json")
+        q = Quarantine(sidecar, budget=3)
+        err = IOError("moov atom not found")
+        assert q.record("/d/bad.mp4", err) is False
+        assert q.record("/d/bad.mp4", err) is False
+        assert not q.contains("/d/bad.mp4")
+        assert q.record("/d/bad.mp4", err) is True  # budget crossed
+        assert q.contains("/d/bad.mp4")
+        assert q.record("/d/bad.mp4", err) is False  # idempotent after
+        # a FRESH object over the same sidecar sees both the quarantined
+        # path and pending under-budget counts
+        q2 = Quarantine(sidecar, budget=3)
+        assert q2.contains("/d/bad.mp4")
+        assert len(q2) == 1
+        q2.record("/d/other.mp4", err)
+        snap = Quarantine(sidecar, budget=3).snapshot()
+        assert snap["failures_under_budget"] == {"/d/other.mp4": 1}
+        assert "/d/bad.mp4" in snap["quarantined"]
+
+    def test_unreadable_sidecar_starts_fresh(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.data.manifest import Quarantine
+
+        sidecar = tmp_path / "q.json"
+        sidecar.write_text("{not json")
+        q = Quarantine(str(sidecar), budget=1)
+        assert len(q) == 0  # never a reason to refuse to train
+
+    def test_substitute_indices_deterministic_and_clean(self):
+        from pytorchvideo_accelerate_tpu.data.samplers import (
+            substitute_indices,
+        )
+
+        idx = np.arange(10)
+        out1 = substitute_indices(idx, {2, 7}, 10, seed=3, epoch=1)
+        out2 = substitute_indices(idx, {2, 7}, 10, seed=3, epoch=1)
+        np.testing.assert_array_equal(out1, out2)
+        assert len(out1) == 10  # epoch geometry unchanged
+        assert not ({2, 7} & set(out1.tolist()))
+        # untouched positions keep their original index
+        keep = [i for i in range(10) if idx[i] not in (2, 7)]
+        np.testing.assert_array_equal(out1[keep], idx[keep])
+        # all-excluded degenerates to the original (nothing clean)
+        np.testing.assert_array_equal(
+            substitute_indices(idx, set(range(10)), 10, 3, 1), idx)
+
+
+# --- the guard ladder + LKG ring -------------------------------------------
+
+def _tiny_state():
+    import jax.numpy as jnp
+    import optax
+
+    from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
+
+    return TrainState.create({"w": jnp.ones((4,))}, {}, optax.sgd(0.1))
+
+
+def _run_guard(guard, metrics_seq, start_step=0):
+    """Feed a synthetic metric stream through the per-step hook the way
+    fit() does (stash step N, observe it at N+1)."""
+    from pytorchvideo_accelerate_tpu.data.pipeline import LoaderState
+
+    state = _tiny_state()
+    actions = []
+    for i, m in enumerate(metrics_seq):
+        gstep = start_step + i + 1
+        pos = LoaderState(epoch=0, position=gstep)
+        batch = {"video": np.full((2, 2), m["loss"], np.float32)}
+        actions.append(guard.step(gstep, m, batch, pos, state))
+    return actions
+
+
+class TestGuardLadder:
+    def _guard(self, tmp_path, **over):
+        kw = dict(enabled=True, lkg_every_steps=2, lkg_keep=2,
+                  rollback_after=2, max_rollbacks=1, warmup_steps=1000)
+        kw.update(over)
+        return TrainGuard(GuardConfig(**kw), output_dir=str(tmp_path),
+                          seed=1)
+
+    @staticmethod
+    def _m(loss):
+        return {"loss": loss, "grad_norm": abs(loss)}
+
+    def test_skip_then_rollback_to_lkg(self, tmp_path):
+        g = self._guard(tmp_path)
+        healthy = [self._m(1.0)] * 5
+        bad = [self._m(float("nan"))] * 2
+        actions = _run_guard(g, healthy + bad + [self._m(1.0)])
+        assert g.lkg_step is not None and g.lkg_step <= 6
+        assert g.skips == 1  # streak 1 = skip (in-graph skip covered it)
+        rollbacks = [a for a in actions if a is not None]
+        assert len(rollbacks) == 1
+        a = rollbacks[0]
+        assert a.kind == "rollback" and a.lkg_step == g.lkg_step
+        # the resume position is the ANOMALOUS batch's consumed position:
+        # the poisoned span is skipped, nothing else
+        assert a.resume_position["position"] == a.resume_position["epoch"] * 0 + 7
+        assert a.bundle_path and os.path.isdir(a.bundle_path)
+
+    def test_halt_after_max_rollbacks(self, tmp_path):
+        g = self._guard(tmp_path)
+        # the trailing healthy step exists because observation lags
+        # dispatch by one (the deferred-fetch discipline)
+        _run_guard(g, [self._m(1.0)] * 4 + [self._m(float("nan"))] * 2
+                   + [self._m(1.0)])
+        assert g.rollbacks == 1
+        with pytest.raises(GuardHalt, match="rollback"):
+            _run_guard(g, [self._m(float("nan"))] * 4, start_step=10)
+
+    def test_halt_when_no_lkg_exists(self, tmp_path):
+        g = self._guard(tmp_path, lkg_every_steps=1000)
+        with pytest.raises(GuardHalt, match="no last-known-good"):
+            _run_guard(g, [self._m(float("nan"))] * 4)
+
+    def test_lkg_ring_pruned_to_keep(self, tmp_path):
+        g = self._guard(tmp_path, lkg_every_steps=1, lkg_keep=2)
+        _run_guard(g, [self._m(1.0)] * 6)
+        g._checkpointer().wait()
+        ring = g.ring_steps()
+        assert len(ring) <= 2, ring  # orbax max_to_keep pruning
+        assert g.lkg_step == max(ring)
+        g.close()
+
+    def test_lkg_requires_healthy_window(self, tmp_path):
+        """Once an anomaly is OBSERVED, the ring must not advance until a
+        full healthy cadence window has passed — and must resume advancing
+        after recovery. (Advance decisions lag dispatch by one observation,
+        the guard's documented exposure; the in-graph skip is why that
+        step can never be nonfinite-poisoned.)"""
+        g = self._guard(tmp_path, lkg_every_steps=3, rollback_after=100,
+                        max_rollbacks=100)
+        _run_guard(g, [self._m(1.0)] * 4)
+        assert g.lkg_step is not None
+        _run_guard(g, [self._m(float("nan"))] * 2, start_step=4)
+        stuck = g.lkg_step
+        # sustained anomalies: no advance through the unhealthy window
+        _run_guard(g, [self._m(float("nan"))] * 8, start_step=6)
+        assert g.lkg_step == stuck
+        # recovery: a full healthy window re-opens the ring
+        _run_guard(g, [self._m(1.0)] * 8, start_step=14)
+        assert g.lkg_step > stuck
+        g.close()
+
+    def test_snapshot_shape(self, tmp_path):
+        g = self._guard(tmp_path)
+        _run_guard(g, [self._m(1.0)] * 3 + [self._m(float("nan"))]
+                   + [self._m(1.0)])
+        snap = guard_snapshot(str(tmp_path))
+        assert snap["armed"] is True
+        assert snap["lkg_step"] == g.lkg_step
+        assert snap["last_verdict"]["kind"] == "nonfinite"
+        assert snap["replay_bundles"] == ["step_4"]
+        g.close()
+
+
+# --- in-graph nonfinite skip ------------------------------------------------
+
+class TestInGraphSkip:
+    def _step(self, mesh, guard_skip):
+        import jax.numpy as jnp
+        import optax
+
+        from pytorchvideo_accelerate_tpu.trainer.steps import (
+            _make_update_step,
+        )
+
+        tx = optax.sgd(0.1)
+
+        def grad_fn(params, batch_stats, batch, key):
+            # loss/grads poisoned by the batch's own content: a NaN batch
+            # produces NaN loss and NaN grads, like a real divergence
+            scale = jnp.mean(batch["video"])
+            loss = jnp.sum(params["w"]) * 0.0 + scale
+            grads = {"w": jnp.ones_like(params["w"]) * scale}
+            return (loss, ({}, jnp.zeros(()), jnp.ones(()))), grads
+
+        step = _make_update_step(grad_fn, tx, mesh, accum_steps=1,
+                                 lr_schedule=None, with_accuracy=False,
+                                 guard_skip=guard_skip)
+        return step, tx
+
+    def test_nonfinite_update_discarded(self, mesh8):
+        import jax
+        import jax.numpy as jnp
+
+        step, _ = self._step(mesh8, guard_skip=True)
+        state = _tiny_state()
+        good = {"video": np.full((8, 2), 0.5, np.float32)}
+        bad = {"video": np.full((8, 2), np.nan, np.float32)}
+        key = jax.random.key(0)
+
+        s1, m1 = step(state, good, key)
+        assert float(m1["skipped"]) == 0.0
+        # fetched BEFORE the next call: the step donates its input state
+        w_after_good = np.asarray(s1.params["w"]).copy()
+        step_after_good = int(s1.step)
+        s2, m2 = step(s1, bad, key)
+        assert float(m2["skipped"]) == 1.0
+        assert not np.isfinite(float(m2["loss"]))
+        # params, optimizer state untouched; only the step counter moved
+        np.testing.assert_array_equal(np.asarray(s2.params["w"]),
+                                      w_after_good)
+        assert int(s2.step) == step_after_good + 1
+        # and the state is still healthy: the next good step trains
+        s3, m3 = step(s2, good, key)
+        assert float(m3["skipped"]) == 0.0
+        assert np.isfinite(np.asarray(s3.params["w"])).all()
+
+    def test_disarmed_has_no_skip_branch(self, mesh8):
+        import jax
+
+        step, _ = self._step(mesh8, guard_skip=False)
+        state = _tiny_state()
+        _s, m = step(state, {"video": np.full((8, 2), 0.5, np.float32)},
+                     jax.random.key(0))
+        assert "skipped" not in m  # structurally absent, not merely 0
+
+    def test_poison_batch_floats_only(self):
+        import jax.numpy as jnp
+
+        batch = {"video": jnp.ones((2, 3), jnp.float32),
+                 "slow": jnp.ones((2, 3), jnp.uint8),
+                 "label": jnp.zeros((2,), jnp.int32)}
+        out = poison_batch(batch)
+        assert not np.isfinite(np.asarray(out["video"])).any()
+        np.testing.assert_array_equal(np.asarray(out["slow"]),
+                                      np.asarray(batch["slow"]))
+        np.testing.assert_array_equal(np.asarray(out["label"]),
+                                      np.asarray(batch["label"]))
+
+
+# --- truncated-checkpoint fallback (satellite) ------------------------------
+
+class TestCheckpointFallback:
+    def _save_two(self, tmp_path):
+        import jax.numpy as jnp
+
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+            Checkpointer,
+        )
+
+        state = _tiny_state()
+        ck = Checkpointer(str(tmp_path), use_async=False)
+        ck.save(1, state, {"kind": "step", "epoch": 0})
+        s2 = state.replace(params={"w": jnp.full((4,), 2.0)})
+        ck.save(2, s2, {"kind": "step", "epoch": 0})
+        ck.close()
+        return state
+
+    @staticmethod
+    def _truncate(tmp_path, step):
+        step_dir = os.path.join(str(tmp_path), str(step))
+        victims = []
+        for root, _dirs, files in os.walk(step_dir):
+            victims += [os.path.join(root, f) for f in files]
+        assert victims, "checkpoint layout changed?"
+        for f in victims:
+            os.remove(f)
+
+    def test_falls_back_to_previous_intact_step(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+            Checkpointer,
+        )
+
+        template = self._save_two(tmp_path)
+        self._truncate(tmp_path, 2)
+        ck = Checkpointer(str(tmp_path), use_async=False)
+        state, _extra, step = ck.restore(template)
+        assert step == 1  # warned + walked back, not a raw orbax traceback
+        np.testing.assert_array_equal(np.asarray(state.params["w"]),
+                                      np.ones(4))
+        ck.close()
+
+    def test_clean_error_when_no_intact_step(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+            Checkpointer,
+        )
+
+        template = self._save_two(tmp_path)
+        self._truncate(tmp_path, 1)
+        self._truncate(tmp_path, 2)
+        ck = Checkpointer(str(tmp_path), use_async=False)
+        with pytest.raises(Exception, match="checkpoint"):
+            ck.restore(template)
+        ck.close()
+
+    def test_guard_ring_delete(self, tmp_path):
+        from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
+            Checkpointer,
+        )
+
+        state = _tiny_state()
+        ck = Checkpointer(str(tmp_path), use_async=False)
+        ck.save(1, state, {})
+        ck.save(2, state, {})
+        ck.delete(1)
+        assert ck.all_steps() == [2]
+        ck.close()
+
+
+# --- labeled counters (satellite) -------------------------------------------
+
+class TestCounterLabels:
+    def test_counter_label_surface(self):
+        from pytorchvideo_accelerate_tpu.obs.registry import Registry
+
+        reg = Registry()
+        c = reg.counter("pva_test_events_total", "events by site",
+                        labelnames=("site",))
+        c.inc(site="decode")
+        c.inc(2, site="train")
+        assert c.value(site="decode") == 1
+        assert c.total() == 3
+        rendered = reg.render()
+        assert 'pva_test_events_total{site="decode"} 1' in rendered
+        assert 'pva_test_events_total{site="train"} 2' in rendered
+        with pytest.raises(ValueError):
+            c.inc(wrong="x")
+        assert dict((tuple(l.items()), v) for l, v in c.samples()) == {
+            (("site", "decode"),): 1.0, (("site", "train"),): 2.0}
+
+    def test_guard_and_quarantine_counters_are_labeled(self, tmp_path):
+        """The PR-9 counters land as labeled families, not name-mangled
+        metric names (the `pva_retry_*{op=}` discipline)."""
+        from pytorchvideo_accelerate_tpu.data.manifest import Quarantine
+        from pytorchvideo_accelerate_tpu.obs import get_registry
+
+        q = Quarantine(str(tmp_path / "q.json"), budget=1)
+        q.record("/x/clip.mp4", IOError("boom"))
+        c = get_registry().get("pva_data_quarantined_total")
+        assert c is not None and c.labelnames == ("site",)
+        assert c.value(site="decode") >= 1
+        g = self._ladder_guard(tmp_path)
+        _run_guard(g, [{"loss": 1.0, "grad_norm": 1.0}] * 3
+                   + [{"loss": float("nan"), "grad_norm": 1.0}]
+                   + [{"loss": 1.0, "grad_norm": 1.0}])
+        ev = get_registry().get("pva_guard_events_total")
+        assert ev is not None and ev.labelnames == ("action",)
+        assert ev.value(action="skip") >= 1
+        g.close()
+
+    @staticmethod
+    def _ladder_guard(tmp_path):
+        cfg = GuardConfig(enabled=True, lkg_every_steps=2, lkg_keep=2,
+                          rollback_after=5, max_rollbacks=1,
+                          warmup_steps=1000)
+        return TrainGuard(cfg, output_dir=str(tmp_path / "g"), seed=1)
+
+
+# --- watchdog sections / collective attribution -----------------------------
+
+class TestCollectiveHangDetection:
+    def test_section_attributes_a_stall(self):
+        from pytorchvideo_accelerate_tpu.obs.watchdog import Watchdog
+
+        wd = Watchdog(0.05, poll_s=10.0)  # driven manually via check()
+        with wd.section("collective", "psum host=0/4 step=12"):
+            time.sleep(0.12)
+            stalled = wd.check()
+        assert stalled == ["collective"]
+        detail, age = wd.last_attribution["collective"]
+        assert "psum" in detail and "host=0/4" in detail
+        assert age >= 0.05
+        # after exit the component is CLEARED: idle != stalled
+        assert wd.check() == []
+
+    def test_clean_sections_never_fire(self):
+        from pytorchvideo_accelerate_tpu.obs.watchdog import Watchdog
+
+        wd = Watchdog(0.5, poll_s=10.0)
+        for i in range(3):
+            with wd.section("collective", f"psum step={i}"):
+                pass
+        assert wd.check() == []
+
+    def test_collective_section_passthrough_without_watchdog(self):
+        from pytorchvideo_accelerate_tpu.parallel import hangcheck
+
+        hangcheck.uninstall_collective_watch()
+        with hangcheck.collective_section("psum", step=1):
+            pass  # no watchdog installed: straight through
+
+    def test_collective_section_reports_through_installed_watchdog(self):
+        from pytorchvideo_accelerate_tpu.obs.watchdog import Watchdog
+        from pytorchvideo_accelerate_tpu.parallel import hangcheck
+
+        wd = Watchdog(0.05, poll_s=10.0)
+        hangcheck.install_collective_watch(wd)
+        try:
+            with hangcheck.collective_section("host_broadcast", step=3):
+                time.sleep(0.12)
+                assert wd.check() == ["collective"]
+            detail, _age = wd.last_attribution["collective"]
+            assert "host_broadcast" in detail and "host=" in detail
+            assert "step=3" in detail
+        finally:
+            hangcheck.uninstall_collective_watch()
+
+
+# --- config surface ---------------------------------------------------------
+
+def test_guard_config_cli_round_trip():
+    cfg = parse_cli(["--guard.enabled", "--guard.lkg_every_steps", "7",
+                     "--guard.policy", "spike"])
+    assert cfg.guard.enabled is True
+    assert cfg.guard.lkg_every_steps == 7
+    assert cfg.guard.policy == "spike"
+    with pytest.raises(SystemExit, match="guard"):
+        parse_cli(["--guard.typo_knob", "1"])
+
+
+def test_doctor_diagnose_carries_guard_snapshot(tmp_path):
+    from pytorchvideo_accelerate_tpu.utils import device_doctor
+
+    rec = device_doctor.diagnose(skip_init=True, obs_dir=str(tmp_path))
+    assert "guard" in rec
+    assert "armed" in rec["guard"]
