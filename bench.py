@@ -737,14 +737,97 @@ def bench_transport_crossover(args) -> dict:
 
 
 # SERVE_FLEET smoke sizing: (replicas, forced CPU devices for the child,
-# offered rps, arrival window s, p99 SLO ms). Module-level so the contract
-# test can shrink it; the SLO is generous for a CPU child that compiles
-# tiny3d buckets while serving — the lane proves the fleet machinery, the
-# absolute numbers are honest smoke numbers.
+# offered rps, arrival window s, p99 SLO ms, head-sampling rate for the
+# lane's distributed traces). Module-level so the contract test can shrink
+# it; the SLO is generous for a CPU child that compiles tiny3d buckets
+# while serving — the lane proves the fleet machinery, the absolute
+# numbers are honest smoke numbers.
 FLEET_SMOKE = dict(replicas=2, devices=2, rate_rps=20.0, duration_s=4.0,
-                   slo_p99_ms=2500.0)
+                   slo_p99_ms=2500.0, trace_sample=0.5)
 FLEET_FULL = dict(replicas=2, devices=0, rate_rps=100.0, duration_s=10.0,
-                  slo_p99_ms=500.0)
+                  slo_p99_ms=500.0, trace_sample=0.1)
+
+# subprocess body for the fleet lane's TRACED process replica: the shared
+# stub engine (host-side forward, no model compile) behind the real
+# Scheduler + InferenceServer with tracing armed, so a request routed here
+# crosses a REAL process boundary (router -> traceparent HTTP hop ->
+# replica scheduler -> engine dispatch) and its trace ring lands in
+# {outdir}/trace_ring.json on SIGTERM-drain — the multi-process half of
+# the merged fleet timeline. One JSON line {{"url": ...}} once bound.
+_TRACE_SRV_CODE = """
+import json
+from pytorchvideo_accelerate_tpu.obs import trace as obstrace
+obstrace.configure_tracing(1.0, seed=0, capacity=8192, output_dir={outdir!r})
+from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+from pytorchvideo_accelerate_tpu.serving.server import InferenceServer
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+from pytorchvideo_accelerate_tpu.serving.stub import StubEngine
+
+engine = StubEngine(forward_s=0.002, num_classes=16)
+engine.model_name = "trace-stub"
+stats = ServingStats(window=512)
+sched = Scheduler(engine, stats=stats, max_queue=256,
+                  realtime_deadline_ms=30000.0)
+srv = InferenceServer(engine, sched, stats, host="127.0.0.1", port=0,
+                      request_timeout_s=30.0)
+host, port = srv.address
+print(json.dumps({{"url": "http://%s:%d" % (host, port)}}), flush=True)
+srv.serve_forever(drain_on_sigterm=True)
+"""
+
+
+def _spawn_traced_replica(outdir: str, startup_timeout_s: float = 120.0):
+    """Start the traced stub serving process; returns (Popen, HttpReplica).
+    Uses the shared wedge-safe bind-line reader (fleet/pool.py) — a child
+    that wedges before binding fails the lane, never hangs it."""
+    import atexit
+    import shutil
+
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        HttpReplica,
+        read_line_with_deadline,
+    )
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _TRACE_SRV_CODE.format(outdir=outdir)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.DEVNULL,
+        text=True)
+
+    def reap():
+        # a lane failure between spawn and the trace-collection teardown
+        # propagates straight out of bench_fleet; the bench child then
+        # exits, but this SUBPROCESS would be reparented to init and serve
+        # forever — reap it (idempotent: the normal path already waited)
+        if proc.poll() is None:
+            try:
+                proc.kill()
+                proc.wait(timeout=10)
+            except Exception:  # noqa: BLE001 - best-effort at interpreter exit
+                pass
+        shutil.rmtree(outdir, ignore_errors=True)
+
+    atexit.register(reap)
+    # match on the URL payload so a stray library line on the child's
+    # stdout (a warning, a banner) can't be mistaken for the bind line;
+    # ANY failure from here kills the child — it must not idle on its
+    # port until the atexit reaper while the lane runs degraded
+    try:
+        line, eof = read_line_with_deadline(proc, startup_timeout_s,
+                                            match='"url"',
+                                            name="fleet-trace-read")
+        if not (line or "").strip():
+            raise RuntimeError(
+                f"traced replica "
+                f"{'closed stdout' if eof else 'produced no URL'} within "
+                f"{startup_timeout_s}s (exit={proc.poll()})")
+        url = json.loads(line)["url"]
+    except Exception:
+        proc.kill()
+        raise
+    return proc, HttpReplica("trace-proc", url, pid=proc.pid,
+                             timeout_s=30.0)
 
 
 def bench_fleet(args) -> dict:
@@ -761,8 +844,18 @@ def bench_fleet(args) -> dict:
     - zero failed (non-shed) requests across the whole run, INCLUDING the
       mid-load swap — sheds are policy, failures are bugs;
     - the swap measurably cut over: post-swap logits differ from pre-swap
-      logits for the same probe clip (params are scaled on export).
+      logits for the same probe clip (params are scaled on export);
+    - distributed tracing (obs/trace.py) is ARMED for the lane: in smoke
+      a third, traced stub-engine replica runs as a REAL separate process,
+      the lane merges its trace ring with the child's into
+      fleet_trace.json (`pva-tpu-trace` machinery), and ≥1 sampled request
+      demonstrably spans router → HTTP hop → replica scheduler → engine
+      dispatch across the process boundary (`trace_linked`), with the
+      tracer's self-measured overhead under 2% of the run
+      (`trace_overhead_frac`).
     """
+    import shutil
+    import tempfile
     import threading
 
     import jax
@@ -786,11 +879,18 @@ def bench_fleet(args) -> dict:
     )
     from pytorchvideo_accelerate_tpu.trainer.train_state import TrainState
 
+    from pytorchvideo_accelerate_tpu.obs import trace as obstrace
+    from pytorchvideo_accelerate_tpu.obs import tracetool
+
     shape = FLEET_SMOKE if args.smoke else FLEET_FULL
     frames, crop = (4, 32) if args.smoke else (8, 64)
     num_classes = 16
     devices = jax.devices()
     platform = devices[0].platform
+    # tracing ARMED for the whole lane (head-sampled requests + forced
+    # probes); the ring merges with the traced process replica's below
+    tracer = obstrace.configure_tracing(shape["trace_sample"], seed=0,
+                                        capacity=16384)
     # the acceptance bar is >= 2 replicas; on a 1-device host they share
     # the device (distinct engines/executables), on the forced-host slice
     # and real multi-chip they land on disjoint single-device meshes
@@ -830,14 +930,31 @@ def bench_fleet(args) -> dict:
                           realtime_deadline_ms=shape["slo_p99_ms"] * 4,
                           batch_max_wait_ms=5.0, name=f"r{i}")
         replicas.append(LocalReplica(f"r{i}", sched))
+    # in smoke, a third replica is a REAL traced serving process (stub
+    # engine, no compile): requests routed there cross the traceparent
+    # HTTP hop, making the merged trace genuinely multi-process. It joins
+    # the pool only AFTER the open-loop window — its JSON serialization
+    # would otherwise contend with the arrival thread and slip the
+    # schedule (open_loop_ok) the lane exists to keep honest — and the
+    # weight-swap probes pin to replicas[0] (a LocalReplica the hot-swap
+    # actually cuts over), so the stub cannot contaminate them either.
+    trace_proc = None
+    trace_dir = None
+    trace_replica = None
+    if args.smoke:
+        trace_dir = tempfile.mkdtemp(prefix="pva_fleet_trace_")
+        try:
+            trace_proc, trace_replica = _spawn_traced_replica(trace_dir)
+            log(f"[fleet] traced process replica at {trace_replica.url} "
+                f"(pid {trace_proc.pid})")
+        except Exception as e:  # noqa: BLE001 - lane degrades, smoke asserts catch it
+            log(f"[fleet] traced process replica failed to start: {e}")
     pool = ReplicaPool(replicas, health_interval_s=0.25)
     router = Router(pool)
 
     # the green checkpoint: same model, deterministically different weights
     # (scaled), exported through the REAL artifact path so the swap
     # exercises from_artifact -> pre-warm -> cutover end to end
-    import tempfile
-
     art_dir = tempfile.mkdtemp(prefix="pva_fleet_swap_")
     green_params = jax.tree.map(lambda x: x * 1.25, params)
     export_inference(
@@ -845,7 +962,7 @@ def bench_fleet(args) -> dict:
         config=cfg, meta={"num_classes": num_classes, "model": "tiny3d"})
 
     pre_logits = np.asarray(
-        router.submit(base_clip).result(timeout=60), np.float32)
+        replicas[0].submit(base_clip).result(timeout=60), np.float32)
 
     swap_out: dict = {}
     gen = LoadGen(router.submit, rate_rps=shape["rate_rps"],
@@ -863,16 +980,91 @@ def bench_fleet(args) -> dict:
 
     st = threading.Thread(target=swapper, daemon=True)
     st.start()
+    run_wall = None
     try:
+        t_run0 = time.perf_counter()
         report = gen.run()
+        load_wall = time.perf_counter() - t_run0
         st.join(timeout=300.0)
+        # the traced process replica joins the rotation now (post-load):
+        # list append is safe against the poller's iteration, and the
+        # fresh member is routable immediately (never marked down)
+        if trace_replica is not None:
+            pool.replicas.append(trace_replica)
+        # forced-sample probes (head sampling bypassed — debug traces):
+        # the idle router rotates ties round-robin, so 4 probes guarantee
+        # every pool member, INCLUDING the traced process replica, serves
+        # at least one fully-sampled request
+        t_probe0 = time.perf_counter()
+        for i in range(4):
+            h = tracer.start("trace_probe", force=True, seq=i)
+            try:
+                with h:
+                    router.submit(base_clip).result(timeout=60)
+            except Exception as e:  # noqa: BLE001 - probe failure is lane evidence
+                log(f"[fleet] trace probe {i} failed: {e}")
+        # overhead denominator: the phases that actually carried traced
+        # traffic (load window + probe burst) — including the idle
+        # swap-join wait would deflate the fraction the smoke gate checks
+        run_wall = max(load_wall + time.perf_counter() - t_probe0, 1e-6)
         post_logits = np.asarray(
-            router.submit(base_clip).result(timeout=60), np.float32)
+            replicas[0].submit(base_clip).result(timeout=60), np.float32)
     finally:
-        import shutil
-
         router.close()
         shutil.rmtree(art_dir, ignore_errors=True)
+    # --- trace collection: SIGTERM-drain the process replica (its ring
+    # dumps to trace_dir/trace_ring.json), merge with this process's ring
+    # into one timeline, and verify the cross-process linkage ------------
+    trace_out: dict = {}
+    try:
+        payloads = [tracer.export()]
+        if trace_proc is not None:
+            try:
+                trace_proc.send_signal(signal.SIGTERM)
+                trace_proc.wait(timeout=60)
+            except Exception:  # noqa: BLE001 - a wedged drain must not hang the lane
+                trace_proc.kill()
+                trace_proc.wait()
+            ring_path = os.path.join(trace_dir, "trace_ring.json")
+            try:
+                with open(ring_path) as f:
+                    payloads.append(json.load(f))
+            except (OSError, ValueError) as e:
+                log(f"[fleet] traced replica ring unreadable: {e}")
+        merged = tracetool.merge_exports(payloads)
+        merged_path = os.path.join(HERE, "fleet_trace.json")
+        with open(merged_path, "w") as f:
+            json.dump(merged, f)
+        summary = tracetool.summarize(merged)
+        tstats = tracer.stats()
+        # ≥1 sampled request spanning router->replica->engine ACROSS the
+        # process boundary: a trace with events from >=2 pids that reaches
+        # an engine-side device_dispatch
+        linked = tracetool.linked_traces(
+            merged, require_names=("device_dispatch",), min_pids=2)
+        trace_out = {
+            "trace_sampled": int(tstats["sampled"]),
+            # head-sampled = sampled minus forced debug probes: the number
+            # that proves the obs.trace_sample_rate decision stream works
+            # (the probes alone would trivially satisfy a >=1 assert)
+            "trace_head_sampled": int(tstats["sampled"]
+                                      - tstats["forced"]),
+            "trace_overhead_frac": round(
+                tstats["overhead_s"] / run_wall, 5) if run_wall else None,
+            "trace_linked": bool(linked) if args.smoke else None,
+            "trace_events": summary["events"],
+            "trace_multiprocess": summary["traces_multiprocess"],
+            "trace_export": merged_path,
+        }
+        log(f"[fleet] trace: {summary['events']} events over "
+            f"{summary['traces']} traces from pids {summary['pids']}, "
+            f"{len(linked)} cross-process linked")
+    except Exception as e:  # noqa: BLE001 - trace plumbing must not sink the lane
+        log(f"[fleet] trace collection failed: {type(e).__name__}: {e}")
+    finally:
+        if trace_dir:
+            shutil.rmtree(trace_dir, ignore_errors=True)
+        obstrace.disable_tracing()
     fleet_snap = router.fleet_snapshot()
     swapped = not np.allclose(pre_logits, post_logits, atol=1e-6)
     out = {
@@ -895,6 +1087,7 @@ def bench_fleet(args) -> dict:
         # measurement — refuse to headline (finalize drops the perf keys)
         "suspect": platform == "cpu" and not args.smoke,
     }
+    out.update(trace_out)
     if "error" in swap_out:
         out["error"] = f"hot-swap failed: {swap_out['error']}"
     log(f"[fleet] {json.dumps(out)}")
@@ -1351,7 +1544,8 @@ def main():
                 "(cpu fallback); see bench_partial.json")
         else:
             for key in ("serve_rps", "serve_p99_ms_under_load",
-                        "swap_blackout_ms", "fleet_shed_frac"):
+                        "swap_blackout_ms", "fleet_shed_frac",
+                        "trace_sampled", "trace_overhead_frac"):
                 if fl.get(key) is not None:
                     extras[key] = fl[key]
         flush_partial()
@@ -1474,6 +1668,23 @@ def main():
             "slo_p99_ms", float("inf")), (
             f"serve_p99_ms_under_load {extras['serve_p99_ms_under_load']} "
             f"ms breaches the {fl.get('slo_p99_ms')} ms SLO: {fl}")
+        # distributed-tracing acceptance (docs/OBSERVABILITY.md § tracing):
+        # the lane ran traced, at least one request was head-sampled, the
+        # merged multi-process timeline links router->replica->engine
+        # across the process boundary, and the tracer's self-measured
+        # bookkeeping stayed under 2% of the run's wall time
+        assert fl.get("trace_sampled", 0) >= 1, (
+            f"fleet lane sampled no traces: {fl}")
+        assert fl.get("trace_head_sampled", 0) >= 1, (
+            "head-based sampling produced no traces (only forced probes "
+            f"recorded — the obs.trace_sample_rate path is broken): {fl}")
+        assert fl.get("trace_linked") is True, (
+            "no sampled request spans router->replica->engine across "
+            f"processes in the merged trace: {fl}")
+        overhead = fl.get("trace_overhead_frac")
+        assert overhead is not None and overhead < 0.02, (
+            f"tracing overhead {overhead} is not under 2% of run wall "
+            f"time: {fl}")
     extras["headline"] = headline  # full record keeps the compact line too
     flush_partial()
     print(json.dumps(headline))
@@ -1606,9 +1817,12 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     mc_perf = ("multichip_cps_per_chip", "multichip_forced_host",
                "multichip_mfu")
     # fleet-lane perf keys obey the same refusal rule: a fleet_error (cpu
-    # fallback or a failed lane) headlines INSTEAD of the numbers
+    # fallback or a failed lane) headlines INSTEAD of the numbers; the
+    # trace verdicts (sampled count + tracer overhead fraction) ride with
+    # them — they come from the same lane and are meaningless without it
     fleet_perf = ("serve_rps", "serve_p99_ms_under_load",
-                  "swap_blackout_ms", "fleet_shed_frac")
+                  "swap_blackout_ms", "fleet_shed_frac",
+                  "trace_sampled", "trace_overhead_frac")
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "trainer_input_wait_frac", "obs_step_s",
                 "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
@@ -1664,7 +1878,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     # ever exceeding the driver's capture window; the per-model map and
     # the truncations are LAST resorts (dropping a lane's optional extras
     # must never cost the models summary)
-    for k in ("probes", "multichip_mfu", "multichip_forced_host",
+    for k in ("probes", "trace_overhead_frac", "trace_sampled",
+              "multichip_mfu", "multichip_forced_host",
               "multichip_train_recompiles", "multichip_error",
               "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
               "fleet_error", "fleet_shed_frac", "swap_blackout_ms",

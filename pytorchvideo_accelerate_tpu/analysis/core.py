@@ -178,11 +178,14 @@ def default_rules() -> List[Rule]:
         ThreadFactoryRule,
         ThreadJoinRule,
     )
+    from pytorchvideo_accelerate_tpu.analysis.rules_trace import (
+        TracePropagationRule,
+    )
     from pytorchvideo_accelerate_tpu.analysis.rules_tracer import TracerLeakRule
 
     return [HostSyncRule(), RecompileHazardRule(), LockDisciplineRule(),
             TracerLeakRule(), SpanDisciplineRule(), ThreadFactoryRule(),
-            ThreadJoinRule(), MeshDisciplineRule()]
+            ThreadJoinRule(), MeshDisciplineRule(), TracePropagationRule()]
 
 
 def parse_module(source: str, path: str) -> ModuleInfo:

@@ -1,0 +1,221 @@
+"""Rule `trace-propagation`: cross-thread handoffs must carry trace context.
+
+The distributed tracer (obs/trace.py) only works if every cross-thread
+handoff on the hot path ships the active `TraceContext` along with the
+payload: the producer calls `trace.capture()` and the consumer re-enters it
+with `trace.attach(...)` (or `Tracer.activate`). A handoff that forgets
+either half silently TRUNCATES every trace flowing through it — the worst
+observability bug, because nothing errors; the merged timeline just stops
+at that hop and the p99 you were chasing dereferences to nothing.
+
+What counts as a handoff site, inside the traced hot modules
+(`TRACE_HANDOFF_MODULES` = rules_host_sync.HOT_MODULES + the fleet tier):
+
+- `make_thread(...)` — a worker thread is born without its parent's
+  context unless the target was handed a captured one;
+- `.put(...)` / `.put_nowait(...)` on a queue BOUND from `make_queue(...)`
+  in the same module — the payload crosses threads here.
+
+The rule fires on every such site when the MODULE contains no call to the
+capture/attach helpers at all (a module that propagates anywhere is
+assumed to have made a deliberate choice per site; one that never imports
+the helpers has simply not been wired). Alias-proof like `thread-factory`:
+`import utils.sync as s; s.make_thread(...)`, from-import as-names of
+`make_thread`/`make_queue`, and `from ...obs import trace as t` /
+`from ...obs.trace import capture as grab` are all resolved.
+
+Escapes: wire the handoff (preferred), or suppress a genuinely
+context-free handoff with `# pva: disable=trace-propagation -- <why>`
+(e.g. a health poller that carries no request).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+)
+from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HOT_MODULES
+
+# the hot modules PLUS the fleet tier's handoff surfaces (scheduler queue,
+# router dispatch, replica pool worker threads)
+TRACE_HANDOFF_MODULES: Tuple[str, ...] = HOT_MODULES + (
+    "fleet/scheduler.py",
+    "fleet/router.py",
+    "fleet/pool.py",
+    "fleet/loadgen.py",
+)
+
+# helper call tails that prove the module participates in propagation
+_HELPER_TAILS = ("capture", "attach", "activate")
+
+
+def _sync_aliases(tree: ast.AST, names: Tuple[str, ...]) -> Dict[str, str]:
+    """Local name -> factory name for from-imports of utils.sync (absolute
+    or relative): `from ...utils.sync import make_thread as mt`."""
+    out: Dict[str, str] = {}
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and (node.module == "sync"
+                     or node.module.endswith(".sync"))):
+            for alias in node.names:
+                if alias.name in names:
+                    out[alias.asname or alias.name] = alias.name
+    return out
+
+
+def _sync_module_aliases(tree: ast.AST) -> Set[str]:
+    """Every local name bound to the utils.sync MODULE: `import
+    pytorchvideo_accelerate_tpu.utils.sync as s` or
+    `from ...utils import sync [as s]`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("utils.sync"):
+                    out.add(alias.asname or alias.name.split(".")[0])
+        elif (isinstance(node, ast.ImportFrom) and node.module
+              and (node.module == "utils" or node.module.endswith(".utils"))):
+            for alias in node.names:
+                if alias.name == "sync":
+                    out.add(alias.asname or "sync")
+    return out
+
+
+def _trace_module_aliases(tree: ast.AST) -> Set[str]:
+    """Every local name bound to the obs.trace MODULE: `from ...obs import
+    trace [as t]` or `import ...obs.trace as t`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("obs.trace"):
+                    out.add(alias.asname or alias.name.split(".")[0])
+        elif (isinstance(node, ast.ImportFrom) and node.module
+              and (node.module == "obs" or node.module.endswith(".obs"))):
+            for alias in node.names:
+                if alias.name == "trace":
+                    out.add(alias.asname or "trace")
+    return out
+
+
+def _trace_helper_names(tree: ast.AST) -> Set[str]:
+    """Bare local names from-imported out of obs.trace whose originals are
+    propagation helpers: `from ...obs.trace import capture as grab`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.ImportFrom) and node.module
+                and (node.module == "trace"
+                     or node.module.endswith(".trace"))):
+            for alias in node.names:
+                if alias.name in _HELPER_TAILS:
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _factory_call_kind(node: ast.Call, fn_aliases: Dict[str, str],
+                       mod_aliases: Set[str]) -> str:
+    """"make_thread"/"make_queue" when this call constructs one (bare
+    alias or dotted through a sync-module alias), else ""."""
+    dn = call_name(node)
+    if dn in fn_aliases:
+        return fn_aliases[dn]
+    if "." in dn:
+        head, tail = dn.rsplit(".", 1)
+        if head in mod_aliases and tail in ("make_thread", "make_queue"):
+            return tail
+    return ""
+
+
+def _binding_name(node: ast.AST) -> str:
+    """Canonical assign-target name: "x" or "self.x" ("" otherwise)."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if (isinstance(node, ast.Attribute) and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return f"self.{node.attr}"
+    return ""
+
+
+class TracePropagationRule(Rule):
+    name = "trace-propagation"
+    description = ("make_thread/queue handoff in a traced hot module that "
+                   "drops the current trace context (module never calls "
+                   "trace.capture/attach)")
+
+    def __init__(self, modules: Tuple[str, ...] = TRACE_HANDOFF_MODULES):
+        self.modules = tuple(modules)
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not module.matches(self.modules):
+            return
+        tree = module.tree
+        fn_aliases = _sync_aliases(tree, ("make_thread", "make_queue"))
+        mod_aliases = _sync_module_aliases(tree)
+        trace_mods = _trace_module_aliases(tree)
+        helper_bare = _trace_helper_names(tree)
+
+        # does this module call ANY propagation helper?
+        propagates = False
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = call_name(node)
+            if dn in helper_bare:
+                propagates = True
+                break
+            if "." in dn:
+                head, tail = dn.rsplit(".", 1)
+                if head in trace_mods and tail in _HELPER_TAILS:
+                    propagates = True
+                    break
+        if propagates:
+            return
+
+        # queue bindings: names assigned from make_queue(...) module-wide
+        # (name-based, like thread-join's binding resolution — a parameter
+        # carrying the same name in a worker matches on purpose)
+        queue_names: Set[str] = set()
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Assign) and isinstance(node.value,
+                                                           ast.Call):
+                if _factory_call_kind(node.value, fn_aliases,
+                                      mod_aliases) == "make_queue":
+                    for tgt in node.targets:
+                        bn = _binding_name(tgt)
+                        if bn:
+                            queue_names.add(bn)
+                            if bn.startswith("self."):
+                                queue_names.add(bn[5:])
+
+        sites: List[Tuple[ast.AST, str]] = []
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _factory_call_kind(node, fn_aliases,
+                                  mod_aliases) == "make_thread":
+                sites.append((node, "make_thread(...) starts a worker"))
+                continue
+            f = node.func
+            if (isinstance(f, ast.Attribute)
+                    and f.attr in ("put", "put_nowait")):
+                base = _binding_name(f.value)
+                if base and (base in queue_names
+                             or f"self.{base}" in queue_names
+                             or (base.startswith("self.")
+                                 and base[5:] in queue_names)):
+                    sites.append(
+                        (node, f"`{base}.{f.attr}(...)` crosses threads"))
+        for node, what in sites:
+            yield self.finding(
+                module, node,
+                f"{what} in a traced hot module, but this module never "
+                "calls obs.trace capture/attach — every trace through "
+                "this handoff is silently truncated; capture() at the "
+                "producer and attach() at the consumer (or suppress a "
+                "genuinely context-free handoff with a reason)")

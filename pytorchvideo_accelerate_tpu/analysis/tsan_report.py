@@ -197,9 +197,18 @@ def _stress_batcher(watchdog, log: Callable[[str], None]) -> None:
     errors: List[str] = []
 
     def client(k: int):
+        from pytorchvideo_accelerate_tpu.obs import trace as obstrace
+
+        tracer = obstrace.get_tracer()
         for i in range(8):
             try:
-                fut = mb.submit(clip)
+                # traced submits: the request context crosses the batcher
+                # queue and the flush thread records under it, so the
+                # tracer's ring/lock traffic races real concurrency here
+                handle = (tracer.start(f"req-{k}", seq=i)
+                          if tracer is not None else None)
+                with (handle if handle is not None else obstrace.NOOP):
+                    fut = mb.submit(clip)
                 if i % 2 == 0:
                     fut.result(timeout=5.0)
             except Exception as e:  # noqa: BLE001 - late submits hit close()
@@ -258,10 +267,19 @@ def _stress_fleet(log: Callable[[str], None]) -> None:
     served: List[str] = []
 
     def client(k: int):
+        from pytorchvideo_accelerate_tpu.obs import trace as obstrace
+
+        tracer = obstrace.get_tracer()
         for i in range(8):
             try:
-                fut = router.submit(
-                    clip, priority=("batch" if (k + i) % 3 else "realtime"))
+                # traced routing: context rides router dispatch ->
+                # scheduler queue -> launch under hot-swap/membership churn
+                handle = (tracer.start(f"fleet-req-{k}", seq=i)
+                          if tracer is not None else None)
+                with (handle if handle is not None else obstrace.NOOP):
+                    fut = router.submit(
+                        clip,
+                        priority=("batch" if (k + i) % 3 else "realtime"))
                 if i % 2 == 0:
                     fut.result(timeout=5.0)
                     served.append("ok")
@@ -389,6 +407,8 @@ def run_stress(smoke: bool = True,
     """
     from pytorchvideo_accelerate_tpu.obs import flight_recorder, spans
 
+    from pytorchvideo_accelerate_tpu.obs import trace as obstrace
+
     log = log or (lambda msg: None)
     rounds = 1 if smoke else 3
     t0 = time.perf_counter()
@@ -401,6 +421,13 @@ def run_stress(smoke: bool = True,
         flight_recorder._DEFAULT = flight_recorder.FlightRecorder()
         spans._DEFAULT = spans.SpanCollector(
             enabled=True, recorder=flight_recorder._DEFAULT)
+        # distributed tracing ARMED for the whole scenario (created inside
+        # the armed window, so the Tracer's lock and @shared_state fields
+        # are tracked): the batcher/fleet clients below start sampled
+        # roots, so trace capture/attach and ring appends genuinely race
+        # the flush threads — the "gates stay clean with tracing armed"
+        # obligation, exercised rather than asserted
+        obstrace.configure_tracing(1.0, seed=0, capacity=512)
         with tempfile.TemporaryDirectory(prefix="pva_tsan_") as tmpdir:
             for _ in range(rounds):
                 wd = _stress_recorder_watchdog(tmpdir, log)
@@ -417,6 +444,7 @@ def run_stress(smoke: bool = True,
             spans._DEFAULT.pop_window()
     finally:
         spans._DEFAULT, flight_recorder._DEFAULT = old_collector, old_recorder
+        obstrace.disable_tracing()
         rt.disarm()
     report = rt.collect()
     report["elapsed_s"] = round(time.perf_counter() - t0, 3)

@@ -240,6 +240,12 @@ class ServeConfig:
     # cannot meet its deadline is shed instead of riding to a 504
     realtime_deadline_ms: float = 2000.0
     batch_deadline_ms: float = 10000.0
+    # per-deployment latency-histogram bucket bounds (comma-separated
+    # MILLISECONDS, e.g. "5,10,25,50,100,250,1000"); "" keeps the shared
+    # serving ladder. An interactive tier wants sub-ms resolution, a bulk
+    # tier wants multi-second tails — one ladder fits neither
+    # (obs/registry.py family buckets).
+    latency_buckets_ms: str = ""
 
 
 @dataclass
@@ -282,6 +288,16 @@ class ObsConfig:
     # bounded in-memory event ring (spans/metrics/warnings) dumped to
     # <output_dir>/flight_record.json on exception, SIGTERM, or stall
     flight_recorder_events: int = 512
+    # distributed tracing (obs/trace.py): head-based sampling rate for new
+    # trace roots (train steps, /predict requests, loadgen arrivals).
+    # 0 = tracing disarmed, structurally zero overhead (the default);
+    # incoming `traceparent` headers are always continued once armed —
+    # the remote head already made the sampling decision.
+    trace_sample_rate: float = 0.0
+    # bounded per-process trace-event ring, exported as Chrome/Perfetto
+    # JSON (<output_dir>/trace_ring.json; merge N of them with
+    # `pva-tpu-trace`)
+    trace_ring_events: int = 4096
 
 
 @dataclass

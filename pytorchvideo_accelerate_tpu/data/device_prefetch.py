@@ -50,6 +50,7 @@ import time
 from typing import Any, Iterator, Optional
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader
 from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
 from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
@@ -141,8 +142,12 @@ class DevicePrefetcher:
         stop = threading.Event()
         slots = threading.Semaphore(self.depth)
         items = self.loader.epoch_items(epoch, from_start)
+        # trace handoff (obs/trace.py): capture the consumer's context so
+        # the worker's h2d spans join whatever trace was active when the
+        # epoch started (disarmed: one global read, ctx stays None)
         worker = make_thread(
-            target=self._worker, args=(items, q, stop, slots),
+            target=self._worker,
+            args=(items, q, stop, slots, trace.capture()),
             name="device-prefetch", daemon=True,
         )
         worker.start()
@@ -204,8 +209,12 @@ class DevicePrefetcher:
                 self.watchdog.clear(self.watchdog_name)
 
     def _worker(self, items: Iterator[tuple], q: "queue.Queue[tuple]",
-                stop: threading.Event, slots: threading.Semaphore) -> None:
+                stop: threading.Event, slots: threading.Semaphore,
+                ctx=None) -> None:
         """Producer: advance the host loader, place on device, enqueue.
+
+        `ctx` is the consumer's captured trace context (trace.attach
+        re-establishes it here so worker-side h2d spans join the trace).
 
         Every exit path funnels through `finally: items.close()` — closing
         the `epoch_items` generator from THIS thread (the only one that ever
@@ -213,24 +222,26 @@ class DevicePrefetcher:
         decode futures; a cross-thread close would race "generator already
         executing"."""
         try:
-            for batch, state in items:
-                if self.watchdog is not None:
-                    self.watchdog.heartbeat(self.watchdog_name)
-                if batch is None:  # exhaustion marker: no slot, no placement
-                    q.put(("state", None, state))
-                    continue
-                while not stop.is_set():
-                    if slots.acquire(timeout=_SENTINEL_POLL_S):
-                        break
-                else:
-                    return  # consumer gone; slot never acquired
-                if stop.is_set():
-                    slots.release()
-                    return
-                with self._lock:
-                    self._resident += 1
-                    self.max_resident = max(self.max_resident, self._resident)
-                q.put(("batch", self._place(batch), state))
+            with trace.attach(ctx):
+                for batch, state in items:
+                    if self.watchdog is not None:
+                        self.watchdog.heartbeat(self.watchdog_name)
+                    if batch is None:  # exhaustion marker: no slot/placement
+                        q.put(("state", None, state))
+                        continue
+                    while not stop.is_set():
+                        if slots.acquire(timeout=_SENTINEL_POLL_S):
+                            break
+                    else:
+                        return  # consumer gone; slot never acquired
+                    if stop.is_set():
+                        slots.release()
+                        return
+                    with self._lock:
+                        self._resident += 1
+                        self.max_resident = max(self.max_resident,
+                                                self._resident)
+                    q.put(("batch", self._place(batch), state))
         except BaseException as e:  # noqa: BLE001 - must cross the thread
             q.put(("error", e, None))
         else:

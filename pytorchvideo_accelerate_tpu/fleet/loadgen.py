@@ -38,6 +38,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
 from pytorchvideo_accelerate_tpu.serving.stats import _percentile
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
@@ -102,7 +103,7 @@ class LoadGen:
         with self._lock:
             self._done.append((outcome, latency_s))
 
-    def _on_done(self, t_submit: float, future) -> None:
+    def _on_done(self, t_submit: float, future, handle=None) -> None:
         latency = time.monotonic() - t_submit
         err = None
         try:
@@ -110,11 +111,16 @@ class LoadGen:
         except Exception as e:  # cancelled
             err = e
         if err is None:
-            self._record("ok", latency)
+            outcome = "ok"
         elif isinstance(err, QueueFullError):
-            self._record("shed", latency)
+            outcome = "shed"
         else:
-            self._record("failed", latency)
+            outcome = "failed"
+        self._record(outcome, latency)
+        if handle is not None:
+            # close the request's root trace span with its verdict — the
+            # client-side end of the merged timeline
+            handle.finish(outcome=outcome)
 
     def run(self) -> Dict[str, float]:
         """Blocking: generate the arrival schedule, fire it, wait out the
@@ -132,6 +138,10 @@ class LoadGen:
             kwargs["deadline_ms"] = self.deadline_ms
         offered = 0
         max_lag = 0.0
+        # distributed tracing: each arrival is a trace HEAD — the sampled
+        # ones get a root span finished with the request's outcome when
+        # its future resolves (disarmed: one global read before the loop)
+        tracer = trace.get_tracer()
         t0 = time.monotonic()
         for t_arr in arrivals:
             now = time.monotonic() - t0
@@ -141,17 +151,25 @@ class LoadGen:
             max_lag = max(max_lag, lag)
             clip = self.clip_factory(rng)
             offered += 1
+            handle = (tracer.start("request", seq=offered)
+                      if tracer is not None else None)
             t_submit = time.monotonic()
             try:
-                fut = self.submit(clip, **kwargs)
+                with trace.attach(handle.ctx if handle is not None
+                                  else None):
+                    fut = self.submit(clip, **kwargs)
             except QueueFullError:
                 self._record("shed", 0.0)
+                if handle is not None:
+                    handle.finish(outcome="shed")
                 continue
             except Exception:  # noqa: BLE001 - a dead front is a failure
                 self._record("failed", 0.0)
+                if handle is not None:
+                    handle.finish(outcome="failed")
                 continue
             fut.add_done_callback(
-                lambda f, t=t_submit: self._on_done(t, f))
+                lambda f, t=t_submit, h=handle: self._on_done(t, f, h))
         wall = time.monotonic() - t0
         # open loop ends at the schedule; stragglers get a bounded grace
         grace_deadline = time.monotonic() + self.grace_s

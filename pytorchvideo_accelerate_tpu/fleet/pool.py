@@ -33,6 +33,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 from pytorchvideo_accelerate_tpu.utils.sync import (
@@ -131,33 +132,47 @@ class HttpReplica:
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"pva-http-{name}")
 
-    def _predict(self, clip, kwargs) -> np.ndarray:
-        body = {k: np.asarray(v).tolist() for k, v in clip.items()}
-        if kwargs.get("priority") is not None:
-            body["priority"] = kwargs["priority"]
-        if kwargs.get("deadline_ms") is not None:
-            body["deadline_ms"] = float(kwargs["deadline_ms"])
-        req = urllib.request.Request(
-            self.url + "/predict", data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"})
-        try:
-            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
-                out = json.loads(r.read())
-        except urllib.error.HTTPError as e:
-            if e.code == 503:
-                retry_after = float(e.headers.get("Retry-After", 1) or 1)
-                raise QueueFullError(f"{self.name}: shed (503)",
-                                     retry_after_s=retry_after) from e
-            if e.code == 400:
-                raise ValueError(f"{self.name}: bad request: "
-                                 f"{e.read()[:200]!r}") from e
-            raise RuntimeError(f"{self.name}: HTTP {e.code}") from e
-        except (urllib.error.URLError, ConnectionError, OSError) as e:
-            raise ReplicaDeadError(f"{self.name}: {e}") from e
-        return np.asarray(out["logits"], np.float32)
+    def _predict(self, clip, kwargs, ctx=None) -> np.ndarray:
+        # the worker thread re-attaches the submitter's trace context (the
+        # thread-pool hop would otherwise drop it) and wraps the whole
+        # round trip in an `http_hop` span; the outgoing `traceparent`
+        # header carries that span's id, so the server's `http_predict`
+        # trace parents onto THIS hop in the merged cross-process timeline
+        with trace.attach(ctx), trace.span("http_hop", replica=self.name):
+            body = {k: np.asarray(v).tolist() for k, v in clip.items()}
+            if kwargs.get("priority") is not None:
+                body["priority"] = kwargs["priority"]
+            if kwargs.get("deadline_ms") is not None:
+                body["deadline_ms"] = float(kwargs["deadline_ms"])
+            headers = {"Content-Type": "application/json"}
+            tp = trace.current_traceparent()
+            if tp:
+                headers["traceparent"] = tp
+            req = urllib.request.Request(
+                self.url + "/predict", data=json.dumps(body).encode(),
+                headers=headers)
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as r:
+                    out = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                if e.code == 503:
+                    retry_after = float(e.headers.get("Retry-After", 1) or 1)
+                    raise QueueFullError(f"{self.name}: shed (503)",
+                                         retry_after_s=retry_after) from e
+                if e.code == 400:
+                    raise ValueError(f"{self.name}: bad request: "
+                                     f"{e.read()[:200]!r}") from e
+                raise RuntimeError(f"{self.name}: HTTP {e.code}") from e
+            except (urllib.error.URLError, ConnectionError, OSError) as e:
+                raise ReplicaDeadError(f"{self.name}: {e}") from e
+            return np.asarray(out["logits"], np.float32)
 
     def submit(self, clip, **kwargs) -> Future:
-        return self._pool.submit(self._predict, dict(clip), kwargs)
+        # trace context captured HERE (the caller's thread) and shipped to
+        # the worker with the payload — the capture/attach handoff pattern
+        return self._pool.submit(self._predict, dict(clip), kwargs,
+                                 trace.capture())
 
     def health(self) -> str:
         try:
@@ -287,6 +302,36 @@ class ReplicaPool:
                                  replica.name)
 
 
+def read_line_with_deadline(proc, timeout_s: float, *,
+                            match: Optional[str] = None,
+                            name: str = "pva-proc-read"):
+    """First stdout line of a child process — the first containing `match`
+    when given — within a deadline, via a daemon reader thread.
+
+    `readline()` blocks forever, so a child that wedges BEFORE printing
+    its bind/URL line would otherwise hang the caller past any timeout.
+    One implementation for every spawn site (`spawn_serving_process`, the
+    chaos replica_kill leg, the bench fleet lane's traced replica) so the
+    wedge-safe protocol cannot drift between them. Returns `(line, eof)`:
+    line None on deadline or EOF, eof True when the child's stdout closed
+    without the wanted line (a died-or-redirected child, NOT a timeout —
+    callers must report the two differently). The CALLER owns the error
+    message and the kill."""
+    box: dict = {}
+
+    def read():
+        for raw in proc.stdout:
+            if match is None or match in raw:
+                box["line"] = raw
+                return
+        box["eof"] = True
+
+    reader = make_thread(target=read, name=name, daemon=True)
+    reader.start()
+    reader.join(timeout=timeout_s)
+    return box.get("line"), bool(box.get("eof"))
+
+
 def spawn_serving_process(artifact: str, *, port: int = 0,
                           n_devices: Optional[int] = None,
                           extra_args: Sequence[str] = (),
@@ -309,28 +354,15 @@ def spawn_serving_process(artifact: str, *, port: int = 0,
            *extra_args]
     proc = subprocess.Popen(cmd, env=env, stdout=subprocess.PIPE,
                             stderr=subprocess.DEVNULL, text=True)
-    # deadline-safe reader: readline() blocks, so a child that wedges
-    # BEFORE printing its bind line would otherwise hang the caller past
-    # any timeout (the chaos replica_kill leg's reader pattern)
-    box: dict = {}
-
-    def read_bind_line():
-        for raw in proc.stdout:
-            if "pva-tpu-serve: http://" in raw:
-                box["line"] = raw
-                return
-        box["eof"] = True
-
-    reader = make_thread(target=read_bind_line, name="pva-fleet-spawn-read",
-                         daemon=True)
-    reader.start()
-    reader.join(timeout=startup_timeout_s)
-    if "line" not in box:
+    line, eof = read_line_with_deadline(proc, startup_timeout_s,
+                                        match="pva-tpu-serve: http://",
+                                        name="pva-fleet-spawn-read")
+    if line is None:
         code = proc.poll()
         proc.kill()
         raise RuntimeError(
             f"serving process exited {code} before binding"
-            if box.get("eof") or code is not None
+            if eof or code is not None
             else f"serving process did not bind within {startup_timeout_s}s")
-    url = box["line"].split()[1]
+    url = line.split()[1]
     return proc, HttpReplica(f"proc-{proc.pid}", url, pid=proc.pid)

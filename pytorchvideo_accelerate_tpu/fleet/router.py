@@ -35,6 +35,7 @@ from concurrent.futures import Future
 from typing import Dict, List, Optional
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.fleet.pool import ReplicaDeadError, ReplicaPool
 from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
 from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
@@ -94,7 +95,12 @@ class Router:
         if deadline_ms is not None:
             kwargs["deadline_ms"] = deadline_ms
         outer: Future = Future()
-        self._dispatch(outer, clip, kwargs, self.retries)
+        # capture the submitter's trace context ONCE: first dispatch runs
+        # on this thread (context already active), but a re-dispatch after
+        # a death/shed runs on a done-callback thread with no context —
+        # the captured value re-attaches it there (trace.attach)
+        self._dispatch(outer, clip, kwargs, self.retries,
+                       ctx=trace.capture())
         return outer
 
     def queue_depth(self) -> int:
@@ -152,13 +158,14 @@ class Router:
                                     replica=name)
 
     def _dispatch(self, outer: Future, clip, kwargs, attempts_left: int,
-                  exclude: frozenset = frozenset()) -> None:
+                  exclude: frozenset = frozenset(), ctx=None) -> None:
         if outer.cancelled():  # the client gave up (504) before dispatch
             return
         last_shed: Optional[QueueFullError] = None
         for replica in self._pick(exclude):
             try:
-                inner = replica.submit(clip, **kwargs)
+                with trace.attach(ctx):
+                    inner = replica.submit(clip, **kwargs)
             except QueueFullError as e:
                 last_shed = e  # this replica sheds; try the next one
                 continue
@@ -185,7 +192,7 @@ class Router:
             self._track(replica.name, +1)
             inner.add_done_callback(
                 lambda f, r=replica: self._settle(
-                    outer, clip, kwargs, attempts_left, r, f))
+                    outer, clip, kwargs, attempts_left, r, f, ctx=ctx))
             return
         # nothing took it: the ROUTER sheds (every candidate shed or died)
         self._c_shed.inc(pool=self._pool_label)
@@ -194,7 +201,7 @@ class Router:
         self._fail(outer, err)
 
     def _settle(self, outer: Future, clip, kwargs, attempts_left: int,
-                replica, inner: Future) -> None:
+                replica, inner: Future, ctx=None) -> None:
         self._track(replica.name, -1)
         if outer.cancelled():
             return
@@ -213,7 +220,7 @@ class Router:
             logger.warning("fleet: %s died mid-request; re-dispatching",
                            replica.name)
             self._dispatch(outer, clip, kwargs, attempts_left - 1,
-                           exclude=frozenset({replica.name}))
+                           exclude=frozenset({replica.name}), ctx=ctx)
             return
         if isinstance(err, ReplicaDeadError):
             self.pool.mark_down(replica)
@@ -225,7 +232,7 @@ class Router:
             # replica is NOT marked down: shedding is it working.
             self._c_retried.inc(pool=self._pool_label)
             self._dispatch(outer, clip, kwargs, attempts_left - 1,
-                           exclude=frozenset({replica.name}))
+                           exclude=frozenset({replica.name}), ctx=ctx)
             return
         self._fail(outer, err)
 

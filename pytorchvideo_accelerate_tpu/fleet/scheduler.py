@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
 from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
 from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, clip_key
@@ -85,6 +86,9 @@ class _SchedRequest:
     priority: str
     key: tuple  # clip geometry: only same-shaped requests share a launch
     seq: int = 0
+    # submitter's trace context, carried with the payload across the
+    # pending-queue hop (None = disarmed tracing or untraced caller)
+    ctx: Optional[object] = None
 
     def rank(self) -> Tuple[int, float, int]:
         """EDF order, realtime class strictly first; seq breaks ties FIFO."""
@@ -168,7 +172,7 @@ class Scheduler:
                if deadline_ms is None else max(float(deadline_ms), 1.0) / 1e3)
         req = _SchedRequest(clip=clips, future=Future(), t_enqueue=now,
                             deadline=now + ttl, priority=priority,
-                            key=clip_key(clips))
+                            key=clip_key(clips), ctx=trace.capture())
         with self._lock:
             if self._closed.is_set():
                 raise RuntimeError("scheduler is closed")
@@ -373,11 +377,27 @@ class Scheduler:
                 stacked[k] = rows
             stacked["mask"] = np.asarray(
                 [1.0] * n + [0.0] * (bucket - n), np.float32)
+            # tracing: traced requests record their scheduler wait, and
+            # the launch runs under the head context so engine-side spans
+            # join its trace (disarmed: one global read + shared no-ops)
+            rt = trace.get_tracer()
+            head_ctx = None
+            if rt is not None:
+                now_w, now_m = time.time(), time.monotonic()
+                for req in reqs:
+                    if req.ctx is not None:
+                        if head_ctx is None:
+                            head_ctx = req.ctx
+                        waited = now_m - req.t_enqueue
+                        rt.event(req.ctx, "sched_wait", now_w - waited,
+                                 waited, priority=req.priority)
             t0 = time.perf_counter()
             # one engine for the WHOLE launch: swap_engine blocks on this
             # lock, so a cutover can never interleave with a launch
-            with self._launch_lock:
-                logits = self.engine.predict(stacked)
+            with trace.attach(head_ctx):
+                with trace.span("device_dispatch", batch=n, bucket=bucket):
+                    with self._launch_lock:
+                        logits = self.engine.predict(stacked)
             svc = time.perf_counter() - t0
             done = time.monotonic()
             latencies = []
@@ -390,7 +410,10 @@ class Scheduler:
                 except Exception:
                     pass  # cancelled between claim and resolve
             if self.stats is not None:
-                self.stats.observe_batch(n, bucket, latencies)
+                self.stats.observe_batch(
+                    n, bucket, latencies,
+                    trace_ids=[getattr(r.ctx, "trace_id", None)
+                               for r in reqs])
             with self._lock:
                 prev = self._svc.get(bucket)
                 self._svc[bucket] = (svc if prev is None else

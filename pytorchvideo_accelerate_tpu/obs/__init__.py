@@ -35,6 +35,9 @@ from pytorchvideo_accelerate_tpu.obs.spans import (  # noqa: F401
     span,
 )
 from pytorchvideo_accelerate_tpu.obs.watchdog import Watchdog  # noqa: F401
+# distributed tracing (obs/trace.py): `obs.trace.configure_tracing(...)`,
+# capture/attach handoff helpers, the per-process trace ring
+from pytorchvideo_accelerate_tpu.obs import trace  # noqa: F401
 
 # default wiring: completed spans feed the flight-recorder ring
 get_collector().recorder = get_recorder()

@@ -23,7 +23,8 @@ under a lock — nanoseconds against a network request or a train step.
 from __future__ import annotations
 
 import math
-from typing import Callable, Dict, Iterable, Optional, Sequence, Tuple
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 from pytorchvideo_accelerate_tpu.utils.sync import make_lock
 
@@ -202,23 +203,66 @@ class Gauge(_Metric):
 DEFAULT_BUCKETS = (0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25,
                    0.5, 1.0, 2.5, 5.0, 10.0)
 
+# Per-FAMILY bucket boundaries, keyed by metric-name prefix (longest match
+# wins). One serving tier wants sub-ms latency buckets, a batch tier wants
+# multi-second ones — a single hardcoded ladder fits neither. Families are
+# registered at configure time (`set_family_buckets`), consulted only when
+# a histogram is created WITHOUT explicit buckets; an existing histogram
+# never reshapes (cumulative counts cannot be re-binned).
+_FAMILY_BUCKETS: Dict[str, Tuple[float, ...]] = {}
+
+
+def set_family_buckets(prefix: str, buckets: Sequence[float]) -> None:
+    """Declare default bucket boundaries for every histogram whose name
+    starts with `prefix` (configure-time; see ServeConfig.latency_buckets_ms
+    for the serving wiring)."""
+    bs = tuple(sorted(float(b) for b in buckets))
+    if not bs:
+        raise ValueError("a bucket family needs at least one finite bound")
+    _FAMILY_BUCKETS[prefix] = bs
+
+
+def family_buckets(name: str,
+                   default: Sequence[float] = DEFAULT_BUCKETS) -> Tuple[float, ...]:
+    """Resolve the bucket ladder for `name`: longest registered family
+    prefix, else `default`."""
+    best = ""
+    for prefix in _FAMILY_BUCKETS:
+        if name.startswith(prefix) and len(prefix) > len(best):
+            best = prefix
+    return _FAMILY_BUCKETS[best] if best else tuple(default)
+
 
 class Histogram(_Metric):
     """Cumulative-bucket histogram (Prometheus convention: each `le` bucket
-    counts every observation <= its bound; `+Inf` == `_count`)."""
+    counts every observation <= its bound; `+Inf` == `_count`).
+
+    Buckets resolve per family when not given explicitly (`family_buckets`).
+    `observe(value, trace_id=...)` additionally pins an OpenMetrics-style
+    EXEMPLAR on the bucket the observation lands in — the last (trace_id,
+    value, timestamp) per bucket — so the top latency bucket names the
+    trace of a REAL slow request (the exemplar→trace workflow,
+    docs/OBSERVABILITY.md). Exemplar rendering is behind a flag
+    (`render(exemplars=True)`): the default text output stays plain
+    Prometheus v0.0.4, parseable by every existing scraper and test."""
 
     kind = "histogram"
 
     def __init__(self, name: str, help: str = "",
-                 buckets: Sequence[float] = DEFAULT_BUCKETS):
+                 buckets: Optional[Sequence[float]] = None):
         super().__init__(name, help)
+        if buckets is None:
+            buckets = family_buckets(name)
         self.buckets = tuple(sorted(float(b) for b in buckets))
         if not self.buckets:
             raise ValueError("histogram needs at least one finite bucket")
         self._counts = [0] * (len(self.buckets) + 1)  # + the +Inf bucket
         self._sum = 0.0
+        # bucket index -> (trace_id, value, unix_ts); last observation wins
+        self._exemplars: List[Optional[Tuple[str, float, float]]] = (
+            [None] * (len(self.buckets) + 1))
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float, trace_id: Optional[str] = None) -> None:
         v = float(value)
         i = len(self.buckets)
         for j, b in enumerate(self.buckets):
@@ -228,6 +272,8 @@ class Histogram(_Metric):
         with self._lock:
             self._counts[i] += 1
             self._sum += v
+            if trace_id:
+                self._exemplars[i] = (str(trace_id), v, time.time())
 
     @property
     def count(self) -> int:
@@ -239,18 +285,30 @@ class Histogram(_Metric):
         with self._lock:
             return self._sum
 
-    def render(self) -> str:
+    def exemplars(self) -> Dict[str, Tuple[str, float, float]]:
+        """{le-label: (trace_id, value, ts)} for buckets holding one —
+        keyed the way render() labels them (`+Inf` for the overflow)."""
+        with self._lock:
+            exs = list(self._exemplars)
+        labels = [_fmt(b) for b in self.buckets] + ["+Inf"]
+        return {labels[i]: ex for i, ex in enumerate(exs) if ex is not None}
+
+    def render(self, exemplars: bool = False) -> str:
         with self._lock:
             counts = list(self._counts)
             total_sum = self._sum
+            exs = list(self._exemplars)
+        labels = [_fmt(b) for b in self.buckets] + ["+Inf"]
         lines = [self.header()]
         cum = 0
-        for b, c in zip(self.buckets, counts):
-            cum += c
-            lines.append(
-                f'{self.name}_bucket{{le="{_fmt(b)}"}} {cum}\n')
-        cum += counts[-1]
-        lines.append(f'{self.name}_bucket{{le="+Inf"}} {cum}\n')
+        for i, label in enumerate(labels):
+            cum += counts[i]
+            line = f'{self.name}_bucket{{le="{label}"}} {cum}'
+            if exemplars and exs[i] is not None:
+                tid, v, ts = exs[i]
+                line += (f' # {{trace_id="{_escape_label(tid)}"}} '
+                         f"{_fmt(v)} {_fmt(round(ts, 3))}")
+            lines.append(line + "\n")
         lines.append(f"{self.name}_sum {_fmt(total_sum)}\n")
         lines.append(f"{self.name}_count {cum}\n")
         return "".join(lines)
@@ -285,18 +343,22 @@ class Registry:
         return self._get_or_create(Gauge, name, help, labelnames)
 
     def histogram(self, name: str, help: str = "",
-                  buckets: Sequence[float] = DEFAULT_BUCKETS) -> Histogram:
+                  buckets: Optional[Sequence[float]] = None) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets)
 
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
 
-    def render(self) -> str:
-        """Prometheus text exposition v0.0.4 of every registered metric."""
+    def render(self, exemplars: bool = False) -> str:
+        """Prometheus text exposition v0.0.4 of every registered metric;
+        `exemplars=True` adds OpenMetrics exemplar suffixes to histogram
+        bucket lines (off by default — plain scrapers must keep parsing)."""
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
-        return "".join(m.render() for m in metrics)
+        return "".join(
+            m.render(exemplars=exemplars) if isinstance(m, Histogram)
+            else m.render() for m in metrics)
 
 
 _DEFAULT = Registry()

@@ -30,6 +30,7 @@ import threading
 import time
 from typing import Dict, Optional, Tuple
 
+from pytorchvideo_accelerate_tpu.obs.trace import get_tracer as _get_tracer
 from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
 
 
@@ -47,20 +48,31 @@ _NOOP = _Noop()
 
 
 class _Span:
-    __slots__ = ("_c", "name", "_t0")
+    __slots__ = ("_c", "name", "_t0", "_trace")
 
     def __init__(self, collector: "SpanCollector", name: str):
         self._c = collector
         self.name = name
         self._t0 = 0.0
+        self._trace = None
 
     def __enter__(self):
         self._c._push(self.name)
+        # distributed-tracing hook (obs/trace.py): when the tracer is armed
+        # AND this thread has an active trace context, the span doubles as
+        # a trace event carrying trace/parent ids. Disarmed (or untraced):
+        # one module-global read, no allocation.
+        rt = _get_tracer()
+        self._trace = rt.span_begin(self.name) if rt is not None else None
         self._t0 = time.perf_counter()
         return self
 
     def __exit__(self, exc_type, exc, tb):
         dt = time.perf_counter() - self._t0
+        tok = self._trace
+        if tok is not None:
+            tok.end(error=exc_type is not None)
+            self._trace = None
         self._c._pop(self.name)
         self._c.observe(self.name, dt, error=exc_type is not None)
         return False

@@ -624,20 +624,17 @@ _KILL_RECOVERY_SLO_MS = 500.0
 
 def _read_url_line(proc, timeout_s: float = 90.0) -> str:
     """First stdout line of a replica subprocess, with a deadline (a
-    replica that never binds must fail the leg, not hang the scenario)."""
-    box: dict = {}
+    replica that never binds must fail the leg, not hang the scenario);
+    the wedge-safe reader lives in fleet/pool.py, shared with every other
+    spawn site."""
+    from pytorchvideo_accelerate_tpu.fleet.pool import read_line_with_deadline
 
-    def read():
-        box["line"] = proc.stdout.readline()
-
-    t = make_thread(target=read, name="chaos-replica-read", daemon=True)
-    t.start()
-    t.join(timeout=timeout_s)
-    line = box.get("line") or ""
-    if not line.strip():
+    line, eof = read_line_with_deadline(proc, timeout_s,
+                                        name="chaos-replica-read")
+    if not (line or "").strip():
         raise RuntimeError(
-            f"replica subprocess produced no URL within {timeout_s}s "
-            f"(exit={proc.poll()})")
+            f"replica subprocess {'closed stdout' if eof else 'produced no URL'}"
+            f" within {timeout_s}s (exit={proc.poll()})")
     return json.loads(line)["url"]
 
 
@@ -1075,31 +1072,41 @@ def run_scenario(seed: int = 42, smoke: bool = True,
     """Run every leg; returns the report dict. `smoke` is accepted for
     CLI-symmetry with pva-tpu-tsan — the scenario is already sized for CI
     (tiny shapes, two short tiny3d fits); full mode is identical today."""
+    from pytorchvideo_accelerate_tpu.obs import trace as obstrace
+
     log = log or (lambda msg: None)
     t0 = time.perf_counter()
     report: dict = {"seed": int(seed), "smoke": bool(smoke),
                     "findings": [], "legs": {}}
-    with tempfile.TemporaryDirectory(prefix="pva_chaos_") as tmpdir:
-        for fn, args in (
-                (leg_replay, (report, seed, log)),
-                (leg_sigterm_plumbing, (report, log)),
-                (leg_decode, (report, tmpdir, seed, log)),
-                (leg_quarantine, (report, tmpdir, seed, log)),
-                (leg_ckpt, (report, tmpdir, seed, log)),
-                (leg_tracker, (report, tmpdir, seed, log)),
-                (leg_serve, (report, seed, log)),
-                (leg_replica_kill, (report, seed, log)),
-                (leg_collective_hang, (report, seed, log)),
-                (leg_guard_nan, (report, tmpdir, seed, log)),
-                (leg_preempt, (report, tmpdir, seed, log)),
-                (leg_preempt_mesh, (report, tmpdir, seed, log)),
-        ):
-            try:
-                fn(*args)
-            except Exception as e:  # noqa: BLE001 - a crashed leg IS a finding
-                faults.disarm()  # never leak an armed plan into later legs
-                _finding(report, fn.__name__,
-                         f"leg crashed: {type(e).__name__}: {e}")
+    # distributed tracing ARMED across every leg: the recovery machinery
+    # (retries, sheds, drains, rollbacks) must behave identically with the
+    # tracer live — the "chaos gate stays clean with tracing armed"
+    # obligation. Seeded, so the sampling decisions replay with the run.
+    obstrace.configure_tracing(1.0, seed=seed, capacity=2048)
+    try:
+        with tempfile.TemporaryDirectory(prefix="pva_chaos_") as tmpdir:
+            for fn, args in (
+                    (leg_replay, (report, seed, log)),
+                    (leg_sigterm_plumbing, (report, log)),
+                    (leg_decode, (report, tmpdir, seed, log)),
+                    (leg_quarantine, (report, tmpdir, seed, log)),
+                    (leg_ckpt, (report, tmpdir, seed, log)),
+                    (leg_tracker, (report, tmpdir, seed, log)),
+                    (leg_serve, (report, seed, log)),
+                    (leg_replica_kill, (report, seed, log)),
+                    (leg_collective_hang, (report, seed, log)),
+                    (leg_guard_nan, (report, tmpdir, seed, log)),
+                    (leg_preempt, (report, tmpdir, seed, log)),
+                    (leg_preempt_mesh, (report, tmpdir, seed, log)),
+            ):
+                try:
+                    fn(*args)
+                except Exception as e:  # noqa: BLE001 - a crashed leg IS a finding
+                    faults.disarm()  # never leak an armed plan into later legs
+                    _finding(report, fn.__name__,
+                             f"leg crashed: {type(e).__name__}: {e}")
+    finally:
+        obstrace.disable_tracing()
     report["elapsed_s"] = round(time.perf_counter() - t0, 3)
     log(f"[chaos] scenario done in {report['elapsed_s']}s: "
         f"{len(report['findings'])} finding(s)")

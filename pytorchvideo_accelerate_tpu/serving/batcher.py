@@ -29,6 +29,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
 from pytorchvideo_accelerate_tpu.serving.engine import CLIP_KEYS, clip_key
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
@@ -58,6 +59,9 @@ class _Request:
     future: Future
     t_enqueue: float
     key: tuple  # clip geometry: only same-shaped requests batch together
+    # the submitter's trace context, carried WITH the payload across the
+    # queue hop (None when tracing is disarmed or the caller is untraced)
+    ctx: Optional[object] = None
 
 
 _STOP = object()
@@ -105,7 +109,7 @@ class MicroBatcher:
             raise RuntimeError("batcher is closed")
         req = _Request(
             clip=clips, future=Future(), t_enqueue=time.monotonic(),
-            key=clip_key(clips),
+            key=clip_key(clips), ctx=trace.capture(),
         )
         try:
             self._q.put_nowait(req)
@@ -237,7 +241,24 @@ class MicroBatcher:
         # (and lets debug tooling assert) which rows are live.
         stacked["mask"] = np.asarray(  # pva: disable=host-sync -- builds the mask from a Python list, host-side by construction
             [1.0] * n + [0.0] * (bucket - n), np.float32)
-        logits = self.engine.predict(stacked)
+        # tracing: every traced request gets its queue wait as a trace
+        # event, and the device dispatch runs under the head request's
+        # context so the engine-side spans join its trace. Disarmed: one
+        # global read + a None check (trace.attach/span return a shared
+        # no-op).
+        rt = trace.get_tracer()
+        head_ctx = None
+        if rt is not None:
+            now_w, now_m = time.time(), time.monotonic()
+            for req in reqs:
+                if req.ctx is not None:
+                    if head_ctx is None:
+                        head_ctx = req.ctx
+                    waited = now_m - req.t_enqueue
+                    rt.event(req.ctx, "queue_wait", now_w - waited, waited)
+        with trace.attach(head_ctx):
+            with trace.span("device_dispatch", batch=n, bucket=bucket):
+                logits = self.engine.predict(stacked)
         done = time.monotonic()
         # padded rows are sliced away here — a response can only ever carry
         # logits[i] for the request that submitted row i
@@ -246,4 +267,6 @@ class MicroBatcher:
             latencies.append(done - req.t_enqueue)
             req.future.set_result(logits[i])
         if self.stats is not None:
-            self.stats.observe_batch(n, bucket, latencies)
+            self.stats.observe_batch(
+                n, bucket, latencies,
+                trace_ids=[getattr(r.ctx, "trace_id", None) for r in reqs])

@@ -43,6 +43,7 @@ from typing import Dict, Optional, Sequence
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.serving.admission import (
     DRAINING,
     AdmissionController,
@@ -79,12 +80,15 @@ class _Handler(BaseHTTPRequestHandler):
         self.end_headers()
         self.wfile.write(body)
 
-    def _reject(self, code: int, message: str, retry_after_s: float) -> None:
+    def _reject(self, code: int, message: str, retry_after_s: float,
+                headers: Optional[Dict[str, str]] = None) -> None:
         """503/504 with Retry-After: the cheapest response the server can
         produce, and it tells a well-behaved client when to come back."""
+        hdrs = {"Retry-After": str(max(int(round(retry_after_s)), 1))}
+        if headers:
+            hdrs.update(headers)
         self._reply(code, {"error": message, "retry_after_s": retry_after_s},
-                    headers={"Retry-After":
-                             str(max(int(round(retry_after_s)), 1))})
+                    headers=hdrs)
 
     def do_GET(self):  # noqa: N802 - stdlib API
         srv: "InferenceServer" = self.server.owner
@@ -108,7 +112,15 @@ class _Handler(BaseHTTPRequestHandler):
                                        for k, v in srv.expected_spec.items()}
             self._reply(503 if state == DRAINING else 200, health)
         elif self.path == "/stats":
-            self._reply(200, srv.stats.snapshot())
+            snap = srv.stats.snapshot()
+            # slowest TRACED completions ride /stats (not the flat
+            # snapshot dict — trackers keep their {str: float} surface):
+            # a bad p99 here names trace ids to pull from the merged
+            # timeline (docs/OBSERVABILITY.md § exemplar→trace)
+            slowest = srv.stats.slowest_traces()
+            if slowest:
+                snap["slowest_traces"] = slowest
+            self._reply(200, snap)
         elif self.path == "/metrics":
             body = srv.stats.registry.render().encode()
             self.send_response(200)
@@ -161,52 +173,78 @@ class _Handler(BaseHTTPRequestHandler):
             srv.stats.observe_rejected("400")
             self._reply(400, {"error": f"bad request: {e}"})
             return
-        try:
-            future = srv.batcher.submit(clip, **kwargs)
-        except QueueFullError as e:
-            # the batcher already counted this one (cause "503")
-            self._reject(503, str(e), e.retry_after_s)
-            return
-        except ValueError as e:
-            srv.stats.observe_rejected("400")
-            self._reply(400, {"error": f"bad request: {e}"})
-            return
-        t0 = time.monotonic()
-        try:
-            logits = future.result(timeout=srv.request_timeout_s)
-        except FutureTimeout:
-            if future.cancel():
-                # shed before the engine touched it: a true rejection
-                srv.stats.observe_rejected("504")
-            else:
-                # lost the cancel race: the flush thread already claimed
-                # the request and will count it as completed — counting a
-                # 504 too would double-book it across the requests/
-                # rejected partition. Record the budget miss separately.
-                obs.get_recorder().warn(
-                    "504 after engine claim (request completed but client "
-                    "timed out)", budget_s=srv.request_timeout_s)
-            self._reject(
-                504, f"request exceeded {srv.request_timeout_s}s budget",
-                srv.admission.retry_after_s)
-            return
-        except QueueFullError as e:
-            # shed AFTER admission: the continuous-batching scheduler's
-            # shed-before-deadline-miss (fleet/scheduler.ShedError) or a
-            # fleet router with no routable capacity resolves the FUTURE
-            # with the shed — same 503 + Retry-After contract as a
-            # submit-time shed, never a 500 and never a burned 504 budget
-            self._reject(503, str(e), e.retry_after_s)
-            return
-        except Exception as e:  # noqa: BLE001 - batch failure surfaced per-request
-            srv.stats.observe_error()
-            self._reply(500, {"error": f"inference failed: {e}"})
-            return
-        self._reply(200, {
-            "logits": np.asarray(logits, np.float32).tolist(),
-            "top1": int(np.argmax(logits)),
-            "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
-        })
+        # distributed tracing (obs/trace.py): continue an incoming
+        # `traceparent` (the head already sampled it) or start a fresh
+        # head-sampled trace; the submit below captures the context into
+        # the request, so the scheduler/batcher spans join this trace.
+        # Sheds above stay untraced on purpose — a shed must remain the
+        # cheapest response the server can produce.
+        rt = trace.get_tracer()
+        handle = None
+        if rt is not None:
+            tp = self.headers.get("traceparent")
+            handle = rt.continue_trace(tp, "http_predict") if tp else None
+            if handle is None:
+                # absent OR malformed/unsampled header: fall back to the
+                # local head-sampling decision (a corrupt header must
+                # degrade to "normal sampling", never disable tracing)
+                handle = rt.start("http_predict")
+        tid = handle.ctx.trace_id if handle is not None else None
+        # sampled responses echo the id so clients/log pipelines can join
+        # their records to the server-side trace
+        echo = {"x-pva-trace-id": tid} if tid else None
+        with (handle if handle is not None else trace.NOOP):
+            try:
+                future = srv.batcher.submit(clip, **kwargs)
+            except QueueFullError as e:
+                # the batcher already counted this one (cause "503")
+                self._reject(503, str(e), e.retry_after_s, headers=echo)
+                return
+            except ValueError as e:
+                srv.stats.observe_rejected("400")
+                self._reply(400, {"error": f"bad request: {e}"},
+                            headers=echo)
+                return
+            t0 = time.monotonic()
+            try:
+                logits = future.result(timeout=srv.request_timeout_s)
+            except FutureTimeout:
+                if future.cancel():
+                    # shed before the engine touched it: a true rejection
+                    srv.stats.observe_rejected("504")
+                else:
+                    # lost the cancel race: the flush thread already claimed
+                    # the request and will count it as completed — counting a
+                    # 504 too would double-book it across the requests/
+                    # rejected partition. Record the budget miss separately.
+                    obs.get_recorder().warn(
+                        "504 after engine claim (request completed but "
+                        "client timed out)", budget_s=srv.request_timeout_s)
+                # traced rejections echo the id too: a 504 is exactly the
+                # tail-latency failure whose server-side trace an operator
+                # needs to find
+                self._reject(
+                    504, f"request exceeded {srv.request_timeout_s}s budget",
+                    srv.admission.retry_after_s, headers=echo)
+                return
+            except QueueFullError as e:
+                # shed AFTER admission: the continuous-batching scheduler's
+                # shed-before-deadline-miss (fleet/scheduler.ShedError) or a
+                # fleet router with no routable capacity resolves the FUTURE
+                # with the shed — same 503 + Retry-After contract as a
+                # submit-time shed, never a 500 and never a burned 504 budget
+                self._reject(503, str(e), e.retry_after_s, headers=echo)
+                return
+            except Exception as e:  # noqa: BLE001 - batch failure surfaced per-request
+                srv.stats.observe_error()
+                self._reply(500, {"error": f"inference failed: {e}"},
+                            headers=echo)
+                return
+            self._reply(200, {
+                "logits": np.asarray(logits, np.float32).tolist(),
+                "top1": int(np.argmax(logits)),
+                "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            }, headers=echo)
 
 
 class InferenceServer:
@@ -301,6 +339,7 @@ class InferenceServer:
                         "in-flight, exit 0)")
             obs.get_recorder().record("signal", "SIGTERM-drain")
             obs.get_recorder().dump()  # flight_record.json still lands
+            trace.dump()  # the trace ring too (no-op when disarmed)
             # httpd.shutdown() must run off the serve_forever thread
             from pytorchvideo_accelerate_tpu.utils.sync import make_thread
 
@@ -358,6 +397,13 @@ def build_server(cfg) -> InferenceServer:
     # stalls EVERY request, and without a heartbeat it stalls silently
     obs.configure(enabled=cfg.obs.enabled,
                   capacity=cfg.obs.flight_recorder_events)
+    if cfg.obs.enabled and cfg.obs.trace_sample_rate > 0:
+        # distributed tracing: head-sample this fraction of /predict
+        # requests (incoming traceparent headers are always continued);
+        # the ring dumps to <output_dir>/trace_ring.json on SIGTERM-drain
+        trace.configure_tracing(cfg.obs.trace_sample_rate, seed=cfg.seed,
+                                capacity=cfg.obs.trace_ring_events,
+                                output_dir=cfg.checkpoint.output_dir)
     watchdog = None
     if cfg.obs.enabled:
         # flight-record destination + SIGTERM/excepthook dump hooks for the
@@ -371,7 +417,18 @@ def build_server(cfg) -> InferenceServer:
                 output_dir=cfg.checkpoint.output_dir,
                 recorder=obs.get_recorder(),
                 collector=obs.get_collector()).start()
-    stats = ServingStats(window=s.stats_window)
+    latency_buckets = None
+    if s.latency_buckets_ms:
+        try:
+            latency_buckets = sorted(
+                float(b) / 1e3 for b in s.latency_buckets_ms.split(",") if b)
+        except ValueError:
+            raise SystemExit(
+                f"--serve.latency_buckets_ms {s.latency_buckets_ms!r}: "
+                "expected comma-separated millisecond bounds, e.g. "
+                "'5,10,25,50,100,250,1000'")
+    stats = ServingStats(window=s.stats_window,
+                         latency_buckets=latency_buckets)
     engine = InferenceEngine.from_artifact(
         s.checkpoint, max_batch_size=s.max_batch_size, stats=stats)
     spec = None

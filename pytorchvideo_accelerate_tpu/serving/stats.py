@@ -39,7 +39,7 @@ def _percentile(sorted_vals: Sequence[float], q: float) -> float:
     return float(sorted_vals[idx])
 
 
-@shared_state("queue_depth_fn", "_lat", "_fills")
+@shared_state("queue_depth_fn", "_lat", "_fills", "_slowest")
 class ServingStats:
     """Thread-safe rolling serving metrics.
 
@@ -57,10 +57,15 @@ class ServingStats:
 
     def __init__(self, window: int = 1024,
                  queue_depth_fn: Optional[Callable[[], int]] = None,
-                 registry: Optional[Registry] = None):
+                 registry: Optional[Registry] = None,
+                 latency_buckets: Optional[Sequence[float]] = None):
         self._lock = make_lock("ServingStats._lock")
         self._lat = deque(maxlen=max(window, 1))     # (done_ts, latency_s)
         self._fills = deque(maxlen=max(window, 1))   # (n_real, bucket)
+        # top-K (latency_s, trace_id) among TRACED completions — the
+        # `/stats` slowest_traces view that turns a bad percentile into a
+        # concrete trace id to pull from the merged timeline
+        self._slowest: list = []
         self.queue_depth_fn = queue_depth_fn
         self._started = time.monotonic()
         # registry-backed counters/histogram: the single source of truth
@@ -93,10 +98,18 @@ class ServingStats:
         self._c_compiles = self.registry.counter(
             "pva_serving_compiled_buckets_total",
             "new (bucket, views) shapes compiled by the engine")
+        # bucket ladder: explicit per-instance boundaries win, then any
+        # registered family default (obs.registry.set_family_buckets),
+        # then the shared serving ladder — the per-family configurability
+        # the one-size-for-all LATENCY_BUCKETS lacked
+        from pytorchvideo_accelerate_tpu.obs.registry import family_buckets
+
         self._h_latency = self.registry.histogram(
             "pva_serving_request_latency_seconds",
             "enqueue-to-response latency of completed requests",
-            buckets=LATENCY_BUCKETS)
+            buckets=(tuple(latency_buckets) if latency_buckets
+                     else family_buckets("pva_serving_request_latency_seconds",
+                                         default=LATENCY_BUCKETS)))
         self.registry.gauge(
             "pva_serving_queue_depth",
             "requests queued but not yet batched").set_function(
@@ -107,17 +120,44 @@ class ServingStats:
             "seconds since this ServingStats was created").set_function(
                 lambda: time.monotonic() - self._started)
 
+    _SLOWEST_KEEP = 8
+
     def observe_batch(self, n_real: int, bucket: int,
-                      latencies_s: Sequence[float]) -> None:
+                      latencies_s: Sequence[float],
+                      trace_ids: Optional[Sequence[Optional[str]]] = None,
+                      ) -> None:
+        """`trace_ids` (parallel to `latencies_s`, entries may be None)
+        links each completion to its sampled trace: the latency histogram
+        pins the trace id as the bucket's exemplar, and the top-K slowest
+        land in `slowest_traces()` — a p99 sample becomes a greppable
+        trace instead of an anonymous number."""
         now = time.monotonic()
         self._c_requests.inc(len(latencies_s))
         self._c_batches.inc()
-        for lat in latencies_s:
-            self._h_latency.observe(lat)
+        for i, lat in enumerate(latencies_s):
+            tid = (trace_ids[i] if trace_ids is not None
+                   and i < len(trace_ids) else None)
+            self._h_latency.observe(lat, trace_id=tid)
         with self._lock:
             self._fills.append((int(n_real), int(bucket)))
-            for lat in latencies_s:
+            for i, lat in enumerate(latencies_s):
                 self._lat.append((now, float(lat)))
+                tid = (trace_ids[i] if trace_ids is not None
+                       and i < len(trace_ids) else None)
+                if tid:
+                    self._slowest.append((float(lat), str(tid)))
+            if len(self._slowest) > self._SLOWEST_KEEP:
+                self._slowest.sort(reverse=True)
+                del self._slowest[self._SLOWEST_KEEP:]
+
+    def slowest_traces(self, k: int = 5) -> list:
+        """Top-k traced completions by latency: [{trace_id, latency_ms}]
+        (served on `/stats`; NOT part of the flat snapshot() dict — the
+        tracker-facing surface stays {str: float})."""
+        with self._lock:
+            worst = sorted(self._slowest, reverse=True)[:k]
+        return [{"trace_id": tid, "latency_ms": round(lat * 1e3, 3)}
+                for lat, tid in worst]
 
     def observe_rejected(self, cause: str = "503", n: int = 1) -> None:
         """A request shed before completion; `cause` is the HTTP status the

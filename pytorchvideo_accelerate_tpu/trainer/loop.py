@@ -119,6 +119,16 @@ class Trainer:
         obs.configure(enabled=cfg.obs.enabled,
                       capacity=cfg.obs.flight_recorder_events)
         self.watchdog: Optional[obs.Watchdog] = None
+        if self.obs_on and cfg.obs.trace_sample_rate > 0:
+            # distributed tracing (obs/trace.py): head-sample train steps;
+            # each sampled step becomes a root span tagged (epoch, gstep)
+            # whose child spans (step/log/h2d) correlate with the
+            # jax.profiler.StepTraceAnnotation window of the same gstep.
+            # The ring dumps to <output_dir>/trace_ring.json at fit() exit.
+            obs.trace.configure_tracing(
+                cfg.obs.trace_sample_rate, seed=cfg.seed,
+                capacity=cfg.obs.trace_ring_events,
+                output_dir=cfg.checkpoint.output_dir)
         if self.obs_on:
             obs.get_recorder().install(cfg.checkpoint.output_dir)
             if cfg.obs.watchdog_timeout_s > 0:
@@ -909,6 +919,9 @@ class Trainer:
         tguard = self.train_guard
         hang_watch = self.watchdog  # collective-hang attribution source
         host_tag = hangcheck_host_tag() if hang_watch is not None else ""
+        # distributed tracing: hoisted armed check — disarmed, the step
+        # loop pays one bool test per step (obs.trace.NOOP is shared)
+        traced = obs.trace.get_tracer() is not None
         window_t0 = time.perf_counter()
         try:
             # while (not for): a guard rollback restores an EARLIER
@@ -961,18 +974,25 @@ class Trainer:
                     # detector exists to prevent; any LATER slow dispatch
                     # is either a real wedge or a recompile the
                     # recompile guard flags anyway.
-                    with (hang_watch.section(
-                            "collective",
-                            f"step_dispatch {host_tag} gstep={gstep}")
-                          if hang_watch is not None
-                          and recompile_guard.armed else nullcontext()):
-                        with obs.span("step"):
-                            with jax.profiler.StepTraceAnnotation(
-                                    "train", step_num=gstep):
-                                self.state, metrics = self.train_step(
-                                    self.state, global_batch,
-                                    self.rng.step_key(gstep)
-                                )
+                    # sampled steps become trace roots tagged (epoch,
+                    # gstep) — the same coordinates the profiler's
+                    # StepTraceAnnotation window carries, so a merged
+                    # timeline and an XLA trace correlate by gstep
+                    with (obs.trace.root("train_step", epoch=epoch,
+                                         gstep=gstep)
+                          if traced else nullcontext()):
+                        with (hang_watch.section(
+                                "collective",
+                                f"step_dispatch {host_tag} gstep={gstep}")
+                              if hang_watch is not None
+                              and recompile_guard.armed else nullcontext()):
+                            with obs.span("step"):
+                                with jax.profiler.StepTraceAnnotation(
+                                        "train", step_num=gstep):
+                                    self.state, metrics = self.train_step(
+                                        self.state, global_batch,
+                                        self.rng.step_key(gstep)
+                                    )
                     gstep += 1
                     train_steps_this_epoch += 1
                     if not recompile_guard.armed:
@@ -1214,6 +1234,10 @@ class Trainer:
             if profiling:
                 jax.profiler.stop_trace()
                 main_print(f"profile trace written to {cfg.profile_dir}")
+            # the distributed-trace ring lands next to the flight record
+            # (<output_dir>/trace_ring.json) on clean exit AND on a crash;
+            # no-op when tracing is disarmed
+            obs.trace.dump()
             if self.watchdog is not None:
                 self.watchdog.clear("train")
                 self.watchdog.stop()

@@ -310,6 +310,23 @@ def lint_snapshot(root: str = "", max_items: int = 40) -> dict:
     return out
 
 
+def trace_snapshot() -> dict:
+    """Distributed-tracing health (obs/trace.py — docs/OBSERVABILITY.md
+    § distributed tracing): whether the tracer is armed, ring occupancy
+    vs capacity, how many heads were sampled, the tracer's self-measured
+    bookkeeping overhead, the slowest root spans still in the ring, and
+    the last export path (`trace_ring.json`) a second shell can merge
+    with `pva-tpu-trace`."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.obs import trace
+
+        out.update(trace.snapshot())
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def tsan_snapshot() -> dict:
     """Dynamic-sanitizer health (analysis/tsan.py — docs/STATIC_ANALYSIS.md
     § dynamic sanitizer): whether a pva-tpu-tsan run happened in this
@@ -394,6 +411,7 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "files": file_facts(),
         "loopback_listeners": loopback_listeners(),
         "obs": obs_snapshot(obs_dir),
+        "trace": trace_snapshot(),
         "lint": lint_snapshot(),
         "tsan": tsan_snapshot(),
         "reliability": reliability_snapshot(obs_dir),

@@ -6,6 +6,9 @@
 # scenario (retry/preemption/shedding recovery asserted under seeded
 # faults — including the PR-9 self-healing legs: guard_nan NaN-rollback,
 # corrupt-clip quarantine, and the wedged-collective hang detector).
+# After the gates, a NON-fatal pva-tpu-perfdiff report compares the two
+# newest BENCH_r*.json rounds (perf trends inform here; the fatal perf
+# gates live in bench --smoke).
 # Exit codes: 0 clean, 1 findings, 2 usage — CI gates on nonzero.
 # Extra args pass through to the lint step only
 # (e.g. `scripts/analyze.sh --select host-sync`).
@@ -18,6 +21,17 @@ env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python -m pytorchvideo_accelerate_tpu.analysis.tsan_report --smoke
 
-exec env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+rc=0
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
-  python -m pytorchvideo_accelerate_tpu.reliability.chaos --smoke
+  python -m pytorchvideo_accelerate_tpu.reliability.chaos --smoke || rc=$?
+
+# perf-diff report (non-fatal): pct deltas between the two newest bench
+# rounds (selection lives in the tool's no-path mode); suspect rounds
+# are refused per the standing no-CPU-numbers-as-device-numbers rule
+echo "[perfdiff] two newest rounds in ${ROOT}" >&2
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  python -m pytorchvideo_accelerate_tpu.analysis.perfdiff \
+  --dir "${ROOT}" || true
+
+exit "$rc"

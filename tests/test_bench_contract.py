@@ -149,6 +149,7 @@ def test_finalize_line_fits_driver_capture():
         "multichip_error": "no trustworthy device numbers " + "z" * 200,
         "serve_rps": 123.456, "serve_p99_ms_under_load": 87.654,
         "swap_blackout_ms": 12.345, "fleet_shed_frac": 0.0123,
+        "trace_sampled": 1234, "trace_overhead_frac": 0.01234,
         "fleet_error": "no trustworthy device numbers " + "w" * 200,
         "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
         "error": "watchdog fired: " + "y" * 3000,
@@ -274,6 +275,25 @@ def test_finalize_fleet_lane_keys_ride_the_headline():
     for key in ("serve_rps", "serve_p99_ms_under_load",
                 "swap_blackout_ms", "fleet_shed_frac"):
         assert key not in out
+
+
+def test_finalize_trace_keys_ride_the_headline():
+    """The fleet lane's distributed-tracing verdicts (sampled-trace count
+    and the tracer's self-measured overhead fraction — `--smoke` asserts
+    >=1 and <0.02 respectively) plumb through finalize; a failed/suspect
+    fleet lane drops them with the rest of the lane's numbers (they are
+    meaningless without the run that produced them)."""
+    extras = {"serve_rps": 118.2, "trace_sampled": 42,
+              "trace_overhead_frac": 0.0031}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["trace_sampled"] == 42
+    assert out["trace_overhead_frac"] == 0.0031
+
+    out = bench.finalize(
+        _model(), {**extras, "fleet_error": "cpu fallback"},
+        user_smoke=False)
+    assert "trace_sampled" not in out
+    assert "trace_overhead_frac" not in out
 
 
 def test_finalize_serving_lane_keys():
