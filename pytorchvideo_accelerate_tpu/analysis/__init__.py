@@ -28,6 +28,11 @@ from pytorchvideo_accelerate_tpu.analysis.recompile_guard import (  # noqa: F401
     RecompileGuard,
     cache_size,
 )
+
+# jaxpr/HLO-level passes (pva-tpu-graphcheck) are NOT imported here:
+# analysis/__init__ must stay importable without jax (the linter runs in
+# CI and in the doctor against broken trees); reach them via
+# `pytorchvideo_accelerate_tpu.analysis.graphcheck` directly.
 from pytorchvideo_accelerate_tpu.analysis.tsan import (  # noqa: F401
     Tsan,
     get_tsan,

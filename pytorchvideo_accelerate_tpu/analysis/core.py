@@ -169,6 +169,7 @@ def iter_suppressions(source: str) -> Iterator[Suppression]:
 def default_rules() -> List[Rule]:
     """The shipped rule set (one import site so the CLI, the tests, the
     bench smoke gate, and the doctor all lint with identical rules)."""
+    from pytorchvideo_accelerate_tpu.analysis.rules_dtype import DtypeLiteralRule
     from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HostSyncRule
     from pytorchvideo_accelerate_tpu.analysis.rules_lock import LockDisciplineRule
     from pytorchvideo_accelerate_tpu.analysis.rules_mesh import MeshDisciplineRule
@@ -185,7 +186,8 @@ def default_rules() -> List[Rule]:
 
     return [HostSyncRule(), RecompileHazardRule(), LockDisciplineRule(),
             TracerLeakRule(), SpanDisciplineRule(), ThreadFactoryRule(),
-            ThreadJoinRule(), MeshDisciplineRule(), TracePropagationRule()]
+            ThreadJoinRule(), MeshDisciplineRule(), TracePropagationRule(),
+            DtypeLiteralRule()]
 
 
 def parse_module(source: str, path: str) -> ModuleInfo:

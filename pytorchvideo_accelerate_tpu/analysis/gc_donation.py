@@ -1,0 +1,213 @@
+"""graphcheck donation pass: verify buffer donation actually aliased.
+
+`jax.jit(step, donate_argnums=0)` is a *request*: jax matches each
+donated input leaf to an output with the identical aval and records the
+pair in the compiled module's `input_output_alias` map; XLA then reuses
+the input buffer for the output. Two failure modes are silent:
+
+- **declared-but-not-aliased**: a donated leaf found no aval-matching
+  output (a dtype/shape drift — e.g. an optimizer leaf that upcasts, or
+  a state field the step stopped returning). jax prints one easily-lost
+  warning at lowering time and then permanently double-buffers that
+  leaf; at production model sizes that is params-sized HBM gone.
+- **donatable-but-undeclared**: a state leaf that *could* alias (same
+  aval in, same out) but was never declared — bytes left on the table.
+
+This pass reads both directly from the artifacts: declared donation from
+`lowered.args_info` (per-leaf `.donated`), achieved aliasing from the
+compiled HLO's `input_output_alias={...}` header, and candidate outputs
+from the jaxpr's out avals. Flat leaf order == HLO parameter order for
+jit-compiled functions (checked against the alias map's parameter
+numbers; a mismatch degrades to a summary caveat instead of lying).
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# one alias entry: "{out_index...}: (param_number, {param_index}, kind)"
+_ALIAS_ENTRY_RE = re.compile(
+    r"\{([0-9, ]*)\}\s*:\s*\(\s*(\d+)\s*,\s*\{[0-9, ]*\}\s*"
+    r"(?:,\s*(may-alias|must-alias))?\s*\)")
+
+
+def _balanced_braces(text: str, start: int) -> str:
+    """Contents of the brace group opening at text[start] == '{' (the
+    alias map nests braces, so a non-greedy regex stops too early)."""
+    depth = 0
+    for i in range(start, len(text)):
+        if text[i] == "{":
+            depth += 1
+        elif text[i] == "}":
+            depth -= 1
+            if depth == 0:
+                return text[start + 1:i]
+    return ""
+
+
+def parse_input_output_aliases(hlo_text: str) -> Dict[int, int]:
+    """{param_number: output_index} from a compiled HLO module header.
+    Empty dict when the module declares no aliasing."""
+    marker = "input_output_alias="
+    pos = hlo_text.find(marker)
+    if pos < 0:
+        return {}
+    body = _balanced_braces(hlo_text, pos + len(marker))
+    out: Dict[int, int] = {}
+    for entry in _ALIAS_ENTRY_RE.finditer(body):
+        out_idx = int(entry.group(1).split(",")[0] or 0)
+        out[int(entry.group(2))] = out_idx
+    return out
+
+
+def _np_dtype(dtype):
+    """np.dtype where possible; jax extended dtypes (PRNG keys) pass
+    through unconverted — they still expose name/itemsize."""
+    try:
+        return np.dtype(dtype)
+    except TypeError:
+        return dtype
+
+
+def _leaf_bytes(shape, dtype) -> int:
+    return (int(np.prod(shape, dtype=np.int64))
+            * int(getattr(dtype, "itemsize", 4)))
+
+
+def _flatten_args_info(args_info) -> List[Tuple[str, tuple, Any, bool]]:
+    """[(path, shape, dtype, donated)] in flat (HLO parameter) order."""
+    import jax
+
+    leaves_with_path = jax.tree_util.tree_flatten_with_path(args_info)[0]
+    out = []
+    for path, info in leaves_with_path:
+        name = jax.tree_util.keystr(path)
+        out.append((name, tuple(info.shape), _np_dtype(info.dtype),
+                    bool(getattr(info, "donated", False))))
+    return out
+
+
+def check_donation(
+    fn,
+    args: Sequence[Any],
+    state_argnums: Sequence[int] = (0,),
+    lowered=None,
+    compiled=None,
+    out_avals: Optional[Sequence[Any]] = None,
+) -> Tuple[List[dict], Dict[str, Any]]:
+    """Run the donation pass over one jitted function + example args.
+
+    `state_argnums`: which positional args hold reusable state (the
+    donatable-but-undeclared scan is scoped to them — batches and rng
+    keys are consumed per call, not round-tripped, so an "undeclared"
+    report on them would be noise).
+
+    Pre-lowered/compiled artifacts can be passed in to avoid paying the
+    trace/compile twice when the caller already has them."""
+    import jax
+
+    if lowered is None:
+        lowered = fn.lower(*args)
+    if compiled is None:
+        compiled = lowered.compile()
+    leaves = _flatten_args_info(lowered.args_info)
+    if out_avals is None:
+        out_avals = jax.tree_util.tree_leaves(
+            jax.eval_shape(fn, *args))
+    try:
+        hlo = compiled.as_text()
+    except Exception as e:  # backend without text dump: degrade loudly
+        return [], {"error": f"compiled HLO unavailable: {e}",
+                    "declared": sum(1 for l in leaves if l[3])}
+    aliases = parse_input_output_aliases(hlo)
+
+    # which flat leaf indices belong to the state argnums
+    arg_leaf_ranges: List[Tuple[int, int]] = []
+    i = 0
+    for a in args:
+        n = len(jax.tree_util.tree_leaves(a))
+        arg_leaf_ranges.append((i, i + n))
+        i += n
+    total_leaves = i
+    state_idx = set()
+    for argnum in state_argnums:
+        lo, hi = arg_leaf_ranges[argnum]
+        state_idx.update(range(lo, hi))
+
+    findings: List[dict] = []
+    caveats: List[str] = []
+    if total_leaves != len(leaves):
+        caveats.append(
+            f"args_info leaf count {len(leaves)} != flat arg leaves "
+            f"{total_leaves}; parameter mapping unverified")
+
+    declared = [i for i, l in enumerate(leaves) if l[3]]
+    aliased = sorted(aliases)
+    bytes_donated = 0
+    bytes_failed = 0
+    for i in declared:
+        name, shape, dtype, _ = leaves[i]
+        nbytes = _leaf_bytes(shape, dtype)
+        if i in aliases:
+            bytes_donated += nbytes
+        else:
+            bytes_failed += nbytes
+            findings.append({
+                "pass": "donation",
+                "site": name,
+                "message": (
+                    f"declared donation NOT aliased: {name} "
+                    f"{dtype}{list(shape)} ({nbytes} B) was donated but "
+                    "the compiled module aliases no output to it — the "
+                    "buffer is silently double-buffered (aval drift "
+                    "between the input leaf and every output)"),
+                "details": {"param": i, "bytes": nbytes},
+            })
+
+    # donatable-but-undeclared: unclaimed output avals greedily matched
+    # against undeclared state leaves by (shape, dtype)
+    claimed_outputs = set(aliases.values())
+    pool: Dict[Tuple[tuple, str], int] = {}
+    for oi, aval in enumerate(out_avals):
+        if oi in claimed_outputs:
+            continue
+        key = (tuple(aval.shape), str(_np_dtype(aval.dtype)))
+        pool[key] = pool.get(key, 0) + 1
+    bytes_undeclared = 0
+    undeclared = 0
+    for i in sorted(state_idx):
+        name, shape, dtype, donated = leaves[i]
+        if donated:
+            continue
+        key = (shape, str(dtype))
+        if pool.get(key, 0) > 0:
+            pool[key] -= 1
+            nbytes = _leaf_bytes(shape, dtype)
+            bytes_undeclared += nbytes
+            undeclared += 1
+            findings.append({
+                "pass": "donation",
+                "site": name,
+                "message": (
+                    f"donatable but undeclared: state leaf {name} "
+                    f"{dtype}{list(shape)} has a matching output aval but "
+                    f"no donation — {nbytes} B of HBM left double-"
+                    "buffered (add it to donate_argnums)"),
+                "details": {"param": i, "bytes": nbytes},
+            })
+
+    summary = {
+        "declared": len(declared),
+        "aliased": len(aliased),
+        "declared_unaliased": len(declared) - sum(
+            1 for i in declared if i in aliases),
+        "undeclared_donatable": undeclared,
+        "bytes_donated": int(bytes_donated),
+        "bytes_failed": int(bytes_failed),
+        "bytes_undeclared": int(bytes_undeclared),
+        "caveats": caveats,
+    }
+    return findings, summary

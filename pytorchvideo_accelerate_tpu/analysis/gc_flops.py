@@ -1,0 +1,238 @@
+"""graphcheck FLOPs pass: analytical per-primitive FLOPs from the jaxpr.
+
+Why it exists (ROADMAP item 1, "honest MFU"): the only FLOPs source the
+repo had was XLA's cost model (`compiled.cost_analysis()`), whose
+availability varies by backend/version — which is exactly why `mfu` has
+been null on every round where capture failed. Shapes don't vary: a
+`dot_general`'s FLOPs are arithmetic over its avals, a conv's over its
+output grid and kernel. This pass walks the closed jaxpr (recursing
+through pjit/custom-grad calls, multiplying scanned bodies by their trip
+count) and counts:
+
+- `dot`: 2 * batch * M * N * K per dot_general;
+- `conv`: 2 * out_elements * kernel_spatial * (C_in / feature_groups)
+  per conv_general_dilated (the backward data/filter convs are plain
+  conv eqns in the differentiated jaxpr, so fwd+bwd is counted
+  naturally, remat recompute included);
+- `elementwise`/`reduce`: 1 FLOP per output (resp. input) element for
+  the plain arithmetic primitives — keeps parity with the XLA cost
+  model tight on conv nets where BN/activation traffic is a few
+  percent. Transcendentals (exp/log/...) are deliberately *excluded*:
+  XLA books them under "transcendental", not "flops", and the parity
+  check compares against "flops".
+
+`while` bodies can't be statically counted (trip count is dynamic);
+they are counted ONCE and surfaced in `caveats` — a lying silent zero
+is worse than a flagged lower bound. `cond` takes the max branch.
+
+The result cross-checks against the cost model where capture succeeds
+(graphcheck's flops pass findings) and becomes `mfu_analytic`'s
+numerator in the trainer/multichip bench lanes when capture fails.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Tuple
+
+# 1-FLOP-per-element arithmetic primitives (XLA cost-model "flops" class).
+# Selects and comparisons ARE counted: the guard-armed train step wraps
+# every state leaf in jnp.where (param-sized select_n trees), and XLA
+# books those as flops — excluding them put the armed ViT-B step 35%
+# under the cost model. Transcendentals (exp/log/erf/...) stay excluded:
+# XLA reports them under "transcendental", not "flops".
+_ELEMENTWISE_1FLOP = frozenset({
+    "add", "sub", "mul", "div", "max", "min", "neg", "abs", "rem",
+    "add_any", "square", "integer_pow", "pow", "rsqrt", "sqrt",
+    "select_n", "eq", "ne", "lt", "le", "gt", "ge", "and", "or", "xor",
+    "not", "is_finite", "sign", "floor", "ceil", "round",
+})
+_REDUCE_PRIMS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
+    "reduce_and", "reduce_or", "argmax", "argmin",
+})
+
+
+def _prod(xs) -> int:
+    out = 1
+    for x in xs:
+        out *= int(x)
+    return out
+
+
+def dot_general_flops(eqn) -> float:
+    """2 * batch * M * N * K from the eqn's avals + dimension_numbers."""
+    lhs, rhs = (v.aval for v in eqn.invars[:2])
+    (lc, rc), (lb, rb) = eqn.params["dimension_numbers"]
+    batch = _prod(lhs.shape[d] for d in lb)
+    contract = _prod(lhs.shape[d] for d in lc)
+    m = _prod(lhs.shape[d] for d in range(len(lhs.shape))
+              if d not in set(lc) | set(lb))
+    n = _prod(rhs.shape[d] for d in range(len(rhs.shape))
+              if d not in set(rc) | set(rb))
+    return 2.0 * batch * m * n * contract
+
+
+def _conv_valid_taps(out_size: int, k: int, stride: int, pad_lo: int,
+                     lhs_dil: int, rhs_dil: int, in_size: int) -> int:
+    """Real multiply-adds along one spatial dim: XLA's cost model counts
+    only taps that land on actual input elements — padding positions and
+    the zeros interleaved by lhs_dilation (backward-data convs) cost
+    nothing, so an analytic count that ignores them overshoots SAME-padded
+    nets by ~15% and backward passes by more."""
+    span = (in_size - 1) * lhs_dil + 1
+    taps = 0
+    for o in range(out_size):
+        base = o * stride - pad_lo
+        for d in range(k):
+            p = base + d * rhs_dil
+            if 0 <= p < span and p % lhs_dil == 0:
+                taps += 1
+    return taps
+
+
+def conv_flops(eqn) -> float:
+    """2 * batch * C_out * (C_in / feature_groups) * valid_taps, exactly
+    the real-multiply-add count the XLA cost model reports."""
+    lhs, rhs = (v.aval for v in eqn.invars[:2])
+    out = eqn.outvars[0].aval
+    dn = eqn.params["dimension_numbers"]
+    strides = eqn.params["window_strides"]
+    padding = eqn.params["padding"]
+    lhs_dil = eqn.params.get("lhs_dilation") or (1,) * len(strides)
+    rhs_dil = eqn.params.get("rhs_dilation") or (1,) * len(strides)
+    taps = 1
+    for i, (ld, rd) in enumerate(zip(dn.lhs_spec[2:], dn.rhs_spec[2:])):
+        taps *= _conv_valid_taps(
+            out.shape[dn.out_spec[2 + i]], rhs.shape[rd], strides[i],
+            padding[i][0], lhs_dil[i], rhs_dil[i], lhs.shape[ld])
+    batch = out.shape[dn.out_spec[0]]
+    c_out = out.shape[dn.out_spec[1]]
+    c_in_per_group = rhs.shape[dn.rhs_spec[1]]  # already / feature_groups
+    return 2.0 * batch * c_out * c_in_per_group * taps
+
+
+def _sub_closed(params_value) -> List[Any]:
+    """ClosedJaxpr values inside one eqn-param value (tuples recursed)."""
+    from jax._src import core as jcore
+
+    out = []
+    if isinstance(params_value, jcore.ClosedJaxpr):
+        out.append(params_value)
+    elif isinstance(params_value, (tuple, list)):
+        for v in params_value:
+            out.extend(_sub_closed(v))
+    return out
+
+
+def jaxpr_flops(closed_jaxpr) -> Dict[str, Any]:
+    """Analytical FLOPs of a closed jaxpr: total + per-class breakdown +
+    caveats (unstatically-countable constructs encountered)."""
+    counts = {"dot": 0.0, "conv": 0.0, "elementwise": 0.0, "reduce": 0.0}
+    eqn_counts = {"dot_general": 0, "conv_general_dilated": 0}
+    caveats: List[str] = []
+
+    def walk(jaxpr, mult: float) -> None:
+        for eqn in jaxpr.eqns:
+            name = eqn.primitive.name
+            if name == "dot_general":
+                counts["dot"] += mult * dot_general_flops(eqn)
+                eqn_counts["dot_general"] += 1
+            elif name == "conv_general_dilated":
+                counts["conv"] += mult * conv_flops(eqn)
+                eqn_counts["conv_general_dilated"] += 1
+            elif name in _ELEMENTWISE_1FLOP:
+                counts["elementwise"] += mult * _prod(
+                    eqn.outvars[0].aval.shape)
+            elif name in _REDUCE_PRIMS:
+                counts["reduce"] += mult * _prod(eqn.invars[0].aval.shape)
+            elif name == "scan":
+                inner = eqn.params["jaxpr"]
+                walk(inner.jaxpr, mult * int(eqn.params.get("length", 1)))
+            elif name == "while":
+                # dynamic trip count: count the body ONCE, flag it
+                caveats.append("while_loop counted once (dynamic trip "
+                               "count)")
+                walk(eqn.params["body_jaxpr"].jaxpr, mult)
+            elif name == "cond":
+                branch_totals = []
+                for br in eqn.params["branches"]:
+                    sub = jaxpr_flops(br)
+                    branch_totals.append(sub)
+                    caveats.extend(sub["caveats"])
+                if branch_totals:
+                    best = max(branch_totals,
+                               key=lambda s: s["flops_total"])
+                    for k in counts:
+                        counts[k] += mult * best["by_class"][k]
+                    for k in eqn_counts:
+                        eqn_counts[k] += best["eqn_counts"][k]
+            else:
+                # generic recursion: pjit / remat / custom_jvp / custom_vjp
+                # / closed_call all carry their body as ClosedJaxpr params
+                for v in eqn.params.values():
+                    for sub in _sub_closed(v):
+                        walk(sub.jaxpr, mult)
+
+    walk(closed_jaxpr.jaxpr, 1.0)
+    total = sum(counts.values())
+    return {
+        "flops_total": total,
+        "by_class": counts,
+        "eqn_counts": eqn_counts,
+        "caveats": sorted(set(caveats)),
+    }
+
+
+def check_flops(closed_jaxpr, costmodel_flops: Optional[float],
+                rtol: float = 0.25, partitions: int = 1,
+                ) -> Tuple[List[dict], Dict[str, Any]]:
+    """The pass: analytic count + cross-check against the XLA cost model
+    when capture succeeded. A finding means the two FLOPs sources disagree
+    past `rtol` — one of them is lying, and MFU headlines built on either
+    are not trustworthy until resolved. No cost model = no finding (the
+    analytic number simply becomes the only source, `mfu_source:
+    analytic`).
+
+    `partitions`: device count the compiled program was partitioned over.
+    The analytic count is GLOBAL (the whole jaxpr, counted once), while
+    `cost_analysis()` reports the per-partition program — the cross-check
+    compares global/partitions against it. Approximate by construction:
+    replicated work (the optimizer update) runs whole on every partition
+    but is spread by the division; `rtol` absorbs it."""
+    analytic = jaxpr_flops(closed_jaxpr)
+    summary = dict(analytic)
+    summary["costmodel_flops"] = costmodel_flops
+    summary["partitions"] = int(partitions)
+    findings: List[dict] = []
+    if costmodel_flops and analytic["flops_total"] > 0:
+        per_part = analytic["flops_total"] / max(int(partitions), 1)
+        rel = abs(per_part - costmodel_flops) / max(costmodel_flops, 1.0)
+        summary["costmodel_rel_err"] = round(rel, 4)
+        if rel > rtol:
+            findings.append({
+                "pass": "flops",
+                "site": "whole-program",
+                "message": (
+                    f"analytic FLOPs {per_part:.3e} (global "
+                    f"{analytic['flops_total']:.3e} / {partitions} "
+                    f"partition(s)) vs XLA cost model "
+                    f"{costmodel_flops:.3e} disagree by "
+                    f"{rel:.1%} (> {rtol:.0%}): one of the two MFU "
+                    "numerators is wrong"),
+                "details": {"analytic": analytic["flops_total"],
+                            "costmodel": costmodel_flops,
+                            "partitions": int(partitions),
+                            "rel_err": rel},
+            })
+    if summary["caveats"]:
+        summary["lower_bound"] = True
+    # guard against NaN/inf arithmetic surprises: the denominator of a
+    # headline metric must be a finite positive number or absent
+    if not math.isfinite(summary["flops_total"]):
+        findings.append({
+            "pass": "flops", "site": "whole-program",
+            "message": "analytic FLOPs overflowed to a non-finite value",
+            "details": {},
+        })
+    return findings, summary

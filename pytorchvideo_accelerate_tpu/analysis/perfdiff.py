@@ -37,8 +37,10 @@ HIGHER_BETTER = (
     "trainer_vs_rawstep",
     "tflops_per_sec",
     "mfu",
+    "mfu_analytic",
     "trainer_mfu",
     "multichip_mfu",
+    "multichip_mfu_analytic",
     "serve_rps",
     "serve_fill_ratio",
 )
@@ -95,8 +97,19 @@ def diff_rounds(old: dict, new: dict, threshold: float = 0.05) -> dict:
     keys: Dict[str, dict] = {}
     regressions = []
     improvements = []
+    appeared = []
     for key in HIGHER_BETTER + LOWER_BETTER:
         ov, nv = old.get(key), new.get(key)
+        if ov is None and isinstance(nv, (int, float)) \
+                and not isinstance(nv, bool):
+            # null -> number is a metric APPEARING (a lane started
+            # measuring something it couldn't before — e.g. mfu_analytic
+            # landing on a round after an r02-shaped round whose mfu was
+            # null), never a regression-from-zero or a divide-by-zero:
+            # "wasn't measured" and "measured zero" are different facts
+            keys[key] = {"old": None, "new": float(nv), "pct": None}
+            appeared.append(key)
+            continue
         if not isinstance(ov, (int, float)) or not isinstance(nv, (int, float)):
             continue
         ov, nv = float(ov), float(nv)
@@ -142,6 +155,7 @@ def diff_rounds(old: dict, new: dict, threshold: float = 0.05) -> dict:
         "models": models,
         "regressions": sorted(regressions),
         "improvements": sorted(improvements),
+        "appeared": sorted(appeared),
         "ok": not regressions,
     }
 

@@ -1,0 +1,142 @@
+"""Rule `dtype-literal`: f32 casts in model/ops hot modules must route
+through the precision policy seam.
+
+The models compute in bf16 by policy (`create_model(mixed_precision)`),
+and the deliberate f32 islands — classifier heads, softmax logits, loss
+math, reference accumulations — go through `precision.f32_island` so the
+policy stays auditable (and the graphcheck dtype pass can allowlist the
+islands by qualname). A bare `x.astype(jnp.float32)` or
+`jnp.asarray(x, jnp.float32)` in a hot model/ops module is either an
+accidental upcast (doubles the tensor's bytes, halves its MXU rate,
+silently — exactly the class of bug analysis/gc_dtype.py audits in the
+compiled graph) or an undeclared island; both must become explicit.
+
+Scope: the model/ops hot modules only (`DTYPE_HOT_MODULES`). `dtype=`
+*defaults* on module dataclass fields and parameter-init dtypes are not
+casts and are not flagged — the rule targets value conversions. The
+detection is alias-proof in the thread-factory style: module aliases
+(`import jax.numpy as J`, `import numpy`) and from-import as-names
+(`from jax.numpy import float32 as f32`) cannot launder a cast past the
+gate. Suppressions follow the house syntax:
+`# pva: disable=dtype-literal -- reason`.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, Set
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    dotted_name,
+)
+
+# the model/ops hot path: every module whose tensors ride the compiled
+# train/serve step. The precision seam itself is exempt (it IS the rails).
+DTYPE_HOT_MODULES = (
+    "models/common.py",
+    "models/heads.py",
+    "models/resnet3d.py",
+    "models/slowfast.py",
+    "models/x3d.py",
+    "models/r2plus1d.py",
+    "models/csn.py",
+    "models/mvit.py",
+    "models/videomae.py",
+    "ops/attention.py",
+    "ops/depthwise.py",
+    "ops/pallas_attention.py",
+    "ops/pallas_depthwise.py",
+)
+
+# modules whose `float32` attribute is the flagged literal
+_F32_MODULES = ("jax.numpy", "numpy", "jnp", "np")
+
+# call names that perform a cast when handed a dtype argument
+_CAST_CALLS = ("asarray", "array")
+
+
+def _f32_module_aliases(tree: ast.AST) -> Set[str]:
+    """Every local name `jax.numpy` / `numpy` is bound to: plain
+    imports, `import jax.numpy as J` / `import numpy as n`, AND the
+    `from jax import numpy [as X]` spelling — any of them could launder
+    a cast otherwise."""
+    out = set(_F32_MODULES)
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in ("jax.numpy", "numpy") and alias.asname:
+                    out.add(alias.asname)
+        elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+            for alias in node.names:
+                if alias.name == "numpy":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+def _f32_name_aliases(tree: ast.AST) -> Set[str]:
+    """Local names bound by `from jax.numpy|numpy import float32 [as f]`."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+                "jax.numpy", "numpy"):
+            for alias in node.names:
+                if alias.name == "float32":
+                    out.add(alias.asname or alias.name)
+    return out
+
+
+class DtypeLiteralRule(Rule):
+    name = "dtype-literal"
+    description = ("bare jnp.float32/np.float32 cast in a model/ops hot "
+                   "module — route through precision.f32_island so the "
+                   "bf16 policy's designed f32 islands stay auditable")
+
+    def __init__(self, hot_modules=DTYPE_HOT_MODULES):
+        self.hot_modules = tuple(hot_modules)
+
+    def _is_f32_literal(self, node: ast.AST, modules: Set[str],
+                        names: Set[str]) -> bool:
+        if isinstance(node, ast.Attribute) and node.attr == "float32":
+            return dotted_name(node.value) in modules
+        if isinstance(node, ast.Name):
+            return node.id in names
+        return False
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not module.matches(self.hot_modules):
+            return
+        modules = _f32_module_aliases(module.tree)
+        names = _f32_name_aliases(module.tree)
+
+        def f32(node):
+            return self._is_f32_literal(node, modules, names)
+
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dtype_args = [kw.value for kw in node.keywords
+                          if kw.arg == "dtype"]
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "astype"):
+                # x.astype(jnp.float32) — positional or keyword
+                if any(f32(a) for a in node.args) or any(map(f32, dtype_args)):
+                    yield self.finding(
+                        module, node,
+                        "bare `.astype(float32)` cast in a hot module: use "
+                        "`precision.f32_island(x)` so the designed f32 "
+                        "island is explicit (docs/STATIC_ANALYSIS.md)")
+                continue
+            dn = dotted_name(node.func)
+            head, _, tail = dn.rpartition(".")
+            if tail in _CAST_CALLS and head in modules:
+                # jnp.asarray(x, jnp.float32) / np.array(x, dtype=np.float32)
+                cands = list(node.args[1:2]) + dtype_args
+                if any(f32(a) for a in cands):
+                    yield self.finding(
+                        module, node,
+                        f"`{dn}(..., float32)` cast in a hot module: use "
+                        "`precision.f32_island(x)` so the designed f32 "
+                        "island is explicit (docs/STATIC_ANALYSIS.md)")

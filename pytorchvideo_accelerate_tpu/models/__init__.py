@@ -218,7 +218,9 @@ def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None):
             f"attention={cfg.attention!r} needs the device mesh: "
             "create_model(cfg, mixed_precision, mesh=mesh)"
         )
-    dtype = jnp.bfloat16 if mixed_precision in ("bf16", "fp16") else jnp.float32
+    from pytorchvideo_accelerate_tpu.precision import policy_compute_dtype
+
+    dtype = policy_compute_dtype(mixed_precision)
     builder = _REGISTRY[cfg.name]
     # user-registered builders may use the original (cfg, dtype) signature;
     # pass the mesh only to builders that declare a parameter named "mesh"

@@ -16,6 +16,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.models.common import global_avg_pool
+from pytorchvideo_accelerate_tpu.precision import f32_island
 
 
 class ResBasicHead(nn.Module):
@@ -44,5 +45,5 @@ class ResBasicHead(nn.Module):
         x = nn.Dense(
             self.num_classes, dtype=jnp.float32, name="proj",
             kernel_init=nn.initializers.normal(0.01),
-        )(x.astype(jnp.float32))
+        )(f32_island(x))
         return x

@@ -32,6 +32,7 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
+from pytorchvideo_accelerate_tpu.precision import f32_island
 from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
 from pytorchvideo_accelerate_tpu.parallel.sharding import constrain_block
 
@@ -280,7 +281,7 @@ class MViT(nn.Module):
         x = jnp.mean(x, axis=(1, 2, 3))
         x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(
-            x.astype(jnp.float32)
+            f32_island(x)
         )
 
     @staticmethod

@@ -41,6 +41,7 @@ import numpy as np
 from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
+from pytorchvideo_accelerate_tpu.precision import f32_island
 from pytorchvideo_accelerate_tpu.parallel.sharding import constrain_block
 
 Dtype = Any
@@ -60,7 +61,7 @@ def sincos_pos_embed(n_pos: int, dim: int) -> np.ndarray:
     emb = np.empty((n_pos, dim))
     emb[:, 0::2] = np.sin(ang[:, 0::2])
     emb[:, 1::2] = np.cos(ang[:, 1::2])
-    return emb.astype(np.float32)
+    return f32_island(emb)  # host-side table; same island policy dtype
 
 
 class ViTBlock(nn.Module):
@@ -260,10 +261,10 @@ class VideoMAEForPretraining(nn.Module):
             dec_tokens = constrain_block(dec_tokens, self.shard_mesh)
         dec_tokens = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(dec_tokens)
         pred = nn.Dense(tt * p * p * 3, dtype=jnp.float32, name="dec_pred")(
-            dec_tokens[:, enc.shape[1]:].astype(jnp.float32)
+            f32_island(dec_tokens[:, enc.shape[1]:])
         )                                               # (B, n_masked, cube)
 
-        target = patchify(x.astype(jnp.float32), self.tubelet)
+        target = patchify(f32_island(x), self.tubelet)
         target = jnp.take_along_axis(target, masked_idx[..., None], axis=1)
         if self.norm_pix:
             mu = target.mean(-1, keepdims=True)
@@ -312,7 +313,7 @@ class VideoMAEClassifier(nn.Module):
         return nn.Dense(
             self.num_classes, dtype=jnp.float32, name="head",
             kernel_init=nn.initializers.normal(0.01),
-        )(feat.astype(jnp.float32))
+        )(f32_island(feat))
 
     @staticmethod
     def backbone_param_filter(path: Tuple[str, ...]) -> bool:

@@ -24,6 +24,8 @@ from typing import Any, Tuple
 import jax.numpy as jnp
 from flax import linen as nn
 
+from pytorchvideo_accelerate_tpu.precision import f32_island
+
 from pytorchvideo_accelerate_tpu.models.common import ConvBNAct, Dtype
 from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
 
@@ -149,7 +151,7 @@ class X3D(nn.Module):
         x = x.reshape(x.shape[0], -1)
         x = nn.Dropout(rate=self.dropout_rate, deterministic=not train)(x)
         x = nn.Dense(self.num_classes, dtype=jnp.float32, name="proj")(
-            x.astype(jnp.float32)
+            f32_island(x)
         )
         return x
 

@@ -23,13 +23,16 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 
+from pytorchvideo_accelerate_tpu.precision import f32_island
+
 
 def dense_attention(q, k, v, scale: Optional[float] = None, kmask=None):
     """Reference attention. `kmask`: optional (Nk,) bool — False keys are
     excluded from the softmax (used for padded keys by the CP wrappers)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k).astype(jnp.float32) * scale
+    # f32 softmax logits: the designed island every attention impl shares
+    logits = f32_island(jnp.einsum("bqhd,bkhd->bhqk", q, k)) * scale
     if kmask is not None:
         logits = jnp.where(kmask[None, None, None, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)

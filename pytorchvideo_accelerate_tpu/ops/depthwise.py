@@ -37,6 +37,8 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax import lax
 
+from pytorchvideo_accelerate_tpu.precision import f32_island
+
 
 def depthwise_conv3d_shift(x, kernel, stride: Tuple[int, int, int] = (1, 1, 1),
                            padding: Tuple[int, int, int] = None):
@@ -64,7 +66,7 @@ def depthwise_conv3d_shift(x, kernel, stride: Tuple[int, int, int] = (1, 1, 1),
     oh = (H + 2 * ph - kh) // sh + 1
     ow = (W + 2 * pw - kw) // sw + 1
 
-    kernel32 = kernel.astype(jnp.float32)
+    kernel32 = f32_island(kernel)
     out = None
     for it in range(kt):
         for ih in range(kh):
@@ -76,7 +78,7 @@ def depthwise_conv3d_shift(x, kernel, stride: Tuple[int, int, int] = (1, 1, 1),
                      iw + (ow - 1) * sw + 1, C),
                     (1, st, sh, sw, 1),
                 )
-                term = tap.astype(jnp.float32) * kernel32[it, ih, iw, 0]
+                term = f32_island(tap) * kernel32[it, ih, iw, 0]
                 out = term if out is None else out + term
     return out.astype(x.dtype)
 

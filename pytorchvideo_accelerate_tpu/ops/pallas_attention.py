@@ -37,6 +37,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from pytorchvideo_accelerate_tpu.precision import f32_island
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -203,7 +205,7 @@ def _flash_bhnd_bwd(scale, block_q, block_k, interpret, res, dout):
 
     # Δ_i = Σ_d dO_id · O_id, broadcast over lanes for tiled VMEM access
     delta = jnp.broadcast_to(
-        jnp.sum(dout.astype(jnp.float32) * out.astype(jnp.float32),
+        jnp.sum(f32_island(dout) * f32_island(out),
                 axis=-1, keepdims=True),
         (BH, nq, LANES),
     )

@@ -49,6 +49,8 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+
+from pytorchvideo_accelerate_tpu.precision import f32_island
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
@@ -71,8 +73,8 @@ def _dw_kernel(x_hbm, k_ref, o_ref, win_ref, sem, *,
         for dh in range(kh):
             for dw in range(kw):
                 tap = win_ref[dt:dt + tb, dh:dh + hb, dw:dw + ow, :]
-                acc += tap.astype(jnp.float32) * k_ref[
-                    (dt * kh + dh) * kw + dw].astype(jnp.float32)
+                acc += f32_island(tap) * f32_island(k_ref[
+                    (dt * kh + dh) * kw + dw])
     o_ref[0] = acc.astype(o_ref.dtype)
 
 
@@ -144,7 +146,7 @@ def _forward(x, kernel, interpret):
     b, t, h, w, _ = x.shape
     tb, hb = _tile_sizes(t, h)
     xp = _pad_for_tiles(x, kt, kh, kw, tb, hb)
-    flat = kernel.reshape(kt * kh * kw, c).astype(jnp.float32)
+    flat = f32_island(kernel.reshape(kt * kh * kw, c))
     return _dw_call(xp, flat, (kt, kh, kw), t, h, w, tb, hb, interpret)
 
 
@@ -164,13 +166,13 @@ def _bwd(interpret, res, dy):
     xp = jnp.pad(x, ((0, 0), (kt // 2, kt // 2), (kh // 2, kh // 2),
                      (kw // 2, kw // 2), (0, 0)))
     t, h, w = dy.shape[1:4]
-    dy32 = dy.astype(jnp.float32)
+    dy32 = f32_island(dy)
     rows = []
     for dt in range(kt):
         for dh in range(kh):
             for dw in range(kw):
                 tap = xp[:, dt:dt + t, dh:dh + h, dw:dw + w, :]
-                rows.append(jnp.sum(tap.astype(jnp.float32) * dy32,
+                rows.append(jnp.sum(f32_island(tap) * dy32,
                                     axis=(0, 1, 2, 3)))
     dk = jnp.stack(rows).reshape(kt, kh, kw, 1, -1).astype(kernel.dtype)
     return dx, dk

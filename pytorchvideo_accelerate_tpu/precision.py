@@ -1,0 +1,46 @@
+"""The precision policy seam: every deliberate f32 island goes through here.
+
+The models compute in bf16 by policy (`create_model(mixed_precision)`),
+params stay fp32, and a handful of sites are *designed* to run in f32
+anyway — classifier heads, loss math, softmax logits, norm statistics,
+reference accumulations. Those casts used to be bare `x.astype(
+jnp.float32)` literals scattered through the model/ops hot modules,
+indistinguishable from an accidental upcast that silently doubles a hot
+path's bytes and halves its MXU rate.
+
+`f32_island(x)` is the single grep-able cast point: the `dtype-literal`
+lint rule (analysis/rules_dtype.py) flags any bare f32 cast in a hot
+model/ops module that does not route through it, and the graphcheck
+dtype pass (analysis/gc_dtype.py) allowlists compute reached from these
+sites by qualname. Adding a new island = calling this helper (the code
+states the intent) — not silencing a linter.
+
+Top-level leaf module on purpose: stdlib + jax.numpy only, importable
+from both models/ and ops/ without package-init cycles (models/__init__
+imports the model files, which import ops/attention — a helper living in
+either package would be mid-init exactly when the other needs it).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+# the island dtype is a policy constant, not a per-site choice: everything
+# deliberately upcast lands in f32 (TPU has no f64 to drift into)
+ISLAND_DTYPE = jnp.float32
+
+
+def f32_island(x):
+    """Cast `x` (jax or numpy array) to float32 at a DESIGNED f32 island.
+
+    Use this instead of a bare `.astype(jnp.float32)` in model/ops hot
+    modules — the cast is the same; the seam is what makes the dtype
+    policy auditable (dtype-literal rule + graphcheck dtype pass)."""
+    return x.astype(ISLAND_DTYPE)
+
+
+def policy_compute_dtype(mixed_precision: str):
+    """Model compute dtype for a TrainConfig.mixed_precision string:
+    bf16 for "bf16"/"fp16" (fp16 maps to bf16 on TPU — no loss scaling),
+    f32 otherwise. The one resolution point `create_model` uses."""
+    return jnp.bfloat16 if mixed_precision in ("bf16", "fp16") else jnp.float32

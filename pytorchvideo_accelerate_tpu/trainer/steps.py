@@ -246,6 +246,13 @@ def _make_update_step(
             metrics["lr"] = lr_schedule(state.step)
         return new_state, metrics
 
+    # state donation, VERIFIED: the graphcheck donation pass
+    # (analysis/gc_donation.py) walks the compiled input_output_alias map
+    # and proves every state leaf aliases — disarmed AND guard-armed (the
+    # jnp.where skip branch above must not break aliasing) — with zero
+    # donatable leaves left undeclared; bench --smoke gates on it. An
+    # aval drift here (a leaf that changes dtype/shape across the step)
+    # would silently double-buffer that leaf — the pass reports the bytes.
     return jax.jit(step, donate_argnums=0)
 
 

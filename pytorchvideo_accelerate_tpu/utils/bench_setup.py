@@ -34,6 +34,8 @@ class StepSetup:
     device_batch: Callable[[int], Any]  # seed -> mesh-sharded batch
     pretrain: bool
     input_u8: bool = False  # effective (clamped off for pretrain)
+    tx: Any = None  # optimizer, for callers that rebuild step variants
+    #               (graphcheck's guard-armed donation probe)
 
 
 def build_step_setup(
@@ -167,7 +169,7 @@ def build_step_setup(
     return StepSetup(model=model, mesh=mesh, state=state, step=step,
                      n_chips=n_chips, global_batch=B, host_batch=host_batch,
                      device_batch=device_batch, pretrain=pretrain,
-                     input_u8=input_u8)
+                     input_u8=input_u8, tx=tx)
 
 
 def xla_flops(compiled) -> Optional[float]:

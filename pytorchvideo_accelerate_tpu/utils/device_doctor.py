@@ -402,6 +402,25 @@ def guard_snapshot(output_dir: str = "") -> dict:
     return out
 
 
+def graphcheck_snapshot() -> dict:
+    """Compiled-graph analysis health (analysis/graphcheck.py —
+    docs/STATIC_ANALYSIS.md § graphcheck): the last in-process
+    pva-tpu-graphcheck run's per-pass finding counts and the
+    donation-verified verdict. ran=False in a fresh process — the doctor
+    reports the absence rather than paying a multi-second trace of the
+    step functions on every diagnosis."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.analysis.graphcheck import (
+            graphcheck_snapshot as _snap,
+        )
+
+        out.update(_snap())
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def diagnose(timeout_s: int = 120, skip_init: bool = False,
              variants: bool = False, obs_dir: str = "") -> dict:
     rec = {
@@ -413,6 +432,7 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "obs": obs_snapshot(obs_dir),
         "trace": trace_snapshot(),
         "lint": lint_snapshot(),
+        "graphcheck": graphcheck_snapshot(),
         "tsan": tsan_snapshot(),
         "reliability": reliability_snapshot(obs_dir),
         "guard": guard_snapshot(obs_dir),

@@ -3,11 +3,20 @@
 bf16 peak TFLOP/s per chip by `device_kind` substring; None for platforms
 without a published peak (CPU, unknown accelerators) — callers then skip the
 MFU line rather than report nonsense.
+
+`resolve_peak` adds the measured fallback: on platforms with no datasheet
+number (the CPU smoke lanes where `mfu` has been null on every round) it
+calibrates an achievable matmul rate once per process and reports MFU
+against THAT, labeled `measured` so a reader can never mistake it for a
+fraction of a datasheet peak. A measured denominator is a proxy — "fraction
+of this host's best matmul rate" — but an honest, labeled proxy beats a
+permanent null (ROADMAP item 1).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+import time
+from typing import Optional, Tuple
 
 _PEAK_TFLOPS = [
     ("v6", 918.0),      # Trillium / v6e
@@ -17,6 +26,8 @@ _PEAK_TFLOPS = [
     ("v3", 123.0),
     ("v2", 45.0),
 ]
+
+_MEASURED: dict = {}  # device_kind -> measured peak (once per process)
 
 
 def peak_tflops(device) -> Optional[float]:
@@ -28,3 +39,67 @@ def peak_tflops(device) -> Optional[float]:
         if key in kind:
             return tf
     return None
+
+
+def measured_peak_tflops(device, n: int = 512, reps: int = 3,
+                         min_probe_s: float = 0.01, max_n: int = 4096,
+                         ) -> Optional[float]:
+    """Best-of-`reps` f32 `n`x`n` matmul rate on `device`, TFLOP/s —
+    the measured stand-in for a missing datasheet peak. Cached per
+    device kind (one short calibration per process). None when the
+    probe itself fails (no backend, OOM) — callers fall back to a null
+    MFU exactly as before.
+
+    The probe size ADAPTS: on an accelerator fast enough that the
+    matmul finishes inside dispatch/transfer latency, a fixed 512^3
+    probe would calibrate latency, not throughput — a "peak" of a few
+    TFLOP/s on silicon with hundreds, inflating every MFU proxy built
+    on it. `n` doubles (to `max_n`) until one timed run takes at least
+    `min_probe_s`, so the measurement is compute-bound wherever the
+    hardware allows."""
+    key = (device.platform, device.device_kind)
+    if key in _MEASURED:
+        return _MEASURED[key]
+    try:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        mm = jax.jit(lambda x, y: x @ y)
+
+        def one_run(size: int, i: int) -> float:
+            a = jax.device_put(jnp.ones((size, size), jnp.float32), device)
+            np.asarray(mm(a, a))  # compile + warm for this size
+            b = a * float(i + 1)  # fresh operand: no result caching
+            t0 = time.perf_counter()
+            np.asarray(mm(b, b))  # value-fetch sync (bench discipline)
+            return time.perf_counter() - t0
+
+        dt = one_run(n, 0)
+        while dt < min_probe_s and n < max_n:
+            n *= 2
+            dt = one_run(n, 0)
+        best = 2.0 * n * n * n / max(dt, 1e-9) / 1e12
+        for i in range(1, reps):
+            dt = one_run(n, i)
+            tf = 2.0 * n * n * n / max(dt, 1e-9) / 1e12
+            best = max(best, tf)
+        _MEASURED[key] = best
+    except Exception:
+        _MEASURED[key] = None
+    return _MEASURED[key]
+
+
+def resolve_peak(device) -> Tuple[Optional[float], str]:
+    """(peak TFLOP/s, source): the datasheet number when one exists
+    ("datasheet"), else a per-process measured matmul calibration
+    ("measured"), else (None, "none"). MFU consumers must carry the
+    source label — a measured-peak MFU is a utilization proxy, not a
+    fraction of silicon peak, and must never be compared against one."""
+    peak = peak_tflops(device)
+    if peak:
+        return peak, "datasheet"
+    peak = measured_peak_tflops(device)
+    if peak:
+        return peak, "measured"
+    return None, "none"

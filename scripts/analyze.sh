@@ -2,7 +2,10 @@
 # The full analysis gate (docs/STATIC_ANALYSIS.md + docs/RELIABILITY.md):
 # the pva-tpu-lint AST pass over the package tree, a short pva-tpu-tsan
 # stress pass (lockset races + lock-order cycles over the threaded
-# data/train/serve layers), then the pva-tpu-chaos fault-injection
+# data/train/serve layers), the pva-tpu-graphcheck jaxpr/HLO passes over
+# the real train/eval/serve steps (donation aliasing, dtype policy,
+# sharding propagation, analytic FLOPs), then the pva-tpu-chaos
+# fault-injection
 # scenario (retry/preemption/shedding recovery asserted under seeded
 # faults — including the PR-9 self-healing legs: guard_nan NaN-rollback,
 # corrupt-clip quarantine, and the wedged-collective hang detector).
@@ -20,6 +23,14 @@ ROOT="$(cd "$(dirname "$0")/.." && pwd)"
 env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python -m pytorchvideo_accelerate_tpu.analysis.tsan_report --smoke
+
+# compiled-graph gate (docs/STATIC_ANALYSIS.md § graphcheck): the four
+# jaxpr/HLO passes — donation aliasing, dtype policy, sharding
+# propagation, analytic-vs-costmodel FLOPs — over the real train/eval/
+# serve step functions; exit 1 on any finding
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m pytorchvideo_accelerate_tpu.analysis.graphcheck
 
 rc=0
 env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \

@@ -139,13 +139,17 @@ def test_finalize_line_fits_driver_capture():
         models[name + "__smoke_fallback"] = _model(name)[name]
     extras = {
         "trainer_vs_rawstep": 0.934, "trainer_mfu": 0.1234,
+        "mfu_analytic": 0.1234, "mfu_source": "costmodel",
+        "mfu_peak_source": "measured",
+        "multichip_mfu_peak_source": "measured",
+        "graphcheck_findings": 0,
         "obs_step_s": 0.012345, "obs_input_wait_frac": 0.0123,
         "obs_h2d_s": 0.001234, "train_recompiles": 0, "tsan_findings": 0,
         "chaos_findings": 0, "guard_rollbacks": 0, "quarantined_clips": 0,
         "mesh_parity": True, "mesh_ckpt_portable": True,
         "multichip_cps_per_chip": {"1": 123.456, "8": 117.89},
         "multichip_forced_host": True, "multichip_train_recompiles": 0,
-        "multichip_mfu": 0.1234,
+        "multichip_mfu": 0.1234, "multichip_mfu_analytic": 0.1111,
         "multichip_error": "no trustworthy device numbers " + "z" * 200,
         "serve_rps": 123.456, "serve_p99_ms_under_load": 87.654,
         "swap_blackout_ms": 12.345, "fleet_shed_frac": 0.0123,
@@ -312,3 +316,43 @@ def test_finalize_serving_lane_keys():
                          user_smoke=False)
     assert out["serve_error"] == "boom"
     assert "serve_p50_ms" not in out
+
+
+def test_finalize_mfu_analytic_keys_ride_the_headline():
+    """The honest-MFU keys (analytic-counter MFU + its provenance label,
+    sourced from fit()'s perf dict via the trainer lane;
+    analysis/gc_flops.py) plumb through finalize onto the headline line —
+    the values `--smoke` asserts non-null."""
+    extras = {"mfu_analytic": 0.39, "mfu_source": "analytic",
+              "mfu_peak_source": "measured"}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["mfu_analytic"] == 0.39
+    assert out["mfu_source"] == "analytic"
+    # the denominator's provenance rides too: a measured-peak MFU must
+    # never read as a datasheet fraction in an archived round
+    assert out["mfu_peak_source"] == "measured"
+
+
+def test_finalize_graphcheck_findings_ride_the_headline():
+    """The compiled-graph verdict (pva-tpu-graphcheck gate at the smoke
+    gate site; analysis/graphcheck.py) plumbs through finalize onto the
+    headline line — the number `--smoke` asserts 0."""
+    out = bench.finalize(_model(), {"graphcheck_findings": 0},
+                         user_smoke=False)
+    assert out["graphcheck_findings"] == 0
+    out = bench.finalize(_model(), {"graphcheck_findings": 4},
+                         user_smoke=False)
+    assert out["graphcheck_findings"] == 4
+
+
+def test_finalize_multichip_mfu_analytic_obeys_the_refusal_rule():
+    """multichip_mfu_analytic rides with the lane's perf keys and drops
+    with them when the lane refuses its numbers (cpu fallback)."""
+    extras = {"mesh_parity": True, "multichip_cps_per_chip": {"1": 10.0},
+              "multichip_mfu_analytic": 0.21}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["multichip_mfu_analytic"] == 0.21
+    out = bench.finalize(
+        _model(), {**extras, "multichip_error": "cpu fallback"},
+        user_smoke=False)
+    assert "multichip_mfu_analytic" not in out
