@@ -1142,6 +1142,23 @@ def bench_fleet(args) -> dict:
 
 # --- parent orchestration ---------------------------------------------------
 
+def bench_kbench(args) -> dict:
+    """Kernel-microbench lane (`pva-tpu-kbench`, ops/kbench.py): each
+    fused Pallas/folded kernel vs its XLA reference at the real
+    slowfast/x3d hot-path shapes. Speedups are SAME-BACKEND ratios —
+    honest on any host — but only a TPU run is a device claim; the
+    record carries platform/device labels and raw ms stay here in
+    bench_partial.json, never on the headline (the standing
+    no-CPU-numbers-as-device-numbers rule)."""
+    import jax
+
+    from pytorchvideo_accelerate_tpu.ops.kbench import run_kbench
+
+    res = run_kbench(smoke=args.smoke, log=log)
+    res["n_chips"] = len(jax.devices())
+    return res
+
+
 def probe_device(probe_attempts: list, timeout: int = 240) -> bool:
     """Can a fresh process enumerate the TPU and run one op? Timestamped
     evidence either way; also appended to .probe_log.jsonl."""
@@ -1240,6 +1257,8 @@ def child_main(args) -> None:
         res = bench_multichip(args)
     elif args.child == "__fleet__":
         res = bench_fleet(args)
+    elif args.child == "__kbench__":
+        res = bench_kbench(args)
     else:
         devices = jax.devices()
         n_chips = len(devices)
@@ -1296,6 +1315,13 @@ def main():
                          "serve_rps / serve_p99_ms_under_load / "
                          "swap_blackout_ms / fleet_shed_frac "
                          "(--no-fleet skips)")
+    ap.add_argument("--kbench", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="kernel-microbench lane (pva-tpu-kbench): fused "
+                         "Pallas/folded kernels vs their XLA references "
+                         "at real slowfast/x3d shapes; per-kernel "
+                         "same-backend speedup keys on the headline, "
+                         "parity gated (--no-kbench skips)")
     ap.add_argument("--smoke", action="store_true",
                     help="tiny CPU-safe shapes for harness verification")
     ap.add_argument("--per_model_timeout", type=int, default=900,
@@ -1646,6 +1672,32 @@ def main():
                     extras[key] = fl[key]
         flush_partial()
 
+    if args.kbench:
+        # kernel-microbench lane: child-isolated like the model benches,
+        # and under the same dead-tunnel rule — a non-smoke child touches
+        # the real backend, which wedges at init when the tunnel is down,
+        # so the lane falls back to the CPU-pinned smoke child there. The
+        # speedups are same-backend ratios, honest on whatever backend the
+        # child lands on (platform-labeled; only a TPU run is a device
+        # claim, and raw ms never leave bench_partial.json)
+        kb = run_child("__kbench__", args, user_smoke or not device_ok,
+                       _model_timeout(args))
+        extras["kbench"] = kb  # full record -> bench_partial.json
+        if "error" in kb:
+            extras["kbench_error"] = str(kb["error"])[:120]
+        elif not kb.get("parity_ok", False):
+            # a fused kernel that diverged from its reference must
+            # headline the violation INSTEAD of any speedup
+            extras["kbench_error"] = ("kernel parity violation (see "
+                                      "bench_partial.json kbench record)")
+        else:
+            from pytorchvideo_accelerate_tpu.ops.kbench import (
+                headline_keys,
+            )
+
+            extras.update(headline_keys(kb))
+        flush_partial()
+
     if args.serve_smoke:
         # serving lane runs in the parent (CPU-pinned, tiny model) but
         # bounded like the host benches: a wedged compile or stuck batcher
@@ -1757,6 +1809,24 @@ def main():
             assert key in headline, (
                 f"serving smoke ran but headline misses {key!r}: "
                 f"{extras.get('serving')}")
+    if user_smoke and args.kbench:
+        # kernel-lane acceptance (docs/KERNELS.md): every fused kernel
+        # holds parity with its XLA reference (benched shape AND
+        # interpret-mode Pallas), every per-kernel speedup key made the
+        # headline, and at least one fused kernel shows a real win over
+        # the reference on this host — the folded depthwise beats XLA's
+        # grouped conv by orders of magnitude even on the CPU smoke host
+        kb = extras.get("kbench", {})
+        assert "kbench_error" not in extras, (
+            f"kbench lane failed: {extras['kbench_error']}: {kb}")
+        assert extras.get("kbench_parity_ok") is True, (
+            f"kbench parity keys missing/false: {kb}")
+        for name in kb.get("kernels", {}):
+            assert f"kbench_{name}_speedup" in extras, (
+                f"kbench ran but headline misses kbench_{name}_speedup")
+        assert kb.get("best_speedup", 0) >= 1.15, (
+            "no fused kernel beat its XLA reference by >=1.15x on the "
+            f"smoke host: {kb}")
     if user_smoke and args.fleet:
         # SERVE_FLEET acceptance (docs/SERVING.md § fleet): the open-loop
         # harness sustained its arrival rate against >=2 replicas, p99
@@ -1954,6 +2024,13 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         out["multichip_error"] = str(extras["multichip_error"])[:120]
     if "fleet_error" in extras:
         out["fleet_error"] = str(extras["fleet_error"])[:120]
+    # kernel-microbench keys (pva-tpu-kbench): dimensionless same-backend
+    # speedup ratios + platform label (never raw ms — those live in
+    # bench_partial.json); a failed or parity-broken lane headlined
+    # kbench_error INSTEAD of speedups at the lane site above
+    for key in sorted(extras):
+        if key.startswith("kbench_"):
+            out[key] = extras[key]
     # serving lane: request-latency percentiles + batcher fill ratio
     serving = extras.get("serving", {})
     if "error" in serving:
@@ -2001,6 +2078,11 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
               "fleet_error", "fleet_shed_frac", "swap_blackout_ms",
               "serve_p99_ms_under_load", "serve_rps",
+              "kbench_conv311_sf_res4_speedup",
+              "kbench_conv133_sf_res4_speedup",
+              "kbench_pw_x3d_res3_speedup", "kbench_platform",
+              "kbench_dw_x3d_res3_speedup", "kbench_parity_ok",
+              "kbench_error", "kbench_best",
               "serve_error", "serve_fill_ratio", "serve_p99_ms",
               "serve_p50_ms", "guard_rollbacks", "quarantined_clips",
               "train_recompiles", "obs_h2d_s",

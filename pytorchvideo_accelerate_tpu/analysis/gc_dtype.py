@@ -50,6 +50,26 @@ DEFAULT_F32_ISLANDS = frozenset({
     "dense_attention",
     "fused_attention",
     "dot_product_attention",
+    # fused conv/norm/act kernel tier (ops/pallas_fused.py): the file IS
+    # the accumulator island — every cast in it routes through
+    # f32_island/end_island (the dtype-literal lint rule enforces that at
+    # source level), its custom_vjp backwards re-enter f32 at the designed
+    # epilogue boundaries (the autodiff image of the islands, exactly the
+    # pallas_attention precedent above)
+    "pallas_fused.py",
+    # models/common.py fused-site helpers: BN batch statistics and the
+    # train-mode affine+act tail are accumulator f32 islands by design
+    # (nn.BatchNorm computes its stats in f32 too — this is the same
+    # policy made explicit); end_island is the precision-seam downcast
+    # whose TRANSPOSE is a designed upcast of the cotangent
+    "fused_train_norm_act",
+    "batch_norm_stats",
+    "end_island",
+    # serving weight dequantization (serving/quantize.py): int8 -> f32
+    # scale multiply -> one downcast to the compute dtype; the upcast
+    # starts from int8, never from bf16 compute, but inlining can
+    # attribute the scale math here
+    "dequantize_tree",
 })
 
 

@@ -33,7 +33,7 @@ numerator in the trainer/multichip bench lanes when capture fails.
 from __future__ import annotations
 
 import math
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 # 1-FLOP-per-element arithmetic primitives (XLA cost-model "flops" class).
 # Selects and comparisons ARE counted: the guard-armed train step wraps
@@ -58,6 +58,86 @@ def _prod(xs) -> int:
     for x in xs:
         out *= int(x)
     return out
+
+
+# --- pallas_call costing ----------------------------------------------------
+#
+# A `pallas_call` is opaque to this walk (its body is a kernel jaxpr whose
+# eqns describe ONE grid program, not the whole op), so an unregistered
+# Pallas kernel would silently undercount `mfu_analytic` — exactly the
+# lying-numerator failure this pass exists to prevent. Every in-tree
+# kernel therefore registers a per-kernel FLOPs hook here, keyed by the
+# kernel FUNCTION name (eqn.params["name_and_src_info"].name), computing
+# from the eqn's avals; a pallas_call with no hook becomes a finding in
+# `check_flops` (and `--selftest` seeds one to prove the detector works).
+
+PALLAS_FLOPS_HOOKS: Dict[str, Callable[[Any], float]] = {}
+
+
+def register_pallas_flops(kernel_name: str,
+                          fn: Callable[[Any], float]) -> None:
+    """Register `fn(eqn) -> flops` for the Pallas kernel function named
+    `kernel_name` (docs/KERNELS.md § adding a kernel)."""
+    PALLAS_FLOPS_HOOKS[kernel_name] = fn
+
+
+def pallas_kernel_name(eqn) -> str:
+    nsi = eqn.params.get("name_and_src_info")
+    return (getattr(nsi, "name", None) or eqn.params.get("name")
+            or "<unknown>")
+
+
+def _pw_kernel_flops(eqn) -> float:
+    # ops/pallas_fused._pw_bn_act_kernel: x (M, Cin) @ w (Cin, Cout)
+    # + per-row bias/act epilogue
+    x, w = (v.aval for v in eqn.invars[:2])
+    m, cin = x.shape
+    cout = w.shape[-1]
+    return 2.0 * m * cin * cout + 2.0 * m * cout
+
+
+def _conv_kernel_flops(eqn) -> float:
+    # ops/pallas_fused._conv_bn_act_kernel: taps MXU matmuls per output
+    # element (w flattened (taps, Cin, Cout)) + bias/act epilogue
+    w = eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    taps, cin, _ = w.shape
+    out_elems = _prod(out.shape)
+    return 2.0 * out_elems * taps * cin + 2.0 * out_elems
+
+
+def _dw_kernel_flops(eqn) -> float:
+    # ops/pallas_depthwise._dw_kernel / pallas_fused._dw_bn_act_kernel:
+    # taps VPU FMAs per output element (k flattened (taps, C)); the
+    # epilogue variant adds bias+act
+    k = eqn.invars[1].aval
+    out = eqn.outvars[0].aval
+    return 2.0 * _prod(out.shape) * k.shape[0] + 2.0 * _prod(out.shape)
+
+
+def _attn_flops(matmuls: int) -> Callable[[Any], float]:
+    # ops/pallas_attention kernels: q/k (BH, Nq, D)/(BH, Nk, D); each
+    # "matmul" is a (Nq, Nk) x D contraction class (fwd: QK^T + PV = 2;
+    # dq: S recompute + dP + dS@K = 3; dkv: S + dV + dP + dK = 4)
+    def hook(eqn) -> float:
+        q, k = (v.aval for v in eqn.invars[:2])
+        bh, nq, d = q.shape
+        nk = k.shape[1]
+        return 2.0 * matmuls * bh * nq * nk * d
+
+    return hook
+
+
+# in-tree kernels (keyed by kernel function name)
+PALLAS_FLOPS_HOOKS.update({
+    "_pw_bn_act_kernel": _pw_kernel_flops,
+    "_conv_bn_act_kernel": _conv_kernel_flops,
+    "_dw_bn_act_kernel": _dw_kernel_flops,
+    "_dw_kernel": _dw_kernel_flops,
+    "_fwd_kernel": _attn_flops(2),
+    "_bwd_dq_kernel": _attn_flops(3),
+    "_bwd_dkv_kernel": _attn_flops(4),
+})
 
 
 def dot_general_flops(eqn) -> float:
@@ -128,13 +208,38 @@ def _sub_closed(params_value) -> List[Any]:
 def jaxpr_flops(closed_jaxpr) -> Dict[str, Any]:
     """Analytical FLOPs of a closed jaxpr: total + per-class breakdown +
     caveats (unstatically-countable constructs encountered)."""
-    counts = {"dot": 0.0, "conv": 0.0, "elementwise": 0.0, "reduce": 0.0}
-    eqn_counts = {"dot_general": 0, "conv_general_dilated": 0}
+    counts = {"dot": 0.0, "conv": 0.0, "elementwise": 0.0, "reduce": 0.0,
+              "pallas": 0.0}
+    eqn_counts = {"dot_general": 0, "conv_general_dilated": 0,
+                  "pallas_call": 0}
     caveats: List[str] = []
+    unregistered: List[str] = []
+    hook_errors: List[str] = []
 
     def walk(jaxpr, mult: float) -> None:
         for eqn in jaxpr.eqns:
             name = eqn.primitive.name
+            if name == "pallas_call":
+                kname = pallas_kernel_name(eqn)
+                hook = PALLAS_FLOPS_HOOKS.get(kname)
+                eqn_counts["pallas_call"] += 1
+                if hook is None:
+                    # a silent zero here would quietly deflate
+                    # mfu_analytic — surface it (check_flops turns the
+                    # list into findings)
+                    unregistered.append(kname)
+                else:
+                    try:
+                        counts["pallas"] += mult * float(hook(eqn))
+                    except Exception as e:  # noqa: BLE001 - see below
+                        # hooks key on bare kernel-function names; a name
+                        # collision hands this hook an eqn whose avals it
+                        # can't parse. That must surface as a finding,
+                        # never crash the whole graphcheck run or book a
+                        # wrong count for the colliding kernel.
+                        hook_errors.append(
+                            f"{kname}: {type(e).__name__}: {e}")
+                continue
             if name == "dot_general":
                 counts["dot"] += mult * dot_general_flops(eqn)
                 eqn_counts["dot_general"] += 1
@@ -167,6 +272,8 @@ def jaxpr_flops(closed_jaxpr) -> Dict[str, Any]:
                         counts[k] += mult * best["by_class"][k]
                     for k in eqn_counts:
                         eqn_counts[k] += best["eqn_counts"][k]
+                    unregistered.extend(best["unregistered_pallas"])
+                    hook_errors.extend(best["pallas_hook_errors"])
             else:
                 # generic recursion: pjit / remat / custom_jvp / custom_vjp
                 # / closed_call all carry their body as ClosedJaxpr params
@@ -181,6 +288,8 @@ def jaxpr_flops(closed_jaxpr) -> Dict[str, Any]:
         "by_class": counts,
         "eqn_counts": eqn_counts,
         "caveats": sorted(set(caveats)),
+        "unregistered_pallas": sorted(set(unregistered)),
+        "pallas_hook_errors": sorted(set(hook_errors)),
     }
 
 
@@ -205,6 +314,30 @@ def check_flops(closed_jaxpr, costmodel_flops: Optional[float],
     summary["costmodel_flops"] = costmodel_flops
     summary["partitions"] = int(partitions)
     findings: List[dict] = []
+    for kname in analytic["unregistered_pallas"]:
+        findings.append({
+            "pass": "flops",
+            "site": f"pallas_call:{kname}",
+            "message": (
+                f"pallas_call kernel {kname!r} has no registered FLOPs "
+                "hook: the analytic count books it as ZERO, silently "
+                "deflating mfu_analytic — register one via "
+                "gc_flops.register_pallas_flops (docs/KERNELS.md § "
+                "adding a kernel)"),
+            "details": {"kernel": kname},
+        })
+    for err in analytic["pallas_hook_errors"]:
+        findings.append({
+            "pass": "flops",
+            "site": f"pallas_call:{err.split(':', 1)[0]}",
+            "message": (
+                f"registered FLOPs hook failed on pallas_call ({err}): "
+                "likely a kernel-function NAME COLLISION handing the hook "
+                "avals it can't parse — rename the kernel or register a "
+                "hook that matches it (docs/KERNELS.md § adding a "
+                "kernel); its FLOPs are booked as zero until fixed"),
+            "details": {"error": err},
+        })
     if costmodel_flops and analytic["flops_total"] > 0:
         per_part = analytic["flops_total"] / max(int(partitions), 1)
         rel = abs(per_part - costmodel_flops) / max(costmodel_flops, 1.0)

@@ -179,6 +179,54 @@ def build_targets(model: str = "tiny3d", smoke: bool = True,
         name="serve_step", fn=jax.jit(serve_forward),
         args=(state.params, state.batch_stats, clips),
         donation="skip"))
+
+    # fused-kernel lowering (ModelConfig.fused_kernels; docs/KERNELS.md),
+    # for the conv families that wire it: (a) the SAME state/batch through
+    # a fused-"auto" train step — donation and the dtype policy must
+    # survive the lowering swap (the param tree is identical, so the
+    # existing state drops in); (b) a forced-"pallas" serve forward, which
+    # puts real `pallas_call` eqns in the jaxpr even on CPU hosts (where
+    # "auto" lowers to the folded-XLA formulation) so the registered-FLOPs
+    # hooks are exercised by every graphcheck run.
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+
+    fused_capable = model.startswith(
+        ("tiny3d", "slow_r50", "slowfast", "x3d", "c2d", "csn",
+         "r2plus1d"))
+    if fused_capable:
+        fused_model = create_model(ModelConfig(
+            name=model, num_classes=num_classes, fused_kernels="auto"))
+        fused_step = make_train_step(fused_model, setup.tx, setup.mesh)
+        targets.append(CheckTarget(
+            name="train_step_fused", fn=fused_step,
+            args=(state, gb, key), donation="require", partitions=parts))
+
+        pallas_model = create_model(ModelConfig(
+            name=model, num_classes=num_classes, fused_kernels="pallas"))
+
+        def serve_fused_pallas(params, batch_stats, clip_batch):
+            from pytorchvideo_accelerate_tpu.precision import f32_island
+            from pytorchvideo_accelerate_tpu.trainer.steps import (
+                _constrain_batch,
+            )
+
+            b = _constrain_batch(clip_batch, mesh, leading_micro=False)
+            b = device_normalize_batch(b, None)
+            logits = multiview_logits(
+                lambda x: pallas_model.apply(
+                    {"params": params, "batch_stats": batch_stats},
+                    x, train=False),
+                model_inputs(b))
+            return f32_island(logits)
+
+        # interpret-mode pallas lowering: no cost-model cross-check (the
+        # emulation's optimized-HLO accounting is not the kernel's), but
+        # the analytic counter MUST cost every pallas_call via its hook
+        targets.append(CheckTarget(
+            name="serve_step_fused_pallas", fn=jax.jit(serve_fused_pallas),
+            args=(state.params, state.batch_stats, clips),
+            donation="skip", flops_costmodel=False))
     return targets
 
 
@@ -432,6 +480,36 @@ def selftest(log=print) -> int:
     f, s = check_flops(mm, costmodel_flops=true_flops)
     expect(not f and s["costmodel_rel_err"] == 0.0,
            "flops: exact matmul parity stays clean")
+
+    # flops: an UNREGISTERED pallas_call must be flagged (an opaque
+    # Pallas primitive counts as zero FLOPs and silently deflates
+    # mfu_analytic); registering a hook makes the same graph clean
+    from jax.experimental import pallas as pl
+
+    from pytorchvideo_accelerate_tpu.analysis.gc_flops import (
+        PALLAS_FLOPS_HOOKS,
+        register_pallas_flops,
+    )
+
+    def _selftest_opaque_kernel(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    pj = jax.make_jaxpr(lambda x: pl.pallas_call(
+        _selftest_opaque_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True)(x))(jnp.ones((8, 128)))
+    f, s = check_flops(pj, costmodel_flops=None)
+    expect(len(f) == 1 and s["unregistered_pallas"] == [
+        "_selftest_opaque_kernel"],
+        "flops: seeded unregistered pallas_call detected")
+    register_pallas_flops("_selftest_opaque_kernel",
+                          lambda eqn: float(8 * 128))
+    try:
+        f, s = check_flops(pj, costmodel_flops=None)
+        expect(not f and s["by_class"]["pallas"] == 8 * 128,
+               "flops: registered pallas hook counts clean")
+    finally:
+        PALLAS_FLOPS_HOOKS.pop("_selftest_opaque_kernel", None)
     return failures
 
 

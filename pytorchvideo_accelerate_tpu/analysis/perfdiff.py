@@ -43,6 +43,15 @@ HIGHER_BETTER = (
     "multichip_mfu_analytic",
     "serve_rps",
     "serve_fill_ratio",
+    # per-kernel fused-vs-reference speedups (pva-tpu-kbench): the keys
+    # that make a bench-trajectory move attributable to ONE kernel —
+    # same-backend ratios, only comparable when kbench_platform matches
+    # across the two rounds (the suspect-refusal rule keeps CPU-fallback
+    # rounds from headlining device claims in the first place)
+    "kbench_dw_x3d_res3_speedup",
+    "kbench_pw_x3d_res3_speedup",
+    "kbench_conv133_sf_res4_speedup",
+    "kbench_conv311_sf_res4_speedup",
 )
 LOWER_BETTER = (
     "step_ms_blocked",

@@ -49,6 +49,9 @@ DTYPE_HOT_MODULES = (
     "ops/depthwise.py",
     "ops/pallas_attention.py",
     "ops/pallas_depthwise.py",
+    "ops/pallas_fused.py",
+    "ops/kbench_refs.py",
+    "serving/quantize.py",
 )
 
 # modules whose `float32` attribute is the flagged literal

@@ -131,6 +131,15 @@ class ModelConfig:
     # fall back to conv). Same param tree in all cases; A/B on device with
     # scripts/perf_sweep.py
     depthwise_impl: str = "conv"
+    # fused conv->norm->activation lowering for the slowfast/x3d/slow
+    # residual-block hot paths (ops/pallas_fused.py; docs/KERNELS.md):
+    # "off" = today's unfused graph, byte-for-byte; "auto" = hand-tiled
+    # Pallas kernels on TPU and the scale-folded XLA formulation
+    # elsewhere; "pallas"/"xla" force one lowering (parity tests,
+    # graphcheck, pva-tpu-kbench A/Bs). Same param tree in every mode —
+    # checkpoints and converted weights are interchangeable across the
+    # knob. Strided sites and non-BN convs keep the unfused path.
+    fused_kernels: str = "off"
     # per-block jax.checkpoint (rematerialization): only block-boundary
     # activations (plus one block's interior at a time) stay resident,
     # trading one extra forward of recompute for the activation HBM that
@@ -240,6 +249,16 @@ class ServeConfig:
     # cannot meet its deadline is shed instead of riding to a 504
     realtime_deadline_ms: float = 2000.0
     batch_deadline_ms: float = 10000.0
+    # quantized inference (serving/quantize.py; docs/SERVING.md §
+    # quantization): "off" = full-precision weights, byte-identical to
+    # the pre-quantization engine; "int8" = per-channel absmax int8
+    # WEIGHTS dequantized to the compute dtype (bf16 activations)
+    # inside the jitted forward — 4x smaller artifacts/HBM residency
+    # and hot-swap transfers, quality-gated against full-precision
+    # evaluate() top-1 (tests/test_zquant.py). Applies at
+    # `export_inference` time (bakes a quantized artifact) AND at
+    # engine load time (on-the-fly quantization of fp artifacts).
+    quantization: str = "off"
     # per-deployment latency-histogram bucket bounds (comma-separated
     # MILLISECONDS, e.g. "5,10,25,50,100,250,1000"); "" keeps the shared
     # serving ladder. An interactive tier wants sub-ms resolution, a bulk

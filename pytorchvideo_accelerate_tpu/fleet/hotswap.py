@@ -75,11 +75,16 @@ def hot_swap(replicas: List, artifact: str, *,
     per: Dict[str, float] = {}
     for replica in replicas:
         blue = replica.scheduler.current_engine()
+        # the serving tier's quantization choice survives the swap: a blue
+        # int8 replica pre-warms and cuts over to an int8 green even when
+        # the new artifact ships fp weights (on-the-fly quantization), and
+        # an fp fleet never silently picks up int8 from an embedded config
         green = InferenceEngine.from_artifact(
             artifact, mesh=blue.mesh,
             max_batch_size=(max_batch_size if max_batch_size is not None
                             else blue.buckets[-1]),
-            stats=replica.stats)
+            stats=replica.stats,
+            quantization=getattr(blue, "quantization", None))
         blackout = swap_replica(replica, green, prewarm=prewarm)
         per[replica.name] = round(blackout * 1e3, 3)
         logger.info("hot-swap %s: cutover blackout %.2f ms",

@@ -41,7 +41,8 @@ def register_model(name: str):
 @register_model("slow_r50")
 def _slow_r50(cfg: ModelConfig, dtype, mesh=None):
     return SlowR50(
-        num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate, dtype=dtype
+        num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
+        fused=cfg.fused_kernels, dtype=dtype
     )
 
 
@@ -51,7 +52,7 @@ def _tiny3d(cfg: ModelConfig, dtype, mesh=None):
     (compiles in seconds on a CPU host; not a reference architecture)."""
     return SlowR50(
         num_classes=cfg.num_classes, depths=(1, 1, 1, 1), stem_features=8,
-        dropout_rate=cfg.dropout_rate, dtype=dtype,
+        dropout_rate=cfg.dropout_rate, fused=cfg.fused_kernels, dtype=dtype,
     )
 
 
@@ -61,6 +62,7 @@ def _slowfast_r50(cfg: ModelConfig, dtype, mesh=None):
         num_classes=cfg.num_classes,
         alpha=cfg.slowfast_alpha,
         dropout_rate=cfg.dropout_rate,
+        fused=cfg.fused_kernels,
         dtype=dtype,
     )
 
@@ -72,6 +74,7 @@ def _slowfast_r101(cfg: ModelConfig, dtype, mesh=None):
         depths=(3, 4, 23, 3),
         alpha=cfg.slowfast_alpha,
         dropout_rate=cfg.dropout_rate,
+        fused=cfg.fused_kernels,
         dtype=dtype,
     )
 
@@ -79,20 +82,23 @@ def _slowfast_r101(cfg: ModelConfig, dtype, mesh=None):
 @register_model("x3d_xs")
 def _x3d_xs(cfg: ModelConfig, dtype, mesh=None):
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
+               depthwise_impl=cfg.depthwise_impl, fused=cfg.fused_kernels,
+               dtype=dtype)
 
 
 @register_model("x3d_s")
 def _x3d_s(cfg: ModelConfig, dtype, mesh=None):
     # XS and S share the trunk; they differ in sampling (13f@160px for S)
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
+               depthwise_impl=cfg.depthwise_impl, fused=cfg.fused_kernels,
+               dtype=dtype)
 
 
 @register_model("x3d_m")
 def _x3d_m(cfg: ModelConfig, dtype, mesh=None):
     return X3D(num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
+               depthwise_impl=cfg.depthwise_impl, fused=cfg.fused_kernels,
+               dtype=dtype)
 
 
 @register_model("x3d_l")
@@ -101,7 +107,8 @@ def _x3d_l(cfg: ModelConfig, dtype, mesh=None):
     # (1,2,5,3) x 5.0 -> (5,10,25,15)); sampled 16f@312px in the paper
     return X3D(num_classes=cfg.num_classes, depths=(5, 10, 25, 15),
                dropout_rate=cfg.dropout_rate,
-               depthwise_impl=cfg.depthwise_impl, dtype=dtype)
+               depthwise_impl=cfg.depthwise_impl, fused=cfg.fused_kernels,
+               dtype=dtype)
 
 
 @register_model("c2d_r50")
@@ -114,7 +121,7 @@ def _c2d_r50(cfg: ModelConfig, dtype, mesh=None):
     return SlowR50(
         num_classes=cfg.num_classes, temporal_kernels=(1, 1, 1, 1),
         stage1_temporal_pool=True,
-        dropout_rate=cfg.dropout_rate, dtype=dtype,
+        dropout_rate=cfg.dropout_rate, fused=cfg.fused_kernels, dtype=dtype,
     )
 
 
@@ -123,7 +130,8 @@ def _csn_r101(cfg: ModelConfig, dtype, mesh=None):
     """Hub `csn_r101` (ir-CSN-101, Kinetics-400 32x2); models/csn.py."""
     return CSN(
         num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-        depthwise_impl=cfg.depthwise_impl, dtype=dtype,
+        depthwise_impl=cfg.depthwise_impl, fused=cfg.fused_kernels,
+        dtype=dtype,
     )
 
 
@@ -132,7 +140,7 @@ def _r2plus1d_r50(cfg: ModelConfig, dtype, mesh=None):
     """Hub `r2plus1d_r50` (Kinetics-400 16x4); models/r2plus1d.py."""
     return R2Plus1D(
         num_classes=cfg.num_classes, dropout_rate=cfg.dropout_rate,
-        dtype=dtype,
+        fused=cfg.fused_kernels, dtype=dtype,
     )
 
 
@@ -213,6 +221,12 @@ def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None):
     """
     if cfg.name not in _REGISTRY:
         raise ValueError(f"unknown model {cfg.name!r}; available: {available_models()}")
+    from pytorchvideo_accelerate_tpu.models.common import FUSED_MODES
+
+    if cfg.fused_kernels not in FUSED_MODES:
+        raise ValueError(
+            f"model.fused_kernels must be one of {FUSED_MODES}, got "
+            f"{cfg.fused_kernels!r} (docs/KERNELS.md)")
     if cfg.attention in ("ring", "ulysses") and mesh is None:
         raise ValueError(
             f"attention={cfg.attention!r} needs the device mesh: "

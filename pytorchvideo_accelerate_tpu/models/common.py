@@ -20,8 +20,123 @@ from typing import Any, Callable, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 from flax import linen as nn
+from jax import lax
+
+from pytorchvideo_accelerate_tpu.precision import end_island, f32_island
 
 Dtype = Any
+
+# the fused-kernel lowering knob threaded from ModelConfig.fused_kernels
+# (docs/KERNELS.md): "off" = today's unfused graph byte-for-byte; "auto" =
+# Pallas kernels on TPU / folded-XLA elsewhere; "pallas"/"xla" force one
+# lowering (parity tests, graphcheck, kbench A/Bs)
+FUSED_MODES = ("off", "auto", "pallas", "xla")
+
+
+def fusable_act_name(act: Optional[Callable]) -> Optional[str]:
+    """Map a ConvBNAct activation callable onto the fused-epilogue act
+    vocabulary (ops/pallas_fused.FUSED_ACTS); None = not fusable (an
+    unrecognized callable keeps the unfused path rather than silently
+    changing function)."""
+    if act is None:
+        return "identity"
+    if act in (nn.relu,):
+        return "relu"
+    if act in (nn.swish, nn.silu):
+        return "silu"
+    return None
+
+
+class ConvKernelParam(nn.Module):
+    """Creates exactly the parameter `nn.Conv(..., use_bias=False)` would —
+    one "kernel" of shape (*kernel_size, Cin/groups, Cout), lecun-normal —
+    at this module's own scope, WITHOUT running the conv. The fused
+    lowerings consume the raw weight (they fold the norm scale into it),
+    and naming the module like the nn.Conv it replaces keeps the param
+    tree byte-identical across the `fused_kernels` knob, so checkpoints
+    and converted weights load unchanged (the DepthwiseConv3D contract,
+    applied to dense convs)."""
+
+    features: int
+    kernel: Tuple[int, int, int]
+    in_features: int
+    groups: int = 1
+
+    @nn.compact
+    def __call__(self):
+        return self.param(
+            "kernel",
+            nn.initializers.lecun_normal(),
+            (*self.kernel, self.in_features // self.groups, self.features),
+            jnp.float32,
+        )
+
+
+class BNAffine(nn.Module):
+    """Owns exactly the `nn.BatchNorm` param/variable tree ("scale"/"bias"
+    params, "mean"/"var" batch_stats) but returns the RESOLVED per-channel
+    (mul, add) affine instead of applying it — the form the fused kernels
+    fold into their weights/epilogue (ops/pallas_fused.py).
+
+    Eval: mul/add from the running stats — the whole norm is two (C,)
+    vectors, so conv+norm+act collapses into one kernel. Train: the caller
+    computes the batch stats of the raw conv output (they need the conv
+    result, so they cannot live in here) and passes them in; running
+    averages update exactly like nn.BatchNorm's (momentum form, f32)."""
+
+    momentum: float = 0.9
+    eps: float = 1e-5
+
+    @nn.compact
+    def __call__(self, features: int, batch_mean=None, batch_var=None,
+                 train: bool = False):
+        scale = self.param("scale", nn.initializers.ones,
+                           (features,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros,
+                          (features,), jnp.float32)
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((features,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((features,), jnp.float32))
+        if train:
+            mean, var = batch_mean, batch_var
+            if not self.is_initializing():
+                ra_mean.value = (self.momentum * ra_mean.value
+                                 + (1.0 - self.momentum) * mean)
+                ra_var.value = (self.momentum * ra_var.value
+                                + (1.0 - self.momentum) * var)
+        else:
+            mean, var = ra_mean.value, ra_var.value
+        mul = scale * lax.rsqrt(var + self.eps)
+        return mul, bias - mean * mul
+
+
+def batch_norm_stats(raw32):
+    """Per-channel batch (mean, var) of a raw conv output, f32, the
+    fast-variance form nn.BatchNorm uses (E[x^2] - E[x]^2, clamped).
+    Under pjit the batch axis is one global sharded tensor, so these are
+    sync-BN global stats by construction — same semantics as the unfused
+    nn.BatchNorm path (module docstring above)."""
+    axes = tuple(range(raw32.ndim - 1))
+    mean = jnp.mean(raw32, axis=axes)
+    var = jnp.maximum(jnp.mean(raw32 * raw32, axis=axes) - mean * mean, 0.0)
+    return mean, var
+
+
+def fused_train_norm_act(raw, bn: BNAffine, features: int, act: str,
+                         dtype):
+    """Training-mode tail of a fused conv site: batch stats from the raw
+    conv output (the one pass the fused lowering already wrote), running-
+    average update via `bn`, then affine + activation as one f32 island.
+    The conv itself used the fused lowering; the stats/affine/act here are
+    plain elementwise XLA fuses into a single pass — training keeps
+    correct autodiff through the batch statistics."""
+    from pytorchvideo_accelerate_tpu.ops.pallas_fused import apply_act
+
+    raw32 = f32_island(raw)
+    mean, var = batch_norm_stats(raw32)
+    mul, add = bn(features, mean, var, train=True)
+    return end_island(apply_act(raw32 * mul + add, act), dtype)
 
 
 class ConvBNAct(nn.Module):
@@ -39,9 +154,21 @@ class ConvBNAct(nn.Module):
     dtype: Dtype = jnp.float32
     bn_momentum: float = 0.9  # = 1 - torch_momentum(0.1)
     bn_eps: float = 1e-5
+    # fused conv+norm+act lowering (FUSED_MODES; docs/KERNELS.md): "off"
+    # keeps the graph below byte-for-byte; any other value routes
+    # stride-1 BN sites through ops/pallas_fused.py — same param tree
+    # (ConvKernelParam/BNAffine mirror nn.Conv/nn.BatchNorm), so the
+    # knob is a deployment choice, not a model change. Strided sites,
+    # bias convs, and unrecognized activations keep the unfused path.
+    fused: str = "off"
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        act_name = fusable_act_name(self.act)
+        if (self.fused != "off" and self.use_bn and not self.use_bias
+                and self.groups == 1 and tuple(self.stride) == (1, 1, 1)
+                and act_name is not None):
+            return self._fused(x, train, act_name)
         x = nn.Conv(
             self.features,
             kernel_size=self.kernel,
@@ -64,6 +191,30 @@ class ConvBNAct(nn.Module):
             x = self.act(x)
         return x
 
+    def _fused(self, x, train: bool, act_name: str):
+        from pytorchvideo_accelerate_tpu.ops.pallas_fused import (
+            fused_conv3d_bn_act,
+        )
+
+        w = ConvKernelParam(self.features, tuple(self.kernel),
+                            x.shape[-1], name="conv")()
+        bn = BNAffine(momentum=self.bn_momentum, eps=self.bn_eps,
+                      name="norm")
+        x = x.astype(self.dtype)
+        w = w.astype(self.dtype)
+        if train:
+            # fused conv pass; stats/affine/act ride it as one elementwise
+            # tail (autodiff through the batch statistics stays plain)
+            raw = fused_conv3d_bn_act(
+                x, w, jnp.ones((self.features,), jnp.float32),
+                jnp.zeros((self.features,), jnp.float32),
+                act="identity", mode=self.fused)
+            return fused_train_norm_act(raw, bn, self.features, act_name,
+                                        self.dtype)
+        mul, add = bn(self.features, train=False)
+        return fused_conv3d_bn_act(x, w, mul, add, act=act_name,
+                                   mode=self.fused)
+
 
 class Bottleneck3D(nn.Module):
     """ResNet bottleneck with a (kt,1,1) temporal conv_a, (1,3,3) spatial
@@ -75,6 +226,7 @@ class Bottleneck3D(nn.Module):
     features_out: int
     temporal_kernel: int = 1
     spatial_stride: int = 1
+    fused: str = "off"  # FUSED_MODES; strided sites auto-fallback
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -83,6 +235,7 @@ class Bottleneck3D(nn.Module):
         y = ConvBNAct(
             self.features_inner,
             kernel=(self.temporal_kernel, 1, 1),
+            fused=self.fused,
             dtype=self.dtype,
             name="conv_a",
         )(x, train)
@@ -90,6 +243,7 @@ class Bottleneck3D(nn.Module):
             self.features_inner,
             kernel=(1, 3, 3),
             stride=(1, self.spatial_stride, self.spatial_stride),
+            fused=self.fused,
             dtype=self.dtype,
             name="conv_b",
         )(y, train)
@@ -97,6 +251,7 @@ class Bottleneck3D(nn.Module):
             self.features_out,
             kernel=(1, 1, 1),
             act=None,
+            fused=self.fused,
             dtype=self.dtype,
             name="conv_c",
         )(y, train)
@@ -106,6 +261,7 @@ class Bottleneck3D(nn.Module):
                 kernel=(1, 1, 1),
                 stride=(1, self.spatial_stride, self.spatial_stride),
                 act=None,
+                fused=self.fused,
                 dtype=self.dtype,
                 name="branch1",
             )(residual, train)
@@ -120,6 +276,7 @@ class ResStage(nn.Module):
     features_out: int
     temporal_kernel: int = 1
     spatial_stride: int = 2
+    fused: str = "off"  # FUSED_MODES; threaded into every block
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -130,6 +287,7 @@ class ResStage(nn.Module):
                 features_out=self.features_out,
                 temporal_kernel=self.temporal_kernel,
                 spatial_stride=self.spatial_stride if i == 0 else 1,
+                fused=self.fused,
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x, train)

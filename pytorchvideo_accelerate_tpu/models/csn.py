@@ -34,8 +34,11 @@ import jax.numpy as jnp
 from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.models.common import (
+    BNAffine,
     ConvBNAct,
+    ConvKernelParam,
     Dtype,
+    fused_train_norm_act,
     max_pool_3d,
 )
 from pytorchvideo_accelerate_tpu.models.heads import ResBasicHead
@@ -44,15 +47,39 @@ from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
 
 class _DepthwiseConvBN(nn.Module):
     """Depthwise conv + BN + ReLU at the `<name>/{conv,norm}` param paths
-    ConvBNAct uses, so the generic converter map lands unchanged."""
+    ConvBNAct uses, so the generic converter map lands unchanged. With
+    `fused` armed, stride-1 blocks route through
+    ops/pallas_fused.fused_depthwise_bn_act (identical param tree —
+    ConvKernelParam/BNAffine mirror the modules below); strided stage
+    entries keep the unfused path."""
 
     features: int
     stride: Tuple[int, int, int]
     depthwise_impl: str
     dtype: Dtype
+    fused: str = "off"  # common.FUSED_MODES
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        if self.fused != "off" and tuple(self.stride) == (1, 1, 1):
+            from pytorchvideo_accelerate_tpu.ops.pallas_fused import (
+                fused_depthwise_bn_act,
+            )
+
+            c = self.features
+            k = ConvKernelParam(c, (3, 3, 3), c, groups=c, name="conv")()
+            bn = BNAffine(momentum=0.9, eps=1e-5, name="norm")
+            x = x.astype(self.dtype)
+            k = k.astype(self.dtype)
+            if train:
+                raw = fused_depthwise_bn_act(
+                    x, k, jnp.ones((c,), jnp.float32),
+                    jnp.zeros((c,), jnp.float32), act="identity",
+                    mode=self.fused)
+                return fused_train_norm_act(raw, bn, c, "relu", self.dtype)
+            mul, add = bn(c, train=False)
+            return fused_depthwise_bn_act(x, k, mul, add, act="relu",
+                                          mode=self.fused)
         x = DepthwiseConv3D(
             self.features, kernel_size=(3, 3, 3), stride=self.stride,
             impl=self.depthwise_impl, dtype=self.dtype, name="conv",
@@ -71,6 +98,7 @@ class CSNBottleneck(nn.Module):
     temporal_stride: int = 1
     spatial_stride: int = 1
     depthwise_impl: str = "conv"
+    fused: str = "off"  # common.FUSED_MODES; strided sites auto-fallback
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -79,16 +107,20 @@ class CSNBottleneck(nn.Module):
         stride = (self.temporal_stride, self.spatial_stride,
                   self.spatial_stride)
         y = ConvBNAct(self.features_inner, kernel=(1, 1, 1),
+                      fused=self.fused,
                       dtype=self.dtype, name="conv_a")(x, train)
         y = _DepthwiseConvBN(self.features_inner, stride=stride,
                              depthwise_impl=self.depthwise_impl,
+                             fused=self.fused,
                              dtype=self.dtype, name="conv_b")(y, train)
         y = ConvBNAct(self.features_out, kernel=(1, 1, 1), act=None,
+                      fused=self.fused,
                       dtype=self.dtype, name="conv_c")(y, train)
         if (residual.shape[-1] != self.features_out
                 or self.spatial_stride != 1 or self.temporal_stride != 1):
             residual = ConvBNAct(self.features_out, kernel=(1, 1, 1),
-                                 stride=stride, act=None, dtype=self.dtype,
+                                 stride=stride, act=None, fused=self.fused,
+                                 dtype=self.dtype,
                                  name="branch1")(residual, train)
         return nn.relu(residual + y)
 
@@ -105,6 +137,7 @@ class CSNStage(nn.Module):
     temporal_stride: int = 1
     spatial_stride: int = 1
     depthwise_impl: str = "conv"
+    fused: str = "off"  # common.FUSED_MODES; threaded into every block
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -116,6 +149,7 @@ class CSNStage(nn.Module):
                 temporal_stride=self.temporal_stride if i == 0 else 1,
                 spatial_stride=self.spatial_stride if i == 0 else 1,
                 depthwise_impl=self.depthwise_impl,
+                fused=self.fused,
                 dtype=self.dtype,
                 name=f"block{i}",
             )(x, train)
@@ -130,6 +164,7 @@ class CSN(nn.Module):
     temporal_strides: Tuple[int, ...] = (1, 2, 2, 2)
     dropout_rate: float = 0.5
     depthwise_impl: str = "conv"  # conv | shift (ops/depthwise.py)
+    fused: str = "off"  # common.FUSED_MODES (ModelConfig.fused_kernels)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -149,6 +184,7 @@ class CSN(nn.Module):
                 temporal_stride=self.temporal_strides[stage_idx],
                 spatial_stride=self.spatial_strides[stage_idx],
                 depthwise_impl=self.depthwise_impl,
+                fused=self.fused,
                 dtype=self.dtype,
                 name=f"res{stage_idx + 2}",
             )(x, train)

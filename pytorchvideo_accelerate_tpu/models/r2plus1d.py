@@ -52,28 +52,29 @@ class Bottleneck2Plus1D(nn.Module):
     features_out: int
     temporal_stride: int = 1
     spatial_stride: int = 1
+    fused: str = "off"  # common.FUSED_MODES; strided sites auto-fallback
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         residual = x
         y = ConvBNAct(
-            self.features_inner, kernel=(1, 1, 1), dtype=self.dtype,
-            name="conv_a",
+            self.features_inner, kernel=(1, 1, 1), fused=self.fused,
+            dtype=self.dtype, name="conv_a",
         )(x, train)
         y = ConvBNAct(
             self.features_inner, kernel=(1, 3, 3),
             stride=(1, self.spatial_stride, self.spatial_stride),
-            dtype=self.dtype, name="conv_b_s",
+            fused=self.fused, dtype=self.dtype, name="conv_b_s",
         )(y, train)
         y = ConvBNAct(
             self.features_inner, kernel=(3, 1, 1),
             stride=(self.temporal_stride, 1, 1),
-            dtype=self.dtype, name="conv_b_t",
+            fused=self.fused, dtype=self.dtype, name="conv_b_t",
         )(y, train)
         y = ConvBNAct(
-            self.features_out, kernel=(1, 1, 1), act=None, dtype=self.dtype,
-            name="conv_c",
+            self.features_out, kernel=(1, 1, 1), act=None, fused=self.fused,
+            dtype=self.dtype, name="conv_c",
         )(y, train)
         if (residual.shape[-1] != self.features_out
                 or self.spatial_stride != 1 or self.temporal_stride != 1):
@@ -81,7 +82,7 @@ class Bottleneck2Plus1D(nn.Module):
                 self.features_out, kernel=(1, 1, 1),
                 stride=(self.temporal_stride, self.spatial_stride,
                         self.spatial_stride),
-                act=None, dtype=self.dtype, name="branch1",
+                act=None, fused=self.fused, dtype=self.dtype, name="branch1",
             )(residual, train)
         return nn.relu(residual + y)
 
@@ -95,6 +96,7 @@ class R2Plus1D(nn.Module):
     spatial_strides: Tuple[int, ...] = (2, 2, 2, 2)
     temporal_strides: Tuple[int, ...] = (1, 1, 2, 2)
     dropout_rate: float = 0.5
+    fused: str = "off"  # common.FUSED_MODES (ModelConfig.fused_kernels)
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -116,6 +118,7 @@ class R2Plus1D(nn.Module):
                         self.temporal_strides[stage_idx] if i == 0 else 1),
                     spatial_stride=(
                         self.spatial_strides[stage_idx] if i == 0 else 1),
+                    fused=self.fused,
                     dtype=self.dtype,
                     name=f"res{stage_idx + 2}_block{i}",
                 )(x, train)

@@ -42,6 +42,10 @@ class SlowR50(nn.Module):
     # requires the 8->4 reduction. Parameter shapes are unaffected.
     stage1_temporal_pool: bool = False
     dropout_rate: float = 0.5
+    # fused conv+BN+act lowering for the stride-1 bottleneck sites
+    # (common.FUSED_MODES; ModelConfig.fused_kernels); the strided stem
+    # keeps the unfused path regardless
+    fused: str = "off"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -65,6 +69,7 @@ class SlowR50(nn.Module):
                 features_out=features_out,
                 temporal_kernel=self.temporal_kernels[stage_idx],
                 spatial_stride=1 if stage_idx == 0 else 2,
+                fused=self.fused,
                 dtype=self.dtype,
                 name=f"res{stage_idx + 2}",
             )(x, train)

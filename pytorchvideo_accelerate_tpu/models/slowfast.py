@@ -64,6 +64,10 @@ class SlowFast(nn.Module):
     stem_features: int = 64
     slow_temporal_kernels: Tuple[int, ...] = (1, 1, 3, 3)
     dropout_rate: float = 0.5
+    # fused conv+BN+act lowering for the stride-1 bottleneck sites
+    # (common.FUSED_MODES; ModelConfig.fused_kernels). Stems and lateral
+    # fusions are strided and keep the unfused path regardless.
+    fused: str = "off"
     dtype: Any = jnp.float32
 
     @nn.compact
@@ -96,6 +100,7 @@ class SlowFast(nn.Module):
                 features_out=slow_inner * 4,
                 temporal_kernel=self.slow_temporal_kernels[stage_idx],
                 spatial_stride=spatial_stride,
+                fused=self.fused,
                 dtype=self.dtype,
                 name=f"slow_res{stage_idx + 2}",
             )(slow, train)
@@ -105,6 +110,7 @@ class SlowFast(nn.Module):
                 features_out=fast_inner * 4,
                 temporal_kernel=3,  # fast pathway: temporal convs everywhere
                 spatial_stride=spatial_stride,
+                fused=self.fused,
                 dtype=self.dtype,
                 name=f"fast_res{stage_idx + 2}",
             )(fast, train)

@@ -26,7 +26,13 @@ from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.precision import f32_island
 
-from pytorchvideo_accelerate_tpu.models.common import ConvBNAct, Dtype
+from pytorchvideo_accelerate_tpu.models.common import (
+    BNAffine,
+    ConvBNAct,
+    ConvKernelParam,
+    Dtype,
+    fused_train_norm_act,
+)
 from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
 
 
@@ -66,24 +72,35 @@ class X3DBlock(nn.Module):
     spatial_stride: int = 1
     use_se: bool = False
     depthwise_impl: str = "conv"
+    fused: str = "off"  # common.FUSED_MODES; strided blocks auto-fallback
     dtype: Dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
         residual = x
         y = ConvBNAct(self.features_inner, kernel=(1, 1, 1),
+                      fused=self.fused,
                       dtype=self.dtype, name="conv_a")(x, train)
-        # depthwise spatiotemporal conv (selectable lowering, ops/depthwise)
-        y = DepthwiseConv3D(self.features_inner, kernel_size=(3, 3, 3),
-                            stride=(1, self.spatial_stride, self.spatial_stride),
-                            impl=self.depthwise_impl, dtype=self.dtype,
-                            name="conv_b")(y)
-        y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype, name="norm_b")(y)
-        if self.use_se:
-            y = SqueezeExcite(self.features_inner, dtype=self.dtype, name="se")(y)
-        y = nn.swish(y)
+        if self.fused != "off" and self.spatial_stride == 1:
+            # fused depthwise conv_b + BN (+ swish when no SE sits between)
+            # through ops/pallas_fused — same conv_b/norm_b param tree
+            y = self._fused_conv_b(y, train)
+        else:
+            # depthwise spatiotemporal conv (selectable lowering,
+            # ops/depthwise); strided stage entries always land here
+            y = DepthwiseConv3D(self.features_inner, kernel_size=(3, 3, 3),
+                                stride=(1, self.spatial_stride,
+                                        self.spatial_stride),
+                                impl=self.depthwise_impl, dtype=self.dtype,
+                                name="conv_b")(y)
+            y = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype, name="norm_b")(y)
+            if self.use_se:
+                y = SqueezeExcite(self.features_inner, dtype=self.dtype,
+                                  name="se")(y)
+            y = nn.swish(y)
         y = ConvBNAct(self.features_out, kernel=(1, 1, 1), act=None,
+                      fused=self.fused,
                       dtype=self.dtype, name="conv_c")(y, train)
         if residual.shape[-1] != self.features_out or self.spatial_stride != 1:
             # pytorchvideo x3d.py quirk (create_x3d_res_block): the shortcut
@@ -97,6 +114,34 @@ class X3DBlock(nn.Module):
                                  name="branch1")(residual, train)
         return nn.relu(residual + y)
 
+    def _fused_conv_b(self, y, train: bool):
+        from pytorchvideo_accelerate_tpu.ops.pallas_fused import (
+            fused_depthwise_bn_act,
+        )
+
+        c = self.features_inner
+        k = ConvKernelParam(c, (3, 3, 3), c, groups=c, name="conv_b")()
+        bn = BNAffine(momentum=0.9, eps=1e-5, name="norm_b")
+        # SE reads the NORMALIZED pre-activation, so with SE the fused
+        # epilogue stops at the affine; without it swish fuses in too
+        epilogue = "identity" if self.use_se else "silu"
+        y = y.astype(self.dtype)
+        k = k.astype(self.dtype)
+        if train:
+            raw = fused_depthwise_bn_act(
+                y, k, jnp.ones((c,), jnp.float32),
+                jnp.zeros((c,), jnp.float32), act="identity",
+                mode=self.fused)
+            y = fused_train_norm_act(raw, bn, c, epilogue, self.dtype)
+        else:
+            mul, add = bn(c, train=False)
+            y = fused_depthwise_bn_act(y, k, mul, add, act=epilogue,
+                                       mode=self.fused)
+        if self.use_se:
+            y = SqueezeExcite(c, dtype=self.dtype, name="se")(y)
+            y = nn.swish(y)
+        return y
+
 
 class X3D(nn.Module):
     num_classes: int
@@ -107,21 +152,44 @@ class X3D(nn.Module):
     head_features: int = 2048
     dropout_rate: float = 0.5
     depthwise_impl: str = "conv"  # conv | shift (ops/depthwise.py)
+    fused: str = "off"  # common.FUSED_MODES (ModelConfig.fused_kernels)
     dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x, train: bool = False):
+        from pytorchvideo_accelerate_tpu.ops.pallas_fused import (
+            fused_depthwise_bn_act,
+        )
+
         x = x.astype(self.dtype)
         # stem: spatial then depthwise-temporal conv
         x = nn.Conv(self.stem_features, (1, 3, 3), strides=(1, 2, 2),
                     padding=[(0, 0), (1, 1), (1, 1)], use_bias=False,
                     dtype=self.dtype, name="stem_xy")(x)
-        x = DepthwiseConv3D(self.stem_features, (5, 1, 1),
-                            impl=self.depthwise_impl, dtype=self.dtype,
-                            name="stem_t")(x)
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype, name="stem_norm")(x)
-        x = nn.relu(x)
+        if self.fused != "off":
+            # fused stem_t depthwise + stem_norm + relu (same param tree)
+            sf = self.stem_features
+            k = ConvKernelParam(sf, (5, 1, 1), sf, groups=sf,
+                                name="stem_t")().astype(self.dtype)
+            bn = BNAffine(momentum=0.9, eps=1e-5, name="stem_norm")
+            if train:
+                raw = fused_depthwise_bn_act(
+                    x, k, jnp.ones((sf,), jnp.float32),
+                    jnp.zeros((sf,), jnp.float32), act="identity",
+                    mode=self.fused)
+                x = fused_train_norm_act(raw, bn, sf, "relu", self.dtype)
+            else:
+                mul, add = bn(sf, train=False)
+                x = fused_depthwise_bn_act(x, k, mul, add, act="relu",
+                                           mode=self.fused)
+        else:
+            x = DepthwiseConv3D(self.stem_features, (5, 1, 1),
+                                impl=self.depthwise_impl, dtype=self.dtype,
+                                name="stem_t")(x)
+            x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                             epsilon=1e-5, dtype=self.dtype,
+                             name="stem_norm")(x)
+            x = nn.relu(x)
 
         for stage_idx, depth in enumerate(self.depths):
             f_out = self.stage_features[stage_idx]
@@ -133,6 +201,7 @@ class X3D(nn.Module):
                     spatial_stride=2 if i == 0 else 1,
                     use_se=(i % 2 == 0),  # SE every other block (paper §3)
                     depthwise_impl=self.depthwise_impl,
+                    fused=self.fused,
                     dtype=self.dtype,
                     name=f"res{stage_idx + 2}_block{i}",
                 )(x, train)
@@ -143,7 +212,8 @@ class X3D(nn.Module):
         # ReLU between makes the order numerically load-bearing for
         # converted weights, and pooling first is also cheaper)
         f5 = int(round(self.stage_features[-1] * self.expansion))
-        x = ConvBNAct(f5, kernel=(1, 1, 1), dtype=self.dtype, name="conv5")(x, train)
+        x = ConvBNAct(f5, kernel=(1, 1, 1), fused=self.fused,
+                      dtype=self.dtype, name="conv5")(x, train)
         x = jnp.mean(x, axis=(1, 2, 3), keepdims=True)  # (B,1,1,1,C)
         x = nn.Conv(self.head_features, (1, 1, 1), use_bias=False,
                     dtype=self.dtype, name="head_conv")(x)

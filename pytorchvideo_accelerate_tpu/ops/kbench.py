@@ -1,0 +1,293 @@
+"""`pva-tpu-kbench`: attributable kernel microbenchmarks.
+
+The missing link between "the bench number moved" and "because of THIS
+kernel": each fused conv/norm/act kernel (ops/pallas_fused.py) is timed
+against its unfused XLA reference — the exact op chain the model graph
+runs with `model.fused_kernels=off` — at the REAL model shapes of the
+slowfast_r50/x3d_s hot paths, and the per-kernel speedup keys ride the
+bench headline so `pva-tpu-perfdiff` can attribute wins round over
+round instead of guessing which change moved the trajectory.
+
+Honesty rules (the bench.py house discipline):
+- **parity before speed**: every case asserts fused-vs-reference
+  allclose at the benched shape, AND interpret-mode Pallas parity at a
+  reduced shape on non-TPU hosts (the kernels' unit-test contract) —
+  a fast wrong kernel fails the lane, it does not headline;
+- **same-backend ratios only**: `speedup` is reference-time /
+  fused-time on ONE backend. On a TPU host that is the device story;
+  on a CPU host it is an honest host story (the folded-shift depthwise
+  lowering beats XLA:CPU's grouped conv by ~two orders of magnitude at
+  x3d shapes) — the record carries `platform` and `device` so a CPU
+  ratio can never impersonate a device number, per the standing
+  suspect-round refusal rule; raw millisecond timings stay in
+  bench_partial.json, never on the headline;
+- the timing loop rotates two distinct inputs (the bench.py
+  anti-constant-folding discipline) and syncs via value fetch.
+
+Run it standalone (`pva-tpu-kbench [--smoke] [--json]`), through the
+bench lane (`bench.py --kbench`, on by default), or from the analysis
+gate (`scripts/analyze.sh` runs `--smoke`). Exit codes: 0 = parity
+clean, 1 = parity violation, 2 = usage.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import sys
+import time
+from dataclasses import dataclass
+from typing import Callable, List, Optional
+
+
+def _log(*a):
+    print(*a, file=sys.stderr, flush=True)
+
+
+@dataclass
+class KernelCase:
+    """One fused kernel vs its XLA reference at one model shape."""
+
+    name: str            # headline key suffix: kbench_<name>_speedup
+    attribution: str     # which model/block this shape comes from
+    shape: tuple         # (B, T, H, W, C...) documentation
+    ref: Callable        # unfused reference (the fused_kernels=off chain)
+    fused: Callable      # ops/pallas_fused dispatcher, mode="auto"
+    pallas: Callable     # forced-pallas variant (interpret off-TPU)
+    args: tuple          # benched operands
+    small_args: tuple    # reduced operands for interpret-mode parity
+    rtol: float = 2e-5
+    atol: float = 2e-5
+
+
+def _affine(rng, c):
+    """A realistic resolved BN affine (gamma/beta over running stats)."""
+    import jax.numpy as jnp
+
+    gamma = rng.standard_normal(c).astype("float32") * 0.1 + 1.0
+    beta = rng.standard_normal(c).astype("float32") * 0.1
+    mean = rng.standard_normal(c).astype("float32") * 0.1
+    var = abs(rng.standard_normal(c)).astype("float32") + 1.0
+    scale = gamma / (var + 1e-5) ** 0.5
+    return jnp.asarray(scale), jnp.asarray(beta - mean * scale)
+
+
+def build_cases(smoke: bool) -> List[KernelCase]:
+    """The measured hot-path shapes. Geometry provenance:
+    x3d_s samples 13f@160px -> stem 80 -> res2 40 -> res3 20 -> res4 10
+    with inner widths 54/108/216/432 (expansion 2.25); slowfast_r50
+    samples 32f@256px -> slow pathway 8f, res4 at 16x16 with inner 256.
+    Smoke mode shrinks every case to harness-verification size."""
+    import functools
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.ops.pallas_fused import (
+        fused_conv3d_bn_act,
+        fused_depthwise_bn_act,
+        fused_pointwise_bn_act,
+    )
+    from pytorchvideo_accelerate_tpu.ops.kbench_refs import (
+        ref_conv_bn_act,
+        ref_dw_bn_act,
+        ref_pw_bn_act,
+    )
+
+    rng = np.random.default_rng(0)
+    cases: List[KernelCase] = []
+
+    def clips(shape):
+        return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+    def weights(shape, s=0.1):
+        return jnp.asarray(rng.standard_normal(shape) * s, jnp.float32)
+
+    def add(name, attribution, shape, ref, fused_fn, args, small_args,
+            **kw):
+        cases.append(KernelCase(
+            name=name, attribution=attribution, shape=shape, ref=ref,
+            fused=functools.partial(fused_fn, mode="auto"),
+            pallas=functools.partial(fused_fn, mode="pallas"),
+            args=args, small_args=small_args, **kw))
+
+    # --- x3d_s res3 depthwise conv_b + BN + swish (the x3d FLOPs bound) -
+    b, t, h, c = (1, 4, 8, 16) if smoke else (2, 13, 20, 108)
+    x = clips((b, t, h, h, c))
+    k = weights((3, 3, 3, 1, c))
+    s_, bi = _affine(rng, c)
+    xs = clips((1, 4, 6, 6, 8))
+    ks = weights((3, 3, 3, 1, 8))
+    ss, bs = _affine(rng, 8)
+    add("dw_x3d_res3", "x3d_s res3 conv_b 3x3x3 dw + BN + swish",
+        (b, t, h, h, c),
+        functools.partial(ref_dw_bn_act, act="silu"),
+        functools.partial(fused_depthwise_bn_act, act="silu"),
+        (x, k, s_, bi), (xs, ks, ss, bs))
+
+    # --- x3d_s res3 pointwise expand conv_a + BN + relu -----------------
+    cin, cout = (8, 16) if smoke else (48, 108)
+    x = clips((b, t, h, h, cin))
+    w = weights((1, 1, 1, cin, cout))
+    s_, bi = _affine(rng, cout)
+    ws = weights((1, 1, 1, 8, 12))
+    ss, bs = _affine(rng, 12)
+    add("pw_x3d_res3", "x3d_s res3 conv_a 1x1x1 expand + BN + relu",
+        (b, t, h, h, cin, cout),
+        functools.partial(ref_pw_bn_act, act="relu"),
+        functools.partial(fused_pointwise_bn_act, act="relu"),
+        (x, w, s_, bi), (xs, ws, ss, bs))
+
+    # --- slowfast_r50 slow res4 spatial conv_b (1,3,3) + BN + relu ------
+    b2, t2, hw, cc = (1, 4, 8, 16) if smoke else (2, 8, 16, 256)
+    x = clips((b2, t2, hw, hw, cc))
+    w = weights((1, 3, 3, cc, cc), s=0.05)
+    s_, bi = _affine(rng, cc)
+    ws = weights((1, 3, 3, 8, 8))
+    ss, bs = _affine(rng, 8)
+    add("conv133_sf_res4", "slowfast_r50 slow res4 conv_b (1,3,3) + BN "
+        "+ relu", (b2, t2, hw, hw, cc),
+        functools.partial(ref_conv_bn_act, act="relu"),
+        functools.partial(fused_conv3d_bn_act, act="relu"),
+        (x, w, s_, bi), (xs, ws, ss, bs))
+
+    # --- slowfast_r50 fast res4 temporal conv_a (3,1,1) + BN + relu -----
+    cin3, cout3 = (16, 8) if smoke else (128, 32)
+    t3 = 4 if smoke else 32
+    x = clips((b2, t3, hw, hw, cin3))
+    w = weights((3, 1, 1, cin3, cout3), s=0.05)
+    s_, bi = _affine(rng, cout3)
+    ws = weights((3, 1, 1, 8, 8))
+    add("conv311_sf_res4", "slowfast_r50 fast res4 conv_a (3,1,1) + BN "
+        "+ relu", (b2, t3, hw, hw, cin3),
+        functools.partial(ref_conv_bn_act, act="relu"),
+        functools.partial(fused_conv3d_bn_act, act="relu"),
+        (x, w, s_, bi), (xs, ws, ss, bs))
+    return cases
+
+
+def _time_fn(fn, args, iters: int, warmup: int = 1) -> float:
+    """Median wall ms per call, value-fetch synced; rotates two operand
+    sets so a caching backend can't replay one result."""
+    import jax
+    import numpy as np
+
+    rotated = [args, tuple(a * (1.0 + 1e-6) if hasattr(a, "dtype") else a
+                           for a in args)]
+    for i in range(warmup):
+        jax.block_until_ready(fn(*rotated[i % 2]))
+    samples = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        out = fn(*rotated[i % 2])
+        np.asarray(jax.tree_util.tree_leaves(out)[0])  # value-fetch sync
+        samples.append(time.perf_counter() - t0)
+    return statistics.median(samples) * 1e3
+
+
+def run_kbench(smoke: bool = False, iters: Optional[int] = None,
+               log=_log) -> dict:
+    """Benchmark every case; returns the record bench.py headlines from."""
+    import jax
+    import numpy as np
+
+    iters = iters if iters is not None else (3 if smoke else 7)
+    platform = jax.default_backend()
+    on_device = platform == "tpu"
+    t_start = time.perf_counter()
+    kernels = {}
+    all_parity = True
+    for case in build_cases(smoke):
+        # one jit wrapper per benchmark case, reused for parity AND the
+        # whole timing loop — the per-case compile IS the measurement unit
+        ref_j = jax.jit(case.ref)      # pva: disable=recompile -- one compile per case, reused across the timing loop
+        fused_j = jax.jit(case.fused)  # pva: disable=recompile -- one compile per case, reused across the timing loop
+        # parity at the benched shape (fused "auto" lowering vs reference)
+        got = np.asarray(fused_j(*case.args), np.float32)
+        want = np.asarray(ref_j(*case.args), np.float32)
+        parity = bool(np.allclose(got, want, rtol=case.rtol,
+                                  atol=case.atol))
+        # interpret-mode Pallas parity at the reduced shape (off-TPU the
+        # auto lowering is folded-XLA, so this is what exercises the
+        # actual kernel code); on TPU the benched fused fn IS pallas
+        pal_got = np.asarray(case.pallas(*case.small_args), np.float32)
+        pal_want = np.asarray(case.ref(*case.small_args), np.float32)
+        interp_parity = bool(np.allclose(pal_got, pal_want,
+                                         rtol=case.rtol, atol=case.atol))
+        all_parity = all_parity and parity and interp_parity
+        ms_ref = _time_fn(ref_j, case.args, iters)
+        ms_fused = _time_fn(fused_j, case.args, iters)
+        rec = {
+            "attribution": case.attribution,
+            "shape": list(case.shape),
+            "ms_ref": round(ms_ref, 3),
+            "ms_fused": round(ms_fused, 3),
+            "speedup": round(ms_ref / max(ms_fused, 1e-9), 3),
+            "parity_ok": parity,
+            "interpret_parity_ok": interp_parity,
+            "lowering": "pallas" if on_device else "xla-folded",
+        }
+        kernels[case.name] = rec
+        log(f"[kbench] {case.name}: ref {ms_ref:.2f} ms, fused "
+            f"{ms_fused:.2f} ms -> {rec['speedup']}x "
+            f"({rec['lowering']}, parity={parity}, "
+            f"interp_parity={interp_parity})")
+    best = max(kernels, key=lambda n: kernels[n]["speedup"])
+    return {
+        "platform": platform,
+        # same-backend ratios are honest anywhere, but only a TPU run is
+        # a DEVICE claim — the standing no-CPU-numbers-as-device-numbers
+        # rule; bench.py refuses to headline ms timings either way
+        "device": on_device,
+        "smoke": bool(smoke),
+        "iters": iters,
+        "parity_ok": all_parity,
+        "kernels": kernels,
+        "best_kernel": best,
+        "best_speedup": kernels[best]["speedup"],
+        "elapsed_s": round(time.perf_counter() - t_start, 1),
+    }
+
+
+def headline_keys(record: dict) -> dict:
+    """The compact keys the bench headline carries (finalize() budget:
+    dimensionless same-backend ratios + platform label, never raw ms)."""
+    out = {
+        "kbench_platform": record["platform"],
+        "kbench_parity_ok": record["parity_ok"],
+        "kbench_best": f"{record['best_kernel']}:"
+                       f"{record['best_speedup']}x",
+    }
+    for name, rec in record["kernels"].items():
+        out[f"kbench_{name}_speedup"] = rec["speedup"]
+    return out
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pva-tpu-kbench",
+        description="fused-kernel microbenchmarks vs XLA references at "
+                    "real model shapes (docs/KERNELS.md)")
+    ap.add_argument("--smoke", action="store_true",
+                    help="tiny shapes; harness/parity verification")
+    ap.add_argument("--iters", type=int, default=None)
+    ap.add_argument("--json", action="store_true")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as e:
+        return 2 if e.code not in (0, None) else 0
+    record = run_kbench(smoke=args.smoke, iters=args.iters)
+    if args.json:
+        print(json.dumps(record, indent=1))
+    else:
+        print(json.dumps(headline_keys(record)))
+    if not record["parity_ok"]:
+        _log("pva-tpu-kbench: PARITY VIOLATION — a fused kernel diverged "
+             "from its XLA reference (record above); speed means nothing")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

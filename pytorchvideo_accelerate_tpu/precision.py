@@ -39,6 +39,16 @@ def f32_island(x):
     return x.astype(ISLAND_DTYPE)
 
 
+def end_island(x, dtype):
+    """Close an f32 island: cast the island's result back to the compute
+    dtype `dtype` at the DESIGNED boundary (the single store/downcast of
+    a fused-kernel epilogue or accumulator). The counterpart seam to
+    `f32_island` — using it states that the f32 excursion ends here on
+    purpose, which is what keeps the graphcheck dtype pass's taint from
+    ever reaching compute."""
+    return x.astype(dtype)
+
+
 def policy_compute_dtype(mixed_precision: str):
     """Model compute dtype for a TrainConfig.mixed_precision string:
     bf16 for "bf16"/"fp16" (fp16 maps to bf16 on TPU — no loss scaling),

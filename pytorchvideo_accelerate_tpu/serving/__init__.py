@@ -29,4 +29,8 @@ from pytorchvideo_accelerate_tpu.serving.batcher import (  # noqa: F401
     QueueFullError,
 )
 from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine  # noqa: F401
+from pytorchvideo_accelerate_tpu.serving.quantize import (  # noqa: F401
+    dequantize_tree,
+    quantize_tree,
+)
 from pytorchvideo_accelerate_tpu.serving.stats import ServingStats  # noqa: F401

@@ -105,7 +105,19 @@ class InferenceEngine:
     def __init__(self, model, params, batch_stats, mesh=None, *,
                  num_classes: int, max_batch_size: int = 8,
                  device_normalize=None, input_dtype: str = "float32",
-                 model_name: str = "", stats=None):
+                 model_name: str = "", stats=None,
+                 quantization: str = "off", compute_dtype=None):
+        from pytorchvideo_accelerate_tpu.serving.quantize import (
+            QUANT_MODES,
+            quant_bytes,
+            quantize_tree,
+            quantized_leaf_count,
+        )
+
+        if quantization not in QUANT_MODES:
+            raise ValueError(
+                f"serve.quantization must be one of {QUANT_MODES}, got "
+                f"{quantization!r} (docs/SERVING.md § quantization)")
         self.model = model
         self.mesh = mesh if mesh is not None else make_mesh()
         self.num_classes = int(num_classes)
@@ -113,10 +125,24 @@ class InferenceEngine:
         self.input_dtype = input_dtype
         self.stats = stats
         self._device_normalize = device_normalize
+        self.quantization = quantization
+        # int8 weights dequantize to THIS dtype inside the jitted forward
+        # (bf16 activations under the default policy — serving/quantize.py)
+        self._compute_dtype = (compute_dtype if compute_dtype is not None
+                               else jnp.bfloat16)
         self.shards = data_shard_count(self.mesh)
         self.buckets = compute_buckets(max_batch_size, self.shards)
+        if quantization == "int8" and not quantized_leaf_count(params):
+            # on-the-fly quantization of a full-precision tree (loaded fp
+            # artifact / direct construction); baked artifacts arrive
+            # already quantized and pass through idempotently
+            params, n = quantize_tree(params)
+            logger.info("engine: quantized %d weight leaves to int8 "
+                        "(%s)", n, quant_bytes(params))
         # pin the weights to the mesh once (replicated / fsdp-sharded per
         # the training rules); every forward reuses the same pinned arrays
+        # — for int8 engines the PINNED tree is the int8 one (4x less HBM);
+        # dequantization happens per-forward inside the compiled graph
         self.params = shard_params(self.mesh, params)
         self.batch_stats = shard_params(self.mesh, batch_stats or {})
         self._fns: Dict[tuple, Callable] = {}
@@ -129,13 +155,21 @@ class InferenceEngine:
 
     @classmethod
     def from_artifact(cls, path: str, mesh=None, *,
-                      max_batch_size: Optional[int] = None, stats=None
+                      max_batch_size: Optional[int] = None, stats=None,
+                      quantization: Optional[str] = None
                       ) -> "InferenceEngine":
         """Restore an `export_inference` artifact (trainer/checkpoint.py)
         into a ready engine: rebuild the model from the artifact's resolved
-        config, load the EMA-resolved params, pin them to the mesh."""
+        config, load the EMA-resolved params, pin them to the mesh.
+
+        `quantization` resolution (docs/SERVING.md § quantization):
+        explicit argument > the artifact's baked `meta.quantization` >
+        the artifact-embedded `serve.quantization`. A baked-int8 artifact
+        always serves int8 — the fp weights no longer exist — so an
+        explicit "off" against one logs a warning instead of lying."""
         from pytorchvideo_accelerate_tpu.config import TrainConfig, config_from_dict
         from pytorchvideo_accelerate_tpu.models import create_model
+        from pytorchvideo_accelerate_tpu.precision import policy_compute_dtype
         from pytorchvideo_accelerate_tpu.trainer.checkpoint import load_inference
 
         params, batch_stats, meta = load_inference(path)
@@ -149,6 +183,14 @@ class InferenceEngine:
         cfg.model.num_classes = num_classes
         mesh = mesh if mesh is not None else make_mesh()
         model = create_model(cfg.model, cfg.mixed_precision, mesh=mesh)
+        art_q = meta.get("quantization") or "off"
+        eff_q = (quantization if quantization is not None
+                 else (art_q if art_q != "off" else cfg.serve.quantization))
+        if art_q == "int8" and eff_q == "off":
+            logger.warning(
+                "artifact %s is baked int8; the fp weights no longer "
+                "exist — serving int8 despite quantization='off'", path)
+            eff_q = "int8"
         # u8-trained runs ship raw uint8 clips and normalize in-graph
         # (data.host_cast='u8'); serving must apply the identical affine
         u8 = cfg.data.host_cast == "u8"
@@ -161,13 +203,16 @@ class InferenceEngine:
             input_dtype="uint8" if u8 else "float32",
             model_name=meta.get("model") or cfg.model.name,
             stats=stats,
+            quantization=eff_q,
+            compute_dtype=policy_compute_dtype(cfg.mixed_precision),
         )
         engine.artifact_config = cfg
         logger.info(
             "engine: %s step %s, %d classes, ema_resolved=%s, buckets=%s "
-            "over %d-shard mesh",
+            "over %d-shard mesh, quantization=%s",
             engine.model_name, meta.get("step"), num_classes,
-            meta.get("ema_resolved"), engine.buckets, engine.shards)
+            meta.get("ema_resolved"), engine.buckets, engine.shards,
+            engine.quantization)
         return engine
 
     # --- forward ----------------------------------------------------------
@@ -182,9 +227,19 @@ class InferenceEngine:
             f"(serve.max_batch_size)")
 
     def _make_forward(self) -> Callable:
+        from pytorchvideo_accelerate_tpu.serving.quantize import (
+            dequantize_tree,
+        )
+
         mesh, norm, model = self.mesh, self._device_normalize, self.model
+        quantized = self.quantization == "int8"
+        compute_dtype = self._compute_dtype
 
         def forward(params, batch_stats, batch):
+            if quantized:
+                # in-graph dequant: the HBM-resident tree stays int8 and
+                # XLA fuses q*scale into each weight read (bf16 compute)
+                params = dequantize_tree(params, compute_dtype)
             batch = _constrain_batch(batch, mesh, leading_micro=False)
             batch = device_normalize_batch(batch, norm)
             logits = multiview_logits(

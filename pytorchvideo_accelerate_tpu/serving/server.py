@@ -430,7 +430,8 @@ def build_server(cfg) -> InferenceServer:
     stats = ServingStats(window=s.stats_window,
                          latency_buckets=latency_buckets)
     engine = InferenceEngine.from_artifact(
-        s.checkpoint, max_batch_size=s.max_batch_size, stats=stats)
+        s.checkpoint, max_batch_size=s.max_batch_size, stats=stats,
+        quantization=s.quantization if s.quantization != "off" else None)
     spec = None
     if engine.artifact_config is not None:
         # pre-compile every bucket for the training run's clip geometry so

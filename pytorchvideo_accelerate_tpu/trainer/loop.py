@@ -652,7 +652,9 @@ class Trainer:
         """Write the params-only (EMA-resolved) serving artifact for the
         CURRENT in-memory state — the checkpoint-to-endpoint handoff
         (trainer/checkpoint.export_inference; serve it with
-        `pva-tpu-serve --serve.checkpoint PATH`)."""
+        `pva-tpu-serve --serve.checkpoint PATH`). With
+        `--serve.quantization int8` the artifact is baked int8 at export
+        (per-channel absmax; docs/SERVING.md § quantization)."""
         from pytorchvideo_accelerate_tpu.trainer.checkpoint import (
             export_inference,
         )
@@ -661,6 +663,7 @@ class Trainer:
             path, self.state, config=self.cfg,
             meta={"num_classes": self.num_classes,
                   "model": self.cfg.model.name},
+            quantization=self.cfg.serve.quantization,
         )
 
     def close(self) -> None:

@@ -32,6 +32,14 @@ env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python -m pytorchvideo_accelerate_tpu.analysis.graphcheck
 
+# fused-kernel parity gate (docs/KERNELS.md): pva-tpu-kbench --smoke
+# asserts every fused Pallas/folded kernel matches its XLA reference
+# (benched shape + interpret mode) before any speedup is believed;
+# exit 1 on a parity violation
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m pytorchvideo_accelerate_tpu.ops.kbench --smoke
+
 rc=0
 env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

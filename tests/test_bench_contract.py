@@ -155,6 +155,13 @@ def test_finalize_line_fits_driver_capture():
         "swap_blackout_ms": 12.345, "fleet_shed_frac": 0.0123,
         "trace_sampled": 1234, "trace_overhead_frac": 0.01234,
         "fleet_error": "no trustworthy device numbers " + "w" * 200,
+        "kbench_platform": "cpu", "kbench_parity_ok": True,
+        "kbench_best": "dw_x3d_res3:118.167x",
+        "kbench_dw_x3d_res3_speedup": 118.167,
+        "kbench_pw_x3d_res3_speedup": 1.272,
+        "kbench_conv133_sf_res4_speedup": 0.95,
+        "kbench_conv311_sf_res4_speedup": 1.169,
+        "kbench_error": "kernel parity violation " + "k" * 120,
         "trainer_error": "Traceback (most recent call last):\n" + "e" * 3000,
         "error": "watchdog fired: " + "y" * 3000,
         "probe_attempts": [
@@ -256,6 +263,30 @@ def test_finalize_multichip_keys_ride_the_headline():
         user_smoke=False)
     assert out["multichip_error"] == "cpu fallback"
     assert "multichip_cps_per_chip" not in out
+
+
+def test_finalize_kbench_keys_ride_the_headline():
+    """The kernel-microbench lane's per-kernel speedup keys (the numbers
+    pva-tpu-perfdiff attributes wins with), platform label, and parity
+    verdict plumb through finalize; raw millisecond timings never do
+    (they live in bench_partial.json only — the device-number refusal
+    rule applied to kernels), and a failed/parity-broken lane headlines
+    kbench_error like the multichip/fleet refusals."""
+    extras = {"kbench_platform": "cpu", "kbench_parity_ok": True,
+              "kbench_best": "dw_x3d_res3:118.167x",
+              "kbench_dw_x3d_res3_speedup": 118.167,
+              "kbench_pw_x3d_res3_speedup": 1.272,
+              "kbench": {"kernels": {"dw_x3d_res3": {"ms_ref": 1111.7}}}}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["kbench_platform"] == "cpu"
+    assert out["kbench_parity_ok"] is True
+    assert out["kbench_best"] == "dw_x3d_res3:118.167x"
+    assert out["kbench_dw_x3d_res3_speedup"] == 118.167
+    assert out["kbench_pw_x3d_res3_speedup"] == 1.272
+    assert "kbench" not in out  # the full record (with ms) stays off-line
+    out = bench.finalize(_model(), {"kbench_error": "kernel parity "
+                                    "violation"}, user_smoke=False)
+    assert out["kbench_error"].startswith("kernel parity")
 
 
 def test_finalize_fleet_lane_keys_ride_the_headline():

@@ -239,7 +239,9 @@ def test_full_tree_clean_gate(report):
     assert finding_count(report) == 0
     assert set(report["targets"]) == {"train_step",
                                       "train_step_guard_armed",
-                                      "eval_step", "serve_step"}
+                                      "eval_step", "serve_step",
+                                      "train_step_fused",
+                                      "serve_step_fused_pallas"}
 
 
 def test_donation_round_trip_on_tiny3d(report):
@@ -258,9 +260,26 @@ def test_donation_round_trip_on_tiny3d(report):
 
 
 def test_eval_and_serve_skip_donation_by_design(report):
-    for target in ("eval_step", "serve_step"):
+    for target in ("eval_step", "serve_step", "serve_step_fused_pallas"):
         s = report["targets"][target]["passes"]["donation"]["summary"]
         assert s.get("skipped") is True, (target, s)
+
+
+def test_fused_lowering_targets_stay_clean(report):
+    """The fused-kernel knob (ModelConfig.fused_kernels) must not cost
+    the graph its verified properties: donation still fully aliases
+    through the fused-"auto" train step, and the forced-pallas serve
+    forward's pallas_call eqns are COSTED by the registered FLOPs hooks
+    (an opaque zero would silently deflate mfu_analytic)."""
+    s = report["targets"]["train_step_fused"]["passes"]["donation"][
+        "summary"]
+    assert s["declared"] > 0 and s["aliased"] == s["declared"], s
+    assert s["undeclared_donatable"] == 0, s
+    f = report["targets"]["serve_step_fused_pallas"]["passes"]["flops"][
+        "summary"]
+    assert f["eqn_counts"]["pallas_call"] > 0, f
+    assert f["by_class"]["pallas"] > 0, f
+    assert f["unregistered_pallas"] == [], f
 
 
 def test_analytic_vs_costmodel_parity_where_capture_works(report):

@@ -1,0 +1,390 @@
+"""Fused conv/norm/act kernel tier (ops/pallas_fused.py + model wiring).
+
+Named `test_zkernels` ON PURPOSE: the tier-1 suite is timeout-bound and
+runs alphabetically, so the kernel additions sort late — a slow run
+kills these, never the pre-existing suite (the test_zserving
+convention). Everything here is tiny-shape CPU work; the real-shape
+microbenches live in `pva-tpu-kbench` (scripts/analyze.sh runs its
+--smoke parity gate out of band).
+
+Contracts locked here:
+- every fused op matches its unfused XLA reference — both lowerings
+  (folded-XLA and interpret-mode Pallas), forward AND gradients;
+- `model.fused_kernels` is a pure lowering knob: identical param trees,
+  eval/train parity (batch_stats updates included) on the same
+  variables;
+- the fused train step holds `train_recompiles == 0` after warmup,
+  guard-disarmed AND guard-armed (the RecompileGuard contract bench
+  --smoke asserts);
+- `pallas_call` eqns are costed by the registered-FLOPs hooks and an
+  unregistered kernel is a graphcheck finding (gc_flops satellite).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pytorchvideo_accelerate_tpu.ops.kbench_refs import (
+    ref_conv_bn_act,
+    ref_dw_bn_act,
+    ref_pw_bn_act,
+)
+from pytorchvideo_accelerate_tpu.ops.pallas_fused import (
+    fused_conv3d_bn_act,
+    fused_depthwise_bn_act,
+    fused_pointwise_bn_act,
+)
+
+
+def _affine(rng, c):
+    gamma = rng.standard_normal(c).astype(np.float32) * 0.1 + 1.0
+    beta = rng.standard_normal(c).astype(np.float32) * 0.1
+    mean = rng.standard_normal(c).astype(np.float32) * 0.1
+    var = np.abs(rng.standard_normal(c)).astype(np.float32) + 1.0
+    scale = gamma / np.sqrt(var + 1e-5)
+    return jnp.asarray(scale), jnp.asarray(beta - mean * scale)
+
+
+def _x(rng, shape):
+    return jnp.asarray(rng.standard_normal(shape), jnp.float32)
+
+
+@pytest.mark.parametrize("act", ["identity", "relu", "silu"])
+def test_fused_ops_match_references_xla_lowering(act):
+    """The folded-XLA lowering (what mode='auto' runs off-TPU) must equal
+    the unfused conv->affine->act chain for all three op families."""
+    rng = np.random.default_rng(0)
+    x = _x(rng, (2, 5, 9, 11, 12))
+    s, b = _affine(rng, 16)
+    w = _x(rng, (1, 3, 3, 12, 16)) * 0.2
+    np.testing.assert_allclose(
+        np.asarray(fused_conv3d_bn_act(x, w, s, b, act=act, mode="xla")),
+        np.asarray(ref_conv_bn_act(x, w, s, b, act=act)),
+        rtol=2e-5, atol=2e-5)
+    wp = _x(rng, (1, 1, 1, 12, 16)) * 0.2
+    np.testing.assert_allclose(
+        np.asarray(fused_pointwise_bn_act(x, wp, s, b, act=act,
+                                          mode="xla")),
+        np.asarray(ref_pw_bn_act(x, wp, s, b, act=act)),
+        rtol=2e-5, atol=2e-5)
+    k = _x(rng, (3, 3, 3, 1, 12)) * 0.2
+    sd, bd = _affine(rng, 12)
+    np.testing.assert_allclose(
+        np.asarray(fused_depthwise_bn_act(x, k, sd, bd, act=act,
+                                          mode="xla")),
+        np.asarray(ref_dw_bn_act(x, k, sd, bd, act=act)),
+        rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("case", ["pw", "conv", "dw"])
+def test_fused_ops_match_references_pallas_interpret(case):
+    """Interpret-mode Pallas (the identical kernel code the TPU compiles)
+    must match the XLA reference on the CPU harness."""
+    rng = np.random.default_rng(1)
+    x = _x(rng, (2, 4, 7, 9, 8))
+    if case == "pw":
+        w = _x(rng, (1, 1, 1, 8, 12)) * 0.2
+        s, b = _affine(rng, 12)
+        got = fused_pointwise_bn_act(x, w, s, b, act="relu", mode="pallas")
+        want = ref_pw_bn_act(x, w, s, b, act="relu")
+    elif case == "conv":
+        w = _x(rng, (3, 1, 1, 8, 12)) * 0.2
+        s, b = _affine(rng, 12)
+        got = fused_conv3d_bn_act(x, w, s, b, act="relu", mode="pallas")
+        want = ref_conv_bn_act(x, w, s, b, act="relu")
+    else:
+        k = _x(rng, (3, 3, 3, 1, 8)) * 0.2
+        s, b = _affine(rng, 8)
+        got = fused_depthwise_bn_act(x, k, s, b, act="silu", mode="pallas")
+        want = ref_dw_bn_act(x, k, s, b, act="silu")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("mode", ["xla", "pallas"])
+def test_fused_conv_gradients_match_reference(mode):
+    """custom_vjp backward (pallas) and plain autodiff (xla) must equal
+    jax.grad of the unfused reference — all four operands."""
+    rng = np.random.default_rng(2)
+    x = _x(rng, (1, 4, 6, 6, 8))
+    w = _x(rng, (1, 3, 3, 8, 10)) * 0.2
+    s, b = _affine(rng, 10)
+
+    def loss(fn):
+        return lambda x, w, s, b: jnp.sum(fn(x, w, s, b) ** 2)
+
+    gp = jax.grad(loss(lambda x, w, s, b: fused_conv3d_bn_act(
+        x, w, s, b, act="silu", mode=mode)), (0, 1, 2, 3))(x, w, s, b)
+    gr = jax.grad(loss(lambda x, w, s, b: ref_conv_bn_act(
+        x, w, s, b, act="silu")), (0, 1, 2, 3))(x, w, s, b)
+    for a, r in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fused_depthwise_and_pointwise_gradients_match():
+    rng = np.random.default_rng(3)
+    x = _x(rng, (1, 4, 6, 6, 8))
+    k = _x(rng, (3, 3, 3, 1, 8)) * 0.2
+    s, b = _affine(rng, 8)
+
+    def loss(fn):
+        return lambda *a: jnp.sum(fn(*a) ** 2)
+
+    gp = jax.grad(loss(lambda x, k, s, b: fused_depthwise_bn_act(
+        x, k, s, b, act="relu", mode="pallas")), (0, 1, 2, 3))(x, k, s, b)
+    gr = jax.grad(loss(lambda x, k, s, b: ref_dw_bn_act(
+        x, k, s, b, act="relu")), (0, 1, 2, 3))(x, k, s, b)
+    for a, r in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+    w = _x(rng, (1, 1, 1, 8, 10)) * 0.2
+    s, b = _affine(rng, 10)
+    gp = jax.grad(loss(lambda x, w, s, b: fused_pointwise_bn_act(
+        x, w, s, b, act="silu", mode="pallas")), (0, 1, 2, 3))(x, w, s, b)
+    gr = jax.grad(loss(lambda x, w, s, b: ref_pw_bn_act(
+        x, w, s, b, act="silu")), (0, 1, 2, 3))(x, w, s, b)
+    for a, r in zip(gp, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(r),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_x3d_fused_knob_is_pure_lowering():
+    """fused on/off: identical param trees, same-variables eval/train
+    parity (running-stat updates included), matching grads."""
+    from pytorchvideo_accelerate_tpu.models.x3d import X3D
+
+    rng = np.random.default_rng(4)
+    x = _x(rng, (2, 4, 16, 16, 3))
+    kw = dict(num_classes=5, depths=(1, 1), stem_features=8,
+              stage_features=(8, 16), head_features=32, dropout_rate=0.0)
+    m_off = X3D(fused="off", **kw)
+    m_xla = X3D(fused="xla", **kw)
+    m_pal = X3D(fused="pallas", **kw)
+    v = m_off.init(jax.random.key(0), x)
+    assert (jax.tree.structure(v)
+            == jax.tree.structure(m_xla.init(jax.random.key(0), x)))
+
+    a = np.asarray(m_off.apply(v, x))
+    np.testing.assert_allclose(a, np.asarray(m_xla.apply(v, x)),
+                               rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(a, np.asarray(m_pal.apply(v, x)),
+                               rtol=1e-4, atol=1e-4)
+
+    out0, mut0 = m_off.apply(v, x, train=True, mutable=["batch_stats"],
+                             rngs={"dropout": jax.random.key(1)})
+    out1, mut1 = m_xla.apply(v, x, train=True, mutable=["batch_stats"],
+                             rngs={"dropout": jax.random.key(1)})
+    np.testing.assert_allclose(np.asarray(out0), np.asarray(out1),
+                               rtol=1e-3, atol=1e-3)
+    for l0, l1 in zip(jax.tree.leaves(mut0), jax.tree.leaves(mut1)):
+        np.testing.assert_allclose(np.asarray(l0), np.asarray(l1),
+                                   rtol=1e-4, atol=1e-4)
+
+    def loss(vv, m):
+        out = m.apply(vv, x, train=True, mutable=["batch_stats"],
+                      rngs={"dropout": jax.random.key(1)})[0]
+        return jnp.sum(out ** 2)
+
+    for l0, l1 in zip(jax.tree.leaves(jax.grad(loss)(v, m_off)),
+                      jax.tree.leaves(jax.grad(loss)(v, m_xla))):
+        np.testing.assert_allclose(np.asarray(l0), np.asarray(l1),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_csn_and_r2plus1d_fused_knob_is_pure_lowering():
+    """Every conv family that wires ConvBNAct honors the knob — a family
+    that silently ignored `fused_kernels` would let users believe the
+    kernel tier is active (the registry passes it to csn/r2plus1d too)."""
+    from pytorchvideo_accelerate_tpu.models.csn import CSN
+    from pytorchvideo_accelerate_tpu.models.r2plus1d import R2Plus1D
+
+    rng = np.random.default_rng(10)
+    x = _x(rng, (1, 4, 16, 16, 3))
+    for cls, kw in ((CSN, dict(num_classes=4, depths=(1, 1),
+                               stem_features=8, dropout_rate=0.0)),
+                    (R2Plus1D, dict(num_classes=4, depths=(1, 1),
+                                    stem_features=8, dropout_rate=0.0))):
+        m_off = cls(fused="off", **kw)
+        m_on = cls(fused="xla", **kw)
+        v = m_off.init(jax.random.key(0), x)
+        assert (jax.tree.structure(v)
+                == jax.tree.structure(m_on.init(jax.random.key(0), x)))
+        np.testing.assert_allclose(np.asarray(m_off.apply(v, x)),
+                                   np.asarray(m_on.apply(v, x)),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_fused_matches_unfused_under_bf16_policy():
+    """bf16 compute (the production policy): the fused path's f32
+    accumulation + folded affine must track the unfused conv+BN+act
+    chain — both round once to bf16 at the end, so worst case is an ulp
+    apart (the test_depthwise bf16 convention)."""
+    from pytorchvideo_accelerate_tpu.models.common import ConvBNAct
+
+    rng = np.random.default_rng(9)
+    x = _x(rng, (2, 4, 8, 8, 16))
+    m_off = ConvBNAct(16, kernel=(1, 3, 3), fused="off",
+                      dtype=jnp.bfloat16)
+    m_on = ConvBNAct(16, kernel=(1, 3, 3), fused="xla",
+                     dtype=jnp.bfloat16)
+    v = m_off.init(jax.random.key(0), x)
+    a = np.asarray(m_off.apply(v, x), np.float32)
+    b = np.asarray(m_on.apply(v, x), np.float32)
+    np.testing.assert_allclose(a, b, rtol=2e-2, atol=2e-2)
+    assert np.mean(a == b) > 0.9  # overwhelmingly identical after rounding
+
+
+def test_fused_falls_back_on_strided_and_foreign_act_sites():
+    """Strided ConvBNAct sites and unrecognized activations must keep the
+    unfused path (same function) rather than silently change geometry."""
+    from pytorchvideo_accelerate_tpu.models.common import ConvBNAct
+
+    rng = np.random.default_rng(5)
+    x = _x(rng, (1, 4, 8, 8, 6))
+    for kwargs in (dict(stride=(1, 2, 2)),        # strided -> fallback
+                   dict(act=jnp.tanh)):           # foreign act -> fallback
+        m_off = ConvBNAct(8, kernel=(1, 3, 3), fused="off", **kwargs)
+        m_on = ConvBNAct(8, kernel=(1, 3, 3), fused="auto", **kwargs)
+        v = m_off.init(jax.random.key(0), x)
+        assert (jax.tree.structure(v)
+                == jax.tree.structure(m_on.init(jax.random.key(0), x)))
+        np.testing.assert_array_equal(np.asarray(m_off.apply(v, x)),
+                                      np.asarray(m_on.apply(v, x)))
+
+
+def test_fused_train_step_zero_recompiles_guarded_and_not():
+    """RecompileGuard contract for the fused-kernel train step: after the
+    first (legitimate) compile the jit cache must not grow across steps
+    with distinct batches — guard-disarmed AND guard-armed variants."""
+    from pytorchvideo_accelerate_tpu.analysis.recompile_guard import (
+        RecompileGuard,
+    )
+    from pytorchvideo_accelerate_tpu.trainer.steps import make_train_step
+    from pytorchvideo_accelerate_tpu.utils.bench_setup import (
+        build_step_setup,
+    )
+
+    setup = build_step_setup(
+        "tiny3d", frames=4, crop=16, batch_per_chip=1, num_classes=4,
+        overrides={"fused_kernels": "auto"})
+    for step_fn in (
+            setup.step,
+            make_train_step(setup.model, setup.tx, setup.mesh,
+                            guard_skip=True, health_metrics=True)):
+        # the step donates its state arg — each variant gets a fresh copy
+        state = jax.tree.map(
+            lambda a: a.copy() if isinstance(a, jax.Array) else a,
+            setup.state)
+        state, _ = step_fn(state, setup.device_batch(0), jax.random.key(0))
+        guard = RecompileGuard(step_fn)
+        guard.arm()
+        for i in range(1, 3):
+            state, metrics = step_fn(state, setup.device_batch(i),
+                                     jax.random.key(i))
+        assert np.isfinite(float(np.asarray(metrics["loss"])))
+        if guard.supported:
+            assert guard.sample() == 0
+
+
+def test_pallas_flops_hooks_cost_fused_kernels():
+    """gc_flops satellite: fused pallas_call eqns are costed (fwd and the
+    custom_vjp bwd kernels) and an unregistered kernel is a finding."""
+    from jax.experimental import pallas as pl
+
+    from pytorchvideo_accelerate_tpu.analysis.gc_flops import (
+        check_flops,
+        jaxpr_flops,
+    )
+
+    x = jnp.ones((1, 4, 8, 8, 8))
+    k = jnp.ones((3, 3, 3, 1, 8))
+    s, b = jnp.ones((8,)), jnp.zeros((8,))
+    cj = jax.make_jaxpr(lambda x, k, s, b: fused_depthwise_bn_act(
+        x, k, s, b, act="silu", mode="pallas"))(x, k, s, b)
+    res = jaxpr_flops(cj)
+    assert res["eqn_counts"]["pallas_call"] == 1
+    # exact tap arithmetic: 2 * out_elems * taps + epilogue
+    out_elems = 1 * 4 * 8 * 8 * 8
+    assert res["by_class"]["pallas"] == 2.0 * out_elems * 27 + 2.0 * out_elems
+    assert res["unregistered_pallas"] == []
+    findings, _ = check_flops(cj, costmodel_flops=None)
+    assert not findings
+
+    # backward kernels are registered too — a grad graph stays clean
+    g = jax.make_jaxpr(jax.grad(lambda x: jnp.sum(fused_depthwise_bn_act(
+        x, k, s, b, act="silu", mode="pallas"))))(x)
+    gres = jaxpr_flops(g)
+    assert gres["unregistered_pallas"] == []
+    assert gres["by_class"]["pallas"] > res["by_class"]["pallas"]
+
+    # an unregistered kernel must become a finding, not a silent zero
+    def _zkernels_opaque(x_ref, o_ref):
+        o_ref[:] = x_ref[:] * 2.0
+
+    oj = jax.make_jaxpr(lambda x: pl.pallas_call(
+        _zkernels_opaque,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        interpret=True)(x))(jnp.ones((8, 128)))
+    findings, summary = check_flops(oj, costmodel_flops=None)
+    assert summary["unregistered_pallas"] == ["_zkernels_opaque"]
+    assert len(findings) == 1 and "registered FLOPs hook" in \
+        findings[0]["message"]
+
+
+def test_kbench_cases_and_headline_keys():
+    """The microbench lane's case set and headline-key contract (bench.py
+    finalize() passes `kbench_*` through; names must stay stable for
+    pva-tpu-perfdiff attribution)."""
+    from pytorchvideo_accelerate_tpu.ops.kbench import (
+        build_cases,
+        headline_keys,
+    )
+
+    cases = build_cases(smoke=True)
+    names = [c.name for c in cases]
+    assert names == ["dw_x3d_res3", "pw_x3d_res3", "conv133_sf_res4",
+                     "conv311_sf_res4"]
+    for c in cases:
+        assert c.attribution and len(c.args) == 4 and len(c.small_args) == 4
+    record = {
+        "platform": "cpu", "parity_ok": True,
+        "best_kernel": "dw_x3d_res3", "best_speedup": 23.0,
+        "kernels": {n: {"speedup": 2.0} for n in names},
+    }
+    keys = headline_keys(record)
+    assert keys["kbench_platform"] == "cpu"
+    assert keys["kbench_parity_ok"] is True
+    assert keys["kbench_best"] == "dw_x3d_res3:23.0x"
+    for n in names:
+        assert keys[f"kbench_{n}_speedup"] == 2.0
+    # the headline never carries raw millisecond timings (refusal rule)
+    assert not any("ms" in k for k in keys)
+
+
+def test_even_kernel_and_mode_validation():
+    """Even-tap dense kernels fall back to the XLA lowering under
+    mode='pallas' (the halo kernel hard-codes odd SAME geometry), and an
+    unknown mode fails loudly."""
+    rng = np.random.default_rng(6)
+    x = _x(rng, (1, 4, 8, 8, 4))
+    w = _x(rng, (2, 3, 3, 4, 6)) * 0.2
+    s, b = _affine(rng, 6)
+    got = fused_conv3d_bn_act(x, w, s, b, act="relu", mode="pallas")
+    want = lax.conv_general_dilated(
+        x, w * s, (1, 1, 1), [(k // 2, k // 2) for k in w.shape[:3]],
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC")) + b
+    np.testing.assert_allclose(np.asarray(got),
+                               np.maximum(np.asarray(want), 0.0),
+                               rtol=2e-5, atol=2e-5)
+    with pytest.raises(ValueError, match="auto|pallas|xla"):
+        fused_conv3d_bn_act(x, w, s, b, act="relu", mode="bogus")
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+
+    with pytest.raises(ValueError, match="fused_kernels"):
+        create_model(ModelConfig(name="tiny3d", num_classes=2,
+                                 fused_kernels="bogus"))
