@@ -1301,6 +1301,14 @@ def main():
                     help="host input-pipeline microbench (decode vs cache vs "
                          "loader clips/sec; CPU-real numbers regardless of "
                          "device-timing trustworthiness); --no-data skips")
+    ap.add_argument("--dataplane", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="DATA_PLANE lane: local loader vs N remote decode-"
+                         "worker processes on the same source/seed; "
+                         "headlines dataplane_cps / "
+                         "dataplane_input_wait_frac / dataplane_workers, "
+                         "parity-gated byte-identical (--no-dataplane "
+                         "skips)")
     ap.add_argument("--serve-smoke", dest="serve_smoke",
                     action=argparse.BooleanOptionalAction, default=True,
                     help="serving-lane smoke: engine + micro-batcher under "
@@ -1648,6 +1656,69 @@ def main():
             dp["feed_projection"] = feed_projection(dp)
         flush_partial()
 
+    if args.dataplane:
+        # DATA_PLANE lane (dataplane/bench.py): local loader vs N remote
+        # decode workers — host-CPU-real numbers in the bench_data
+        # tradition (trustworthy on any box, never device claims), run in
+        # the parent but bounded so a wedged worker process can't break
+        # the one-JSON-line contract. A DAEMON thread, not an executor:
+        # concurrent.futures' atexit hook joins non-daemon workers, so an
+        # abandoned-but-wedged lane would block interpreter exit on any
+        # non-os._exit path (a failed smoke assert) and lose the round to
+        # the driver's kill. The refusal rule mirrors the fleet lane: a
+        # failed or parity-broken lane headlines dataplane_error INSTEAD
+        # of the perf keys.
+        import threading as _dp_threading
+
+        from pytorchvideo_accelerate_tpu.dataplane.bench import (
+            run_dataplane_bench,
+        )
+
+        _dp_out: dict = {}
+
+        def _dp_lane():
+            try:
+                # deadline_s < the join timeout: the lane self-bounds (it
+                # stops spawning worker processes between trials) BEFORE
+                # this thread is abandoned — nothing can cancel it from
+                # outside, and an abandoned lane would keep spawning
+                _dp_out["result"] = run_dataplane_bench(
+                    smoke=args.smoke, workers=2, deadline_s=480, log=log)
+            except Exception as e:  # noqa: BLE001 - lane isolation
+                _dp_out["result"] = {
+                    "error": f"{type(e).__name__}: {e}"}
+
+        _dp_thread = _dp_threading.Thread(target=_dp_lane, daemon=True,
+                                          name="bench-dataplane")
+        _dp_thread.start()
+        _dp_thread.join(timeout=600)
+        dpl = _dp_out.get("result") or {"error": "timeout after 600s"}
+        extras["dataplane"] = dpl
+        if "error" in dpl:
+            log(f"[dataplane] lane failed: {dpl['error']}")
+            # an abandoned lane must not leave decode-worker PROCESSES
+            # burning CPU under the fleet/serving lanes measured next —
+            # the exact cross-lane distortion this lane documents
+            from pytorchvideo_accelerate_tpu.dataplane.feed import (
+                reap_spawned_workers,
+            )
+
+            reaped = reap_spawned_workers()
+            if reaped:
+                log(f"[dataplane] reaped {reaped} orphaned worker "
+                    "process(es) after lane failure")
+            extras["dataplane_error"] = str(dpl["error"])[:120]
+        elif not dpl.get("parity"):
+            extras["dataplane_error"] = (
+                "remote batch stream diverged from the local loader "
+                "(see bench_partial.json dataplane record)")
+        else:
+            extras["dataplane_cps"] = dpl["dataplane_cps"]
+            extras["dataplane_input_wait_frac"] = dpl[
+                "dataplane_input_wait_frac"]
+            extras["dataplane_workers"] = dpl["dataplane_workers"]
+        flush_partial()
+
     if args.fleet:
         # SERVE_FLEET lane: child-isolated like the model benches (a
         # wedged warmup compile loses the lane, not the round); smoke mode
@@ -1868,6 +1939,31 @@ def main():
         assert overhead is not None and overhead < 0.02, (
             f"tracing overhead {overhead} is not under 2% of run wall "
             f"time: {fl}")
+    if user_smoke and args.dataplane:
+        # DATA_PLANE acceptance (docs/INPUT_PIPELINE.md § disaggregated
+        # data plane): N>=2 remote decode workers produced a byte-
+        # identical batch stream to the local loader on the same source/
+        # seed, and the remote input-wait fraction is no worse than the
+        # local loader's on this host — decode scale-out must never cost
+        # the trainer wait time, or the whole lever is fake
+        dpl = extras.get("dataplane", {})
+        assert "dataplane_error" not in extras, (
+            f"DATA_PLANE lane failed: {extras['dataplane_error']}: {dpl}")
+        assert dpl.get("parity") is True, (
+            f"remote batch stream diverged from the local loader: {dpl}")
+        assert extras.get("dataplane_workers", 0) >= 2, (
+            f"dataplane lane ran <2 remote workers: {dpl}")
+        for key in ("dataplane_cps", "dataplane_input_wait_frac"):
+            assert extras.get(key) is not None, (
+                f"dataplane smoke ran but produced no {key!r}: {dpl}")
+        from pytorchvideo_accelerate_tpu.dataplane.bench import (
+            WAIT_FRAC_TOLERANCE,
+        )
+
+        assert (extras["dataplane_input_wait_frac"]
+                <= dpl["local_input_wait_frac"] + WAIT_FRAC_TOLERANCE), (
+            f"remote input_wait_frac {extras['dataplane_input_wait_frac']} "
+            f"worse than local {dpl['local_input_wait_frac']}: {dpl}")
     extras["headline"] = headline  # full record keeps the compact line too
     flush_partial()
     print(json.dumps(headline))
@@ -2007,6 +2103,11 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     fleet_perf = ("serve_rps", "serve_p99_ms_under_load",
                   "swap_blackout_ms", "fleet_shed_frac",
                   "trace_sampled", "trace_overhead_frac")
+    # DATA_PLANE lane perf keys under the same refusal rule: a
+    # dataplane_error (failed lane or broken byte parity) headlines
+    # INSTEAD of the numbers
+    dataplane_perf = ("dataplane_cps", "dataplane_input_wait_frac",
+                      "dataplane_workers")
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "mfu_analytic", "mfu_source", "mfu_peak_source",
                 "trainer_input_wait_frac", "obs_step_s",
@@ -2015,15 +2116,18 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                 "tsan_findings", "chaos_findings", "graphcheck_findings",
                 "mesh_parity",
                 "mesh_ckpt_portable", "multichip_train_recompiles",
-                *mc_perf, *fleet_perf):
+                *mc_perf, *fleet_perf, *dataplane_perf):
         if key in extras and not (
                 (key in mc_perf and "multichip_error" in extras)
-                or (key in fleet_perf and "fleet_error" in extras)):
+                or (key in fleet_perf and "fleet_error" in extras)
+                or (key in dataplane_perf and "dataplane_error" in extras)):
             out[key] = extras[key]
     if "multichip_error" in extras:
         out["multichip_error"] = str(extras["multichip_error"])[:120]
     if "fleet_error" in extras:
         out["fleet_error"] = str(extras["fleet_error"])[:120]
+    if "dataplane_error" in extras:
+        out["dataplane_error"] = str(extras["dataplane_error"])[:120]
     # kernel-microbench keys (pva-tpu-kbench): dimensionless same-backend
     # speedup ratios + platform label (never raw ms — those live in
     # bench_partial.json); a failed or parity-broken lane headlined
@@ -2067,6 +2171,16 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                         "(unreachable tunnel or failed bench; see "
                         "bench_partial.json + .probe_log.jsonl); CPU/smoke "
                         "values are not device numbers")
+    if out.get("suspect"):
+        # refusal rule for the flagship's own device-shaped perf keys: a
+        # suspect round was headlining a literal `"tflops_per_sec": 0.0`
+        # (BENCH_r05) — a zero pva-tpu-perfdiff could one day diff against
+        # a real device number. Shed them like the lane perf keys above;
+        # `value` stays (its metric string carries the smoke tag and the
+        # suspect flag rides beside it, and perfdiff refuses suspect
+        # rounds wholesale).
+        out.pop("tflops_per_sec", None)
+        out.pop("step_ms_blocked", None)
     # hard size guarantee: shed optional detail one key at a time before
     # ever exceeding the driver's capture window; the per-model map and
     # the truncations are LAST resorts (dropping a lane's optional extras
@@ -2078,6 +2192,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
               "fleet_error", "fleet_shed_frac", "swap_blackout_ms",
               "serve_p99_ms_under_load", "serve_rps",
+              "dataplane_error", "dataplane_workers",
+              "dataplane_input_wait_frac", "dataplane_cps",
               "kbench_conv311_sf_res4_speedup",
               "kbench_conv133_sf_res4_speedup",
               "kbench_pw_x3d_res3_speedup", "kbench_platform",

@@ -43,16 +43,25 @@ from pytorchvideo_accelerate_tpu.analysis.core import (
 from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HOT_MODULES
 
 # the hot modules PLUS the fleet tier's handoff surfaces (scheduler queue,
-# router dispatch, replica pool worker threads)
+# router dispatch, replica pool worker threads) PLUS the disaggregated
+# data plane's socket handoffs (feed leases/reader threads, worker frames)
 TRACE_HANDOFF_MODULES: Tuple[str, ...] = HOT_MODULES + (
     "fleet/scheduler.py",
     "fleet/router.py",
     "fleet/pool.py",
     "fleet/loadgen.py",
+    "dataplane/feed.py",
+    "dataplane/worker.py",
 )
 
-# helper call tails that prove the module participates in propagation
-_HELPER_TAILS = ("capture", "attach", "activate")
+# helper call tails that prove the module participates in propagation.
+# current_traceparent/format_traceparent are the cross-PROCESS halves (a
+# traceparent shipped in a wire frame or HTTP header) and, being
+# module-level functions, reachable via bare from-imports — which is why
+# they live here; continue_trace is a Tracer METHOD only, so the
+# any-receiver dotted check in `check` is its sole (and sufficient) match.
+_HELPER_TAILS = ("capture", "attach", "activate",
+                 "current_traceparent", "format_traceparent")
 
 
 def _sync_aliases(tree: ast.AST, names: Tuple[str, ...]) -> Dict[str, str]:
@@ -174,6 +183,15 @@ class TracePropagationRule(Rule):
                 if head in trace_mods and tail in _HELPER_TAILS:
                     propagates = True
                     break
+                # the cross-process helpers have distinctive names and are
+                # typically called on a Tracer INSTANCE
+                # (`get_tracer().continue_trace(...)`), so any receiver
+                # counts — unlike the generic capture/attach tails, which
+                # stay module-scoped to avoid laundering by coincidence
+                if tail in ("continue_trace", "current_traceparent",
+                            "format_traceparent"):
+                    propagates = True
+                    break
         if propagates:
             return
 
@@ -200,6 +218,14 @@ class TracePropagationRule(Rule):
             if _factory_call_kind(node, fn_aliases,
                                   mod_aliases) == "make_thread":
                 sites.append((node, "make_thread(...) starts a worker"))
+                continue
+            dn = call_name(node)
+            if dn == "send_frame" or dn.endswith(".send_frame"):
+                # the data plane's cross-PROCESS put site: a wire frame
+                # (dataplane/wire.py) leaving this process without a
+                # traceparent truncates the trace at the process boundary
+                sites.append(
+                    (node, "`send_frame(...)` crosses a process boundary"))
                 continue
             f = node.func
             if (isinstance(f, ast.Attribute)

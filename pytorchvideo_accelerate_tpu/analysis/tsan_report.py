@@ -179,6 +179,62 @@ def _stress_prefetcher(watchdog, log: Callable[[str], None]) -> None:
         loader.close()
 
 
+def _stress_dataplane(log: Callable[[str], None]) -> None:
+    """Disaggregated data-plane churn: a RemoteClipFeed with two IN-THREAD
+    DecodeWorkers over loopback sockets — the credit/ack machinery (reader
+    threads moving batches into the reorder buffer, the consumer releasing
+    window slots, `_pump_locked` leasing from three call sites) under real
+    interleavings, plus the two hazard paths: a mid-flight generator abort
+    (stale-generation frames racing the reset) and a worker death mid-epoch
+    (the re-lease path racing live receipts)."""
+    import socket
+
+    from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader
+    from pytorchvideo_accelerate_tpu.dataplane import spec as dpspec
+    from pytorchvideo_accelerate_tpu.dataplane.feed import RemoteClipFeed
+    from pytorchvideo_accelerate_tpu.dataplane.worker import DecodeWorker
+
+    tspec = dict(num_frames=2, training=True, crop_size=16,
+                 min_short_side_scale=18, max_short_side_scale=22)
+    spec = dpspec.synthetic_spec(tspec, num_videos=16, num_classes=4,
+                                 seed=3, raw_frames=4, raw_size=[24, 32])
+    loader = ClipLoader(dpspec.build_source(spec), global_batch_size=4,
+                        shuffle=True, num_workers=1, seed=3)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=30.0)
+    workers = []
+    for k in range(2):
+        s = socket.create_connection(feed.address)
+        t = make_thread(target=DecodeWorker(s, decode_threads=1).run,
+                        name=f"dataplane-worker-{k}", daemon=True)
+        t.start()
+        workers.append((t, s))
+    try:
+        feed.wait_for_workers(2, timeout=30.0)
+        n = sum(1 for batch, _ in feed.epoch_items(0, from_start=True)
+                if batch is not None)
+        # mid-flight abort: the finally's generation bump races frames the
+        # workers already have in flight
+        aborted = feed.epoch_items(1, from_start=True)
+        next(aborted)
+        aborted.close()
+        # worker death mid-epoch: close one worker's socket and drain —
+        # the reader's re-lease runs against the survivor's receipts
+        it = feed.epoch_items(2, from_start=True)
+        next(it)
+        workers[0][1].close()
+        rest = sum(1 for batch, _ in it if batch is not None)
+        stats = feed.stats()
+        log(f"[tsan] dataplane churn: {n} + {rest + 1} batches, "
+            f"{stats['releases']} re-leased, "
+            f"{stats['workers_lost']} worker lost")
+    finally:
+        feed.close()
+        loader.close()
+        for t, _s in workers:
+            t.join(timeout=10.0)
+
+
 def _stress_batcher(watchdog, log: Callable[[str], None]) -> None:
     """Concurrent submitters against one flush thread, snapshots racing the
     traffic, then a mid-flight close with requests still queued."""
@@ -438,6 +494,7 @@ def run_stress(smoke: bool = True,
                     _stress_fleet(log)
                     _stress_trackers(log)
                     _stress_prefetcher(wd, log)
+                    _stress_dataplane(log)
                 finally:
                     wd.stop()
             # drain the scenario collector the way the trainer would

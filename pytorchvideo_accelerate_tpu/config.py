@@ -83,6 +83,22 @@ class DataConfig:
     # from prefetch_batches: that hides DECODE latency on the host, this
     # hides TRANSFER latency onto the chip.
     device_prefetch_depth: int = 2
+    # DISAGGREGATED decode (dataplane/; docs/INPUT_PIPELINE.md): >0 spawns
+    # that many decode-worker PROCESSES (pva-tpu-dataworker) and the train
+    # loader's decode happens there — clip tensors stream back over a
+    # zero-copy wire protocol into the device-prefetch ring, byte-identical
+    # to local decode (epoch/shuffle/quarantine state stays trainer-owned;
+    # checkpoints and mid-epoch resume are unchanged). 0 = local decode.
+    # Additional workers (other hosts) may connect to dataplane_listen at
+    # any time and join mid-epoch.
+    dataplane_workers: int = 0
+    # per-worker in-flight lease bound; the trainer-side reorder buffer is
+    # bounded by credits x workers (credit-based back-pressure — a slow
+    # trainer idles workers, never balloons their memory)
+    dataplane_credits: int = 2
+    # host:port the feed listens on for workers (port 0 = ephemeral,
+    # logged at startup; bind a routable address for cross-host workers)
+    dataplane_listen: str = "127.0.0.1:0"
     crop_size: int = 256
     min_short_side_scale: int = 256
     max_short_side_scale: int = 320

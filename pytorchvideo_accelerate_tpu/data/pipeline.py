@@ -304,6 +304,49 @@ class SyntheticClipSource(ClipSource):
         return out
 
 
+def stack_samples(arrs: List[np.ndarray]) -> np.ndarray:
+    """np.stack via the native multithreaded gather-copy when available
+    (GIL-free batch assembly); numpy fallback otherwise. Module-level so
+    remote decode workers (dataplane/worker.py) assemble batches with the
+    EXACT code path the local loader uses — byte parity by construction."""
+    first = np.asarray(arrs[0])
+    if first.ndim == 0:
+        return np.stack(arrs)
+    from pytorchvideo_accelerate_tpu.native.ringbuf import gather_copy
+
+    out = np.empty((len(arrs), *first.shape), first.dtype)
+    gather_copy(out, arrs)
+    return out
+
+
+def assemble_batch(samples: List[Dict[str, np.ndarray]], pad_to: int,
+                   accum_steps: int = 1,
+                   local_batch_size: Optional[int] = None) -> dict:
+    """Stack per-sample dicts into one batch dict: padded + masked tail
+    (val only) below `pad_to`, reshaped to (accum, B_local, ...) when
+    `accum_steps > 1`. The single batch-assembly authority for the local
+    loader AND the remote decode workers."""
+    n = len(samples)
+    keys = samples[0].keys()
+    batch = {k: stack_samples([s[k] for s in samples]) for k in keys}
+    if n < pad_to:  # padded tail (val only): mask marks real samples
+        mask = np.zeros(pad_to, np.float32)
+        mask[:n] = 1.0
+        for k in list(batch):
+            pad_shape = (pad_to - n, *batch[k].shape[1:])
+            batch[k] = np.concatenate(
+                [batch[k], np.zeros(pad_shape, batch[k].dtype)]
+            )
+        batch["mask"] = mask
+    if accum_steps > 1:
+        lb = local_batch_size if local_batch_size else pad_to // accum_steps
+        batch = {
+            k: v.reshape(accum_steps, lb, *v.shape[1:])
+            for k, v in batch.items()
+        }
+    return batch
+
+
 @dataclass
 class LoaderState:
     """Checkpointable iterator position."""
@@ -421,36 +464,11 @@ class ClipLoader:
 
     @staticmethod
     def _stack(arrs: List[np.ndarray]) -> np.ndarray:
-        """np.stack via the native multithreaded gather-copy when available
-        (GIL-free batch assembly); numpy fallback otherwise."""
-        first = np.asarray(arrs[0])
-        if first.ndim == 0:
-            return np.stack(arrs)
-        from pytorchvideo_accelerate_tpu.native.ringbuf import gather_copy
-
-        out = np.empty((len(arrs), *first.shape), first.dtype)
-        gather_copy(out, arrs)
-        return out
+        return stack_samples(arrs)
 
     def _assemble(self, samples: List[Dict[str, np.ndarray]], pad_to: int) -> dict:
-        n = len(samples)
-        keys = samples[0].keys()
-        batch = {k: self._stack([s[k] for s in samples]) for k in keys}
-        if n < pad_to:  # padded tail (val only): mask marks real samples
-            mask = np.zeros(pad_to, np.float32)
-            mask[:n] = 1.0
-            for k in list(batch):
-                pad_shape = (pad_to - n, *batch[k].shape[1:])
-                batch[k] = np.concatenate(
-                    [batch[k], np.zeros(pad_shape, batch[k].dtype)]
-                )
-            batch["mask"] = mask
-        if self.accum_steps > 1:
-            batch = {
-                k: v.reshape(self.accum_steps, self.local_batch_size, *v.shape[1:])
-                for k, v in batch.items()
-            }
-        return batch
+        return assemble_batch(samples, pad_to, accum_steps=self.accum_steps,
+                              local_batch_size=self.local_batch_size)
 
     def epoch(self, epoch: Optional[int] = None,
               from_start: bool = False) -> Iterator[dict]:

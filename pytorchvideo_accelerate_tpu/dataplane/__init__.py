@@ -1,0 +1,21 @@
+"""Disaggregated data plane: decode-worker service + trainer-side feed.
+
+Decode and training no longer share one host's cores: `RemoteClipFeed`
+(feed.py) leases index spans to N `DecodeWorker` processes (worker.py,
+console script ``pva-tpu-dataworker``) which stream ready clip-tensor
+batches back over a length-prefixed zero-copy wire protocol (wire.py),
+byte-identical to the local loader's stream and bounded by credit-based
+back-pressure. See docs/INPUT_PIPELINE.md § disaggregated data plane.
+"""
+
+from pytorchvideo_accelerate_tpu.dataplane.feed import (  # noqa: F401
+    NoWorkersError,
+    RemoteClipFeed,
+    spawn_worker,
+)
+from pytorchvideo_accelerate_tpu.dataplane.wire import (  # noqa: F401
+    Frame,
+    WireError,
+    recv_frame,
+    send_frame,
+)

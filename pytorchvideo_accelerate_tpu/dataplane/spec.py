@@ -1,0 +1,97 @@
+"""Source specs: the JSON shape of "build my ClipSource over there".
+
+A decode worker is a separate process (later: a separate host) that must
+reconstruct the EXACT deterministic sample function the trainer's local
+loader would have used — same transform, same seed, same substitution
+streams — so a remote batch is byte-identical to a local one. The trainer
+serializes that recipe once into a spec dict, ships it in the handshake
+`config` frame (dataplane/wire.py), and `build_source` rebuilds it worker-
+side.
+
+Two source types today (the cache-backed source stays local — its memmap
+slices are already cheaper than the wire):
+
+- ``synthetic``: SyntheticClipSource kwargs verbatim (tests/bench/chaos);
+- ``video``: the manifest shipped as explicit `(path, label, name)` entries
+  — deterministic regardless of worker-side filesystem enumeration, and the
+  SAMPLER-level quarantine exclusion stays trainer-owned (leases carry
+  explicit indices; the worker never re-derives epoch geometry).
+
+The transform spec is `make_transform`'s kwargs plus `training` — all
+JSON-scalar, tuples tolerated as lists.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from pytorchvideo_accelerate_tpu.data.manifest import Manifest, VideoEntry
+from pytorchvideo_accelerate_tpu.data.pipeline import (
+    ClipSource,
+    SyntheticClipSource,
+    VideoClipSource,
+)
+from pytorchvideo_accelerate_tpu.data.transforms import make_transform
+
+
+def synthetic_spec(transform: dict, **source_kwargs) -> dict:
+    """Spec for a SyntheticClipSource; `transform` = make_transform kwargs
+    including `training`."""
+    return {"source": {"type": "synthetic", **source_kwargs},
+            "transform": dict(transform)}
+
+
+def video_spec(manifest: Manifest, transform: dict, *, clip_duration: float,
+               training: bool, seed: int, num_clips: int = 1,
+               decode_retries: int = 2,
+               retry_base_delay_s: float = 0.05) -> dict:
+    """Spec for a VideoClipSource over an explicit manifest."""
+    return {
+        "source": {
+            "type": "video",
+            "entries": [[e.path, int(e.label), e.label_name]
+                        for e in manifest.entries],
+            "class_names": list(manifest.class_names),
+            "clip_duration": float(clip_duration),
+            "training": bool(training),
+            "seed": int(seed),
+            "num_clips": int(num_clips),
+            "decode_retries": int(decode_retries),
+            "retry_base_delay_s": float(retry_base_delay_s),
+        },
+        "transform": dict(transform),
+    }
+
+
+def build_transform(tspec: dict):
+    kw = dict(tspec)
+    # JSON round-trips tuples as lists; make_transform wants sequences, so
+    # only mean/std need normalizing for equality-sensitive callers
+    for key in ("mean", "std"):
+        if key in kw:
+            kw[key] = tuple(kw[key])
+    return make_transform(**kw)
+
+
+def build_source(spec: dict,
+                 quarantine: Optional[object] = None) -> ClipSource:
+    """Reconstruct the ClipSource a spec describes. `quarantine` (any
+    object with `contains(path)`/`record(path, err)`) is threaded into the
+    video source — worker-side it is the report-back shim that lands
+    failures in the TRAINER's persisted sidecar (dataplane/worker.py)."""
+    src = dict(spec["source"])
+    kind = src.pop("type", None)
+    transform = build_transform(spec.get("transform", {}))
+    if kind == "synthetic":
+        if "raw_size" in src:
+            src["raw_size"] = tuple(src["raw_size"])
+        return SyntheticClipSource(transform, **src)
+    if kind == "video":
+        entries = [VideoEntry(str(p), int(label), str(name))
+                   for p, label, name in src.pop("entries")]
+        manifest = Manifest(entries=entries,
+                            class_names=list(src.pop("class_names")))
+        return VideoClipSource(manifest, transform,
+                               clip_duration=src.pop("clip_duration"),
+                               quarantine=quarantine, **src)
+    raise ValueError(f"unknown source spec type {kind!r}")

@@ -1,0 +1,203 @@
+"""Length-prefixed binary wire protocol for the disaggregated data plane.
+
+One frame carries one protocol message between a decode worker and the
+trainer-side `RemoteClipFeed` (dataplane/feed.py):
+
+    MAGIC "PVDP" | u32 header_len | header JSON | raw array payloads
+
+The header is small JSON — `kind` (hello/config/lease/batch/qreport/error/
+stop), a `meta` dict, an ordered `arrays` manifest of `{key, dtype, shape}`
+entries, and an optional W3C `traceparent` (the PR 10 tracer's HTTP hop
+format reused verbatim, so a batch's decode spans on the worker join the
+trainer's trace). The payload is the arrays' raw bytes, concatenated in
+manifest order.
+
+Zero-copy on purpose, both directions: `pack_frame` returns buffer views
+(`sendall` ships the array memory without a serialization pass — a 32f/256²
+bf16 batch is tens of MB, and a json/pickle hop would double the data
+plane's CPU bill), and `recv_frame` reads the whole payload into ONE
+bytearray and hands out `np.frombuffer` windows over it.
+
+Failure posture (the fuzz contract, tests/test_zdataplane.py): a garbage
+magic, an oversized or non-JSON header, a negative/overflowing shape, or a
+connection closed mid-frame all raise `WireError` — a clean, attributable
+error, never a hang and never a partially-built batch. `WireError` is a
+`ConnectionError` so socket-level handlers (the feed's reader threads, the
+worker loop) treat protocol corruption exactly like a dead peer: drop the
+connection, re-lease the span.
+
+Stdlib + numpy only: worker processes must import this without jax.
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import struct
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+import numpy as np
+
+MAGIC = b"PVDP"
+_LEN = struct.Struct("<I")
+# a header is a few hundred bytes of JSON; anything near this bound is
+# garbage or an attack, not a batch manifest
+MAX_HEADER_BYTES = 1 << 20
+# per-array and per-frame payload bound: rejects corrupt/hostile shape
+# manifests before a multi-GB allocation, while leaving room far above any
+# real clip batch (reference geometry is tens of MB)
+MAX_PAYLOAD_BYTES = 1 << 31
+
+
+class WireError(ConnectionError):
+    """Protocol-level corruption (bad magic/header/shape) or a peer lost
+    mid-frame. A ConnectionError on purpose: the recovery is the same as a
+    dead socket — drop the worker, re-lease its spans."""
+
+
+def parse_address(text: str) -> tuple:
+    """`HOST:PORT` -> (host, int(port)) with an error that names the
+    shape. ONE parser for both ends of the wire: the trainer's
+    `--data.dataplane_listen` and the worker's `--connect` must agree on
+    what an address looks like."""
+    host, sep, port = str(text).rpartition(":")
+    if not sep or not host or not port.isdigit():
+        raise ValueError(
+            f"address must be HOST:PORT (e.g. 127.0.0.1:0), got {text!r}")
+    return host, int(port)
+
+
+@dataclass
+class Frame:
+    """One decoded protocol message."""
+
+    kind: str
+    meta: dict = field(default_factory=dict)
+    arrays: Dict[str, np.ndarray] = field(default_factory=dict)
+    traceparent: Optional[str] = None
+
+
+def _np_dtype(name: str) -> np.dtype:
+    """dtype from its wire name; lazily registers ml_dtypes for the
+    extended families (bfloat16 host-cast batches)."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes  # noqa: F401 - registers bfloat16 et al.
+
+        return np.dtype(name)
+
+
+def pack_frame(kind: str, meta: Optional[dict] = None,
+               arrays: Optional[Dict[str, np.ndarray]] = None,
+               traceparent: Optional[str] = None) -> List[memoryview]:
+    """Encode one frame as a list of buffers ready for sequential
+    `sendall` — the array buffers are VIEWS of the caller's memory (the
+    zero-copy half of the contract; don't mutate them mid-send)."""
+    specs: List[dict] = []
+    payloads: List[np.ndarray] = []
+    for key, arr in (arrays or {}).items():
+        a = np.ascontiguousarray(arr)
+        specs.append({"key": str(key), "dtype": a.dtype.name,
+                      "shape": list(a.shape)})
+        payloads.append(a)
+    header: dict = {"kind": kind, "meta": meta or {}, "arrays": specs}
+    if traceparent:
+        header["traceparent"] = traceparent
+    hb = json.dumps(header, separators=(",", ":")).encode()
+    if len(hb) > MAX_HEADER_BYTES:
+        raise WireError(f"frame header too large ({len(hb)} bytes)")
+    parts = [memoryview(MAGIC + _LEN.pack(len(hb)) + hb)]
+    parts.extend(memoryview(a).cast("B") for a in payloads)
+    return parts
+
+
+def send_frame(sock: socket.socket, kind: str, meta: Optional[dict] = None,
+               arrays: Optional[Dict[str, np.ndarray]] = None,
+               traceparent: Optional[str] = None) -> None:
+    for part in pack_frame(kind, meta, arrays, traceparent):
+        sock.sendall(part)
+
+
+def _recv_exact(sock: socket.socket, n: int,
+                allow_eof: bool = False) -> Optional[bytearray]:
+    """Read exactly n bytes into one bytearray. A clean EOF at a frame
+    boundary (`allow_eof`) returns None; EOF mid-frame is corruption."""
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        k = sock.recv_into(view[got:], n - got)
+        if k == 0:
+            if got == 0 and allow_eof:
+                return None
+            raise WireError(f"peer closed mid-frame ({got}/{n} bytes)")
+        got += k
+    return buf
+
+
+def recv_frame(sock: socket.socket,
+               allow_eof: bool = True) -> Optional[Frame]:
+    """Read one frame; None on a clean EOF at a frame boundary. Raises
+    `WireError` on any protocol corruption, `socket.timeout` past the
+    socket's deadline (callers own the timeout policy — a truncated frame
+    from a live-but-silent peer must not hang forever)."""
+    head = _recv_exact(sock, len(MAGIC) + _LEN.size, allow_eof=allow_eof)
+    if head is None:
+        return None
+    if bytes(head[:4]) != MAGIC:
+        raise WireError(f"bad frame magic {bytes(head[:4])!r}")
+    (hlen,) = _LEN.unpack(head[4:])
+    if not 0 < hlen <= MAX_HEADER_BYTES:
+        raise WireError(f"implausible header length {hlen}")
+    try:
+        header = json.loads(bytes(_recv_exact(sock, hlen)))
+    except (ValueError, UnicodeDecodeError) as e:
+        raise WireError(f"unparseable frame header: {e}") from e
+    if not isinstance(header, dict) or not isinstance(header.get("kind"),
+                                                      str):
+        raise WireError("frame header missing 'kind'")
+    specs = header.get("arrays", [])
+    if not isinstance(specs, list):
+        raise WireError("frame header 'arrays' is not a list")
+    sizes: List[tuple] = []
+    total = 0
+    for spec in specs:
+        try:
+            dtype = _np_dtype(spec["dtype"])
+            shape = tuple(int(d) for d in spec["shape"])
+            key = str(spec["key"])
+        except (KeyError, TypeError, ValueError, OverflowError) as e:
+            raise WireError(f"bad array spec {spec!r}: {e}") from e
+        if dtype.kind == "O" or dtype.itemsize == 0:
+            # object/void dtypes can't carry raw wire bytes — and frombuffer
+            # on them raises far uglier things than a protocol error
+            raise WireError(f"non-plain dtype {dtype!r} on the wire")
+        if any(d < 0 or d > MAX_PAYLOAD_BYTES for d in shape):
+            # a huge dim beside a zero dim would pass the product bound
+            # (0 elements) and then overflow numpy's intp in reshape
+            raise WireError(f"implausible dimension in shape {shape}")
+        # pure-Python product on purpose: np.prod(dtype=int64) silently
+        # WRAPS on hostile dims like 2**32 x 2**32 (to 0, which would pass
+        # the bound and then blow up in reshape instead of raising here)
+        nbytes = dtype.itemsize
+        for d in shape:
+            nbytes *= d
+            if nbytes > MAX_PAYLOAD_BYTES:
+                raise WireError(
+                    f"implausible array size for shape {shape}")
+        sizes.append((key, dtype, shape, nbytes))
+        total += nbytes
+        if total > MAX_PAYLOAD_BYTES:
+            raise WireError(f"implausible frame payload ({total} bytes)")
+    payload = _recv_exact(sock, total) if total else bytearray()
+    view = memoryview(payload)
+    arrays: Dict[str, np.ndarray] = {}
+    off = 0
+    for key, dtype, shape, nbytes in sizes:
+        arrays[key] = np.frombuffer(
+            view[off:off + nbytes], dtype=dtype).reshape(shape)
+        off += nbytes
+    return Frame(kind=header["kind"], meta=dict(header.get("meta") or {}),
+                 arrays=arrays, traceparent=header.get("traceparent"))

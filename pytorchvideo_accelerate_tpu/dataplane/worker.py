@@ -1,0 +1,263 @@
+"""Decode worker: the horizontally-scalable half of the data plane.
+
+One worker process (console script ``pva-tpu-dataworker``) — or, for tests
+and the tsan stress scenario, one worker THREAD — connects to a trainer's
+`RemoteClipFeed` (dataplane/feed.py), receives the source spec in the
+handshake, and then serves index-span leases: decode + transform every
+leased clip through the exact `VideoClipSource.get()` path the local
+loader runs, assemble with the shared `data.pipeline.assemble_batch`, and
+stream the ready batch back over the length-prefixed wire protocol
+(dataplane/wire.py). Byte parity with the local loader is a consequence of
+sharing the sample function, the seed streams, and the assembly code — the
+worker adds transport, never semantics.
+
+Back-pressure is the FEED's job (credit-based leasing): a worker only ever
+holds the spans the trainer granted it, so a slow trainer idles workers
+instead of ballooning their memory. Epoch/shuffle determinism stays
+centralized too — leases carry explicit manifest indices, the worker never
+re-derives epoch geometry or consults a sampler.
+
+Failure reporting generalizes the PR 6/9 machinery across the wire:
+
+- transient decode failures retry + substitute INSIDE the worker's source
+  (reliability/retry.py; the substitution streams are attempt-keyed, so
+  every worker — and the local loader — substitutes identically);
+- exhausted-retry failures report back as ``qreport`` frames and land in
+  the TRAINER's persisted quarantine sidecar (data/manifest.Quarantine) —
+  the same budget, the same sampler-level exclusion next epoch/run;
+- a hard failure (``_MAX_CONSECUTIVE_FAILURES`` unreadable clips) becomes
+  an ``error`` frame the feed re-raises in the consumer, exactly where the
+  local loader would have raised.
+
+Tracing: each lease carries the trainer's W3C ``traceparent``; the worker
+continues that trace around the decode (obs/trace.py continue_trace) and
+stamps the batch frame with it, so a merged timeline shows the remote hop.
+
+Stdlib + numpy + cv2 only — a decode worker never imports jax.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import socket
+import sys
+from concurrent.futures import ThreadPoolExecutor
+from typing import List, Optional
+
+from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
+from pytorchvideo_accelerate_tpu.data.pipeline import assemble_batch
+from pytorchvideo_accelerate_tpu.dataplane import spec as spec_mod
+from pytorchvideo_accelerate_tpu.dataplane.wire import (
+    WireError,
+    parse_address,
+    recv_frame,
+    send_frame,
+)
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
+
+logger = logging.getLogger(__name__)
+
+# two jobs: the HANDSHAKE recv deadline (a feed that accepts but never
+# configures must not pin a worker forever), and the driver for the TCP
+# keepalive schedule after it — a trainer host that vanishes without a FIN
+# (power loss, partition) is detected within roughly this long and the
+# worker exits instead of living as an hours-long orphan. An idle
+# inter-epoch gap, by contrast, never kills a worker.
+IDLE_TIMEOUT_S = 600.0
+
+
+class _QuarantineReporter:
+    """Worker-side quarantine shim: `contains` is always False (the trainer
+    owns exclusion — it happens at the sampler, before a lease exists) and
+    `record` ships the verdict home as a ``qreport`` frame, where the feed
+    counts it against the clip's persisted budget."""
+
+    def __init__(self, worker: "DecodeWorker"):
+        self._worker = worker
+
+    def __len__(self) -> int:  # sampler-exclusion surface: trainer-owned
+        return 0
+
+    def contains(self, path: str) -> bool:
+        return False
+
+    def record(self, path: str, error: Optional[BaseException] = None) -> bool:
+        self._worker._send(
+            "qreport",
+            {"path": path,
+             "error": f"{type(error).__name__}: {error}"[:200]
+             if error else ""})
+        return False
+
+
+@shared_state("batches_done", "reports_sent")
+class DecodeWorker:
+    """One worker over one connected socket; `run()` serves until a
+    ``stop`` frame, a closed connection, or the idle deadline."""
+
+    def __init__(self, sock: socket.socket, decode_threads: int = 2,
+                 idle_timeout_s: float = IDLE_TIMEOUT_S):
+        self.sock = sock
+        self.decode_threads = max(int(decode_threads), 1)
+        self.idle_timeout_s = idle_timeout_s
+        self.batches_done = 0
+        self.reports_sent = 0
+        # decode pool threads (qreport) and the lease loop (batch) share
+        # the socket; frames must never interleave
+        self._send_lock = make_lock("DecodeWorker._send_lock")
+        self._pool: Optional[ThreadPoolExecutor] = None
+        self._source = None
+        self._spy = 0
+        self._accum = 1
+        self._local_batch = 0
+
+    # --- wire ----------------------------------------------------------------
+
+    def _send(self, kind: str, meta: Optional[dict] = None,
+              arrays: Optional[dict] = None,
+              traceparent: Optional[str] = None) -> None:
+        with self._send_lock:
+            send_frame(self.sock, kind, meta, arrays, traceparent)
+            if kind == "qreport":
+                self.reports_sent += 1
+
+    # --- protocol ------------------------------------------------------------
+
+    def _configure(self, meta: dict) -> None:
+        geom = meta.get("batch", {})
+        self._spy = int(geom["samples_per_yield"])
+        self._accum = int(geom.get("accum_steps", 1))
+        self._local_batch = int(geom.get("local_batch_size", self._spy))
+        tr = meta.get("trace") or {}
+        if tr.get("sample_rate") and trace.get_tracer() is None:
+            # a spawned process arms its own tracer from the handshake; an
+            # in-process worker thread rides the already-armed one
+            trace.configure_tracing(float(tr["sample_rate"]),
+                                    seed=int(tr.get("seed", 0)))
+        self._source = spec_mod.build_source(
+            meta["spec"], quarantine=_QuarantineReporter(self))
+        self._pool = ThreadPoolExecutor(max_workers=self.decode_threads)
+
+    def _decode_lease(self, fr) -> None:
+        epoch = int(fr.meta["epoch"])
+        index = int(fr.meta["index"])
+        gen = fr.meta.get("gen")
+        indices: List[int] = [int(i) for i in fr.meta["indices"]]
+        tracer = trace.get_tracer()
+        handle = (tracer.continue_trace(fr.traceparent, "remote_decode",
+                                        epoch=epoch, batch=index)
+                  if tracer is not None and fr.traceparent else None)
+        try:
+            with handle or trace.NOOP:
+                def fetch_one(i):
+                    with obs.span("decode"):
+                        return self._source.get(int(i), epoch)
+
+                samples = list(self._pool.map(fetch_one, indices))
+                batch = assemble_batch(samples, self._spy,
+                                       accum_steps=self._accum,
+                                       local_batch_size=self._local_batch)
+        except Exception as e:  # noqa: BLE001 - must cross the wire
+            # the local loader would raise here — IOError after 10
+            # consecutive unreadable clips, or a TRANSFORM bug propagating
+            # on purpose (pipeline.py keeps those distinct from corrupt
+            # files). Either way: report instead of dying, so the feed
+            # raises it in the CONSUMER with the original type named and
+            # the worker stays available — a deterministic poisoned span
+            # must not serially kill every worker it gets re-leased to
+            self._send("error", {"epoch": epoch, "index": index, "gen": gen,
+                                 "message":
+                                 f"{type(e).__name__}: {e}"[:500]})
+            return
+        self._send("batch", {"epoch": epoch, "index": index, "gen": gen},
+                   arrays=batch, traceparent=fr.traceparent)
+        self.batches_done += 1
+
+    def run(self) -> None:
+        """Serve the connection: hello → config → lease loop."""
+        # handshake under a deadline (a feed that accepts but never
+        # configures must not pin a worker forever); after it, reads
+        # block — an idle inter-epoch gap is normal, and a dead trainer
+        # (even SIGKILLed) closes the TCP stream, which reads as EOF.
+        # The half-open corner (trainer host power loss / partition, no
+        # FIN ever arrives) is covered by a keepalive schedule DERIVED
+        # from idle_timeout_s — a plain read timeout can't distinguish
+        # "idle between epochs" from "mid-frame corruption", but kernel
+        # probes detect a dead peer within ~idle_timeout_s and the
+        # blocked recv then errors out cleanly.
+        self.sock.settimeout(self.idle_timeout_s)
+        try:
+            self.sock.setsockopt(socket.SOL_SOCKET, socket.SO_KEEPALIVE, 1)
+            if hasattr(socket, "TCP_KEEPIDLE"):  # Linux schedule knobs
+                idle = max(int(self.idle_timeout_s / 2), 10)
+                intvl = max(int(self.idle_timeout_s / 10), 5)
+                self.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_KEEPIDLE, idle)
+                self.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_KEEPINTVL, intvl)
+                self.sock.setsockopt(socket.IPPROTO_TCP,
+                                     socket.TCP_KEEPCNT, 5)
+        except OSError:  # pragma: no cover - platform-dependent
+            pass
+        try:
+            self._send("hello", {"pid": os.getpid()})
+            cfg = recv_frame(self.sock, allow_eof=True)
+            if cfg is None:
+                return
+            if cfg.kind != "config":
+                raise WireError(f"expected config frame, got {cfg.kind!r}")
+            self._configure(cfg.meta)
+            self.sock.settimeout(None)
+            while True:
+                fr = recv_frame(self.sock, allow_eof=True)
+                if fr is None or fr.kind == "stop":
+                    return
+                if fr.kind == "lease":
+                    self._decode_lease(fr)
+                # unknown kinds are ignored: a newer feed may gossip
+        except (WireError, OSError, KeyError, TypeError, ValueError) as e:
+            # KeyError/TypeError/ValueError: a wire-valid frame with
+            # malformed meta (a version-skewed feed) — same clean-exit
+            # posture as protocol corruption, never a raw traceback death
+            logger.warning("decode worker exiting: %s: %s",
+                           type(e).__name__, e)
+        finally:
+            if self._pool is not None:
+                self._pool.shutdown(wait=False)
+            try:
+                self.sock.close()
+            except OSError:
+                pass
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="pva-tpu-dataworker",
+        description="disaggregated decode worker: connect to a trainer's "
+                    "RemoteClipFeed and serve clip-decode leases "
+                    "(docs/INPUT_PIPELINE.md § disaggregated data plane)")
+    ap.add_argument("--connect", required=True, metavar="HOST:PORT",
+                    help="the feed's listen address (trainer log / "
+                         "--data.dataplane_listen)")
+    ap.add_argument("--threads", type=int, default=2,
+                    help="decode threads inside this worker (cv2 releases "
+                         "the GIL; scale workers horizontally first)")
+    ap.add_argument("--idle-timeout-s", type=float, default=IDLE_TIMEOUT_S)
+    args = ap.parse_args(argv)
+    try:
+        host, port = parse_address(args.connect)
+    except ValueError as e:
+        ap.error(f"--connect: {e}")
+    logging.basicConfig(level=logging.INFO, stream=sys.stderr)
+    sock = socket.create_connection((host, port), timeout=30.0)
+    sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+    DecodeWorker(sock, decode_threads=args.threads,
+                 idle_timeout_s=args.idle_timeout_s).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -39,6 +39,11 @@ Legs (all seeded via one `--seed`, CPU-only, replayable):
   watched section, forced-host child) trips the watchdog DURING the
   wedge with per-host, per-op attribution — evidence before the external
   kill;
+- **dataplane_kill**: two real decode-worker processes feed a
+  RemoteClipFeed; one is SIGKILLed mid-epoch with leases outstanding —
+  the unacked span re-leases to the survivor, the batch stream stays
+  byte-identical to the local loader's (zero duplicate/missing), and the
+  dead worker's quarantine verdicts survive in the persisted sidecar;
 - **serve**: synthetic overload against a micro-batcher + admission
   controller — load sheds with 503/Retry-After semantics before latency
   collapses, an injected flush fault fails one batch (not the thread),
@@ -945,6 +950,135 @@ def leg_quarantine(report: dict, tmpdir: str, seed: int, log: Log) -> None:
         f"excludes index {bad_idx}")
 
 
+def leg_dataplane_kill(report: dict, tmpdir: str, seed: int,
+                       log: Log) -> None:
+    """Leg 13: decode-worker SIGKILL mid-epoch (docs/INPUT_PIPELINE.md §
+    disaggregated data plane). Two worker PROCESSES feed a RemoteClipFeed;
+    one is SIGKILLed with leases outstanding — the feed must re-lease the
+    unacked span to the survivor and the full batch stream must be
+    byte-identical to the local loader's (zero duplicate, zero missing →
+    identical training loss by construction). With a codec present, the
+    tree carries a deterministically-corrupt clip: the remote worker's
+    quarantine report must land in the trainer's persisted sidecar and
+    SURVIVE the reporting worker's death."""
+    import signal as signal_mod
+
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.data.manifest import (
+        Quarantine,
+        scan_directory,
+    )
+    from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader
+    from pytorchvideo_accelerate_tpu.dataplane import spec as dpspec
+    from pytorchvideo_accelerate_tpu.dataplane.bench import batch_digest
+    from pytorchvideo_accelerate_tpu.dataplane.feed import RemoteClipFeed
+
+    leg = _leg(report, "dataplane_kill")
+    tspec = dict(num_frames=4, training=True, crop_size=24,
+                 min_short_side_scale=26, max_short_side_scale=30)
+    root = os.path.join(tmpdir, "dpvideos")
+    bad_path = None
+    if _write_video_tree(root, n_per_class=6):
+        bad_path = os.path.join(root, "class0", "v0.mp4")
+        rng = np.random.default_rng(seed)
+        with open(bad_path, "wb") as f:  # seeded corrupt bytes
+            f.write(rng.integers(0, 256, 4096, dtype=np.uint8).tobytes())
+        spec = dpspec.video_spec(
+            scan_directory(root), tspec, clip_duration=0.2, training=True,
+            seed=seed, decode_retries=1, retry_base_delay_s=0.001)
+    else:
+        leg["codec"] = "unavailable (synthetic source, quarantine half skipped)"
+        spec = dpspec.synthetic_spec(tspec, num_videos=12, num_classes=4,
+                                     seed=seed)
+
+    def make_loader() -> ClipLoader:
+        # shuffle=False pins the corrupt clip (sorted index 0) into the
+        # FIRST batch, so the quarantine report deterministically precedes
+        # the kill; byte parity is shuffle-independent anyway
+        return ClipLoader(dpspec.build_source(spec), global_batch_size=4,
+                          shuffle=False, num_workers=1, seed=seed)
+
+    loader = make_loader()
+    try:
+        # batch_digest is THE byte-identity definition (shared with the
+        # DATA_PLANE bench lane — the two gates must agree on it)
+        local = [batch_digest(b) for b, _ in
+                 loader.epoch_items(0, from_start=True) if b is not None]
+    finally:
+        loader.close()
+
+    sidecar = os.path.join(tmpdir, "dp_quarantine.json")
+    quarantine = Quarantine(sidecar, budget=1, site="dataplane")
+    loader = make_loader()
+    feed = RemoteClipFeed(loader, spec, spawn=2, credits=2,
+                          quarantine=quarantine, batch_timeout_s=120.0)
+    remote: List[str] = []
+    victim = None
+    try:
+        for i, (batch, _state) in enumerate(
+                feed.epoch_items(0, from_start=True)):
+            if batch is None:
+                continue
+            remote.append(batch_digest(batch))
+            if victim is None and i == 0:
+                # wait for the quarantine verdict (codec runs), then kill
+                # the REPORTING worker — its completed verdicts must
+                # outlive it; prefer a moment when it holds leases so the
+                # re-lease path is exercised, not just membership
+                deadline = time.monotonic() + 20.0
+                while (bad_path is not None
+                       and not quarantine.contains(bad_path)
+                       and time.monotonic() < deadline):
+                    time.sleep(0.01)
+                stats = feed.stats()
+                reporters = {q["pid"] for q in stats["qreports"]}
+                deadline = time.monotonic() + 5.0
+                while time.monotonic() < deadline:
+                    stats = feed.stats()
+                    busy = [w for w in stats["workers"].values()
+                            if w["outstanding"] > 0
+                            and (not reporters or w["pid"] in reporters)]
+                    if busy:
+                        break
+                    time.sleep(0.005)
+                cand = [w["pid"] for w in stats["workers"].values()
+                        if w["outstanding"] > 0] or \
+                       [w["pid"] for w in stats["workers"].values()]
+                victim = (next((p for p in cand if p in reporters), None)
+                          or cand[0])
+                os.kill(victim, signal_mod.SIGKILL)
+        stats = feed.stats()
+    finally:
+        feed.close()
+        loader.close()
+    leg.update(batches=len(remote), want=len(local), victim_pid=victim,
+               releases=stats.get("releases"),
+               workers_lost=stats.get("workers_lost"),
+               qreports=len(stats.get("qreports", [])))
+    if remote != local:
+        _finding(report, "dataplane_kill",
+                 f"remote stream diverged after the kill: {len(remote)} "
+                 f"batches vs {len(local)} local (dup/missing/reordered)")
+    if stats.get("workers_lost") != 1:
+        _finding(report, "dataplane_kill",
+                 f"feed lost {stats.get('workers_lost')} workers, want "
+                 "exactly the SIGKILLed one")
+    if bad_path is not None:
+        if not quarantine.contains(bad_path):
+            _finding(report, "dataplane_kill",
+                     "remote decode failure never reached the trainer's "
+                     "quarantine sidecar")
+        elif not Quarantine(sidecar, budget=1).contains(bad_path):
+            _finding(report, "dataplane_kill",
+                     "quarantine verdict did not persist to the sidecar "
+                     "(lost with the dead worker)")
+    log(f"[chaos] dataplane_kill: worker {victim} SIGKILLed mid-epoch; "
+        f"{stats.get('releases')} span(s) re-leased, {len(remote)}/"
+        f"{len(local)} batches byte-identical, quarantine "
+        f"{'persisted' if bad_path else 'n/a (no codec)'}")
+
+
 # forced-host child for leg_collective_hang: a REAL mesh psum wedged by an
 # injected delay inside the watched section; the watchdog (tiny timeout)
 # must fire DURING the wedge with per-host attribution. One JSON line to
@@ -1090,6 +1224,7 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                     (leg_sigterm_plumbing, (report, log)),
                     (leg_decode, (report, tmpdir, seed, log)),
                     (leg_quarantine, (report, tmpdir, seed, log)),
+                    (leg_dataplane_kill, (report, tmpdir, seed, log)),
                     (leg_ckpt, (report, tmpdir, seed, log)),
                     (leg_tracker, (report, tmpdir, seed, log)),
                     (leg_serve, (report, seed, log)),

@@ -322,6 +322,7 @@ class Trainer:
         val_tf = make_transform(training=False,
                                 num_spatial_crops=eval_spatial, **common)
 
+        train_manifest = None  # set by the real-video branch (dataplane spec)
         if d.synthetic:
             num_classes = cfg.model.num_classes or 4
             self.train_source = SyntheticClipSource(
@@ -411,11 +412,60 @@ class Trainer:
             shuffle=False, drop_last=False,
             prefetch_batches=d.prefetch_batches, **loader_kw,
         )
+        # disaggregated data plane (dataplane/; docs/INPUT_PIPELINE.md):
+        # when configured, the train loader's decode moves to N worker
+        # PROCESSES and a RemoteClipFeed slots in where the local iterator
+        # feeds the device prefetcher — same epoch_items contract, same
+        # LoaderState in checkpoints, byte-identical batches. The feed
+        # records remote quarantine verdicts into the same sidecar.
+        self.train_feed = None
+        if d.dataplane_workers > 0:
+            from pytorchvideo_accelerate_tpu.dataplane import spec as dpspec
+            from pytorchvideo_accelerate_tpu.dataplane.feed import (
+                RemoteClipFeed,
+            )
+
+            if d.cache_dir:
+                raise ValueError(
+                    "data.dataplane_workers is incompatible with "
+                    "data.cache_dir: memmap cache reads are already cheaper "
+                    "than the wire — disaggregate the decode path only")
+            tspec = {**common, "training": True}
+            if d.synthetic:
+                src_spec = dpspec.synthetic_spec(
+                    tspec, num_videos=d.synthetic_num_videos,
+                    num_classes=num_classes, seed=cfg.seed)
+            else:
+                src_spec = dpspec.video_spec(
+                    train_manifest, tspec, clip_duration=cfg.clip_duration,
+                    training=True, seed=cfg.seed,
+                    decode_retries=cfg.reliability.decode_retries,
+                    retry_base_delay_s=cfg.reliability.retry_base_delay_s)
+            from pytorchvideo_accelerate_tpu.dataplane.wire import (
+                parse_address,
+            )
+
+            try:
+                listen = parse_address(d.dataplane_listen)
+            except ValueError as e:
+                raise ValueError(f"--data.dataplane_listen: {e}") from None
+            self.train_feed = RemoteClipFeed(
+                self.train_loader, src_spec, spawn=d.dataplane_workers,
+                listen=listen,
+                credits=d.dataplane_credits, quarantine=self.quarantine,
+                trace_config={"sample_rate": cfg.obs.trace_sample_rate,
+                              "seed": cfg.seed},
+            )
+            main_print(
+                f"dataplane: {d.dataplane_workers} decode worker(s) on "
+                f"{self.train_feed.address[0]}:{self.train_feed.address[1]} "
+                f"(credits={d.dataplane_credits})")
         # device-side prefetch: the step loops consume pre-placed mesh
         # batches; the H2D copy of batch N+1 overlaps compute of batch N
         # (depth 0 = synchronous placement, the A/B baseline)
         self.train_prefetch = DevicePrefetcher(
-            self.train_loader, self.mesh, depth=d.device_prefetch_depth,
+            self.train_feed or self.train_loader, self.mesh,
+            depth=d.device_prefetch_depth,
             micro_dim=cfg.optim.gradient_accumulation_steps > 1,
             watchdog=self.watchdog, watchdog_name="prefetch_train",
         )
@@ -681,6 +731,8 @@ class Trainer:
             uninstall_collective_watch()
             self.watchdog.stop()
             self.watchdog = None
+        if self.train_feed is not None:
+            self.train_feed.close()
         self.train_loader.close()
         self.val_loader.close()
 
@@ -820,6 +872,8 @@ class Trainer:
                 self.checkpointer.close()
             if self.watchdog is not None:
                 self.watchdog.stop()
+            if self.train_feed is not None:
+                self.train_feed.close()
             self.train_loader.close()
             self.val_loader.close()
 
@@ -1311,6 +1365,8 @@ class Trainer:
             tguard.close()  # fence the LKG ring's async saves
         if use_tqdm:
             progress.close()
+        if self.train_feed is not None:
+            self.train_feed.close()
         self.train_loader.close()
         self.val_loader.close()
         result = {"train_loss": last_train_loss, "steps": int(self.state.step),  # pva: disable=host-sync -- fit() exit: training is over, the sync is free

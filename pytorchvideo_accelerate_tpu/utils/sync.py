@@ -20,10 +20,11 @@ sanitizer instruments exactly those attribute accesses while armed (a class
 decorator is import-time metadata only — nothing happens until `arm()`).
 Registered today: DevicePrefetcher, MicroBatcher, ServingStats,
 AdmissionController, Watchdog, SpanCollector, FlightRecorder, TrackerHub,
-the distributed tracer (obs/trace.Tracer), and the fleet tier's
-Scheduler / ReplicaPool / Router / LoadGen (fleet/*.py) — new threaded
-classes MUST declare here so the pva-tpu-tsan stress scenario gates their
-concurrency like everything else's.
+the distributed tracer (obs/trace.Tracer), the fleet tier's
+Scheduler / ReplicaPool / Router / LoadGen (fleet/*.py), and the data
+plane's RemoteClipFeed / DecodeWorker (dataplane/*.py — the credit/ack
+machinery) — new threaded classes MUST declare here so the pva-tpu-tsan
+stress scenario gates their concurrency like everything else's.
 
 Stdlib-only on purpose: obs/ and serving worker paths import this module,
 and they must stay importable without jax (this file must never grow a

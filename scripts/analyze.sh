@@ -40,6 +40,14 @@ env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python -m pytorchvideo_accelerate_tpu.ops.kbench --smoke
 
+# disaggregated data-plane gate (docs/INPUT_PIPELINE.md § disaggregated
+# data plane): 2 remote decode-worker processes must produce a byte-
+# identical batch stream to the local loader on the same source/seed,
+# with input-wait no worse than local; exit 1 on parity break/regression
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m pytorchvideo_accelerate_tpu.dataplane.bench --smoke
+
 rc=0
 env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

@@ -155,6 +155,9 @@ def test_finalize_line_fits_driver_capture():
         "swap_blackout_ms": 12.345, "fleet_shed_frac": 0.0123,
         "trace_sampled": 1234, "trace_overhead_frac": 0.01234,
         "fleet_error": "no trustworthy device numbers " + "w" * 200,
+        "dataplane_cps": 49.71, "dataplane_input_wait_frac": 0.8294,
+        "dataplane_workers": 2,
+        "dataplane_error": "remote batch stream diverged " + "d" * 200,
         "kbench_platform": "cpu", "kbench_parity_ok": True,
         "kbench_best": "dw_x3d_res3:118.167x",
         "kbench_dw_x3d_res3_speedup": 118.167,
@@ -387,3 +390,50 @@ def test_finalize_multichip_mfu_analytic_obeys_the_refusal_rule():
         _model(), {**extras, "multichip_error": "cpu fallback"},
         user_smoke=False)
     assert "multichip_mfu_analytic" not in out
+
+
+def test_finalize_dataplane_keys_ride_the_headline():
+    """The DATA_PLANE lane's headline keys (remote clips/sec, remote
+    input-wait fraction, worker count — the numbers `--smoke` asserts)
+    plumb through finalize; a failed or parity-broken lane headlines
+    dataplane_error INSTEAD of the numbers (the fleet/multichip refusal
+    rule)."""
+    extras = {"dataplane_cps": 49.7, "dataplane_input_wait_frac": 0.31,
+              "dataplane_workers": 2}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["dataplane_cps"] == 49.7
+    assert out["dataplane_input_wait_frac"] == 0.31
+    assert out["dataplane_workers"] == 2
+
+    out = bench.finalize(
+        _model(),
+        {**extras, "dataplane_error": "remote batch stream diverged"},
+        user_smoke=False)
+    assert out["dataplane_error"] == "remote batch stream diverged"
+    for key in ("dataplane_cps", "dataplane_input_wait_frac",
+                "dataplane_workers"):
+        assert key not in out
+
+
+def test_finalize_suspect_round_sheds_flagship_device_perf_keys():
+    """BENCH_r05 regression: a suspect round (CPU fallback) headlined a
+    literal `"tflops_per_sec": 0.0` beside `suspect: true` — a zero that
+    pva-tpu-perfdiff could one day diff against a real device number.
+    Suspect rounds must shed the flagship's device-shaped perf keys
+    (tflops_per_sec, step_ms_blocked) under the same refusal rule the
+    lane keys obey; a trusted round keeps them."""
+    trusted = bench.finalize(_model(), {}, user_smoke=False)
+    assert trusted["tflops_per_sec"] == 50.0
+    assert trusted["step_ms_blocked"] == 10.0
+
+    suspect = bench.finalize(
+        _model(platform="cpu", smoke=True,
+               tflops_per_sec_per_chip=0.0, suspect=True),
+        {}, user_smoke=False)
+    assert suspect["suspect"] is True
+    assert "tflops_per_sec" not in suspect
+    assert "step_ms_blocked" not in suspect
+    # the child-flagged suspect shape (device round that self-flagged)
+    # sheds too, independent of the cpu-fallback detector
+    suspect2 = bench.finalize(_model(suspect=True), {}, user_smoke=False)
+    assert "tflops_per_sec" not in suspect2

@@ -69,7 +69,11 @@ def _run_main(bench, monkeypatch, argv, probe_script, calls,
     monkeypatch.setattr(bench, "probe_device", probe)
     monkeypatch.setattr(bench, "run_child",
                         _fake_child(calls, device_results))
-    monkeypatch.setattr(sys, "argv", ["bench.py", "--no-data"] + argv)
+    # --no-dataplane: that lane spawns real decode-worker SUBPROCESSES in
+    # the parent (this module's contract is fake probes/children only);
+    # its finalize plumbing is locked by test_bench_contract instead
+    monkeypatch.setattr(sys, "argv",
+                        ["bench.py", "--no-data", "--no-dataplane"] + argv)
     import io
     import contextlib
 

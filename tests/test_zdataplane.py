@@ -1,0 +1,545 @@
+"""Disaggregated data plane (dataplane/): wire-protocol round-trip + fuzz,
+remote/local byte parity, mid-epoch resume with leased-but-unconsumed
+spans, the credit/window back-pressure bound (asserted non-vacuously),
+worker-death re-lease, and the quarantine report-back path.
+
+Late-alphabet name on purpose: tier-1 is timeout-bound and these tests run
+after the cheap early families (the test_zobs/test_zfleet rationale). Most
+tests run workers as IN-PROCESS threads over loopback sockets — process
+spawn is covered once (the chaos leg SIGKILLs real processes)."""
+
+import os
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader, LoaderState
+from pytorchvideo_accelerate_tpu.dataplane import spec as dpspec
+from pytorchvideo_accelerate_tpu.dataplane import wire
+from pytorchvideo_accelerate_tpu.dataplane.feed import (
+    NoWorkersError,
+    RemoteClipFeed,
+)
+from pytorchvideo_accelerate_tpu.dataplane.worker import DecodeWorker
+
+TSPEC = dict(num_frames=4, training=True, crop_size=24,
+             min_short_side_scale=26, max_short_side_scale=30)
+
+
+def _spec(num_videos=16, seed=7):
+    return dpspec.synthetic_spec(TSPEC, num_videos=num_videos,
+                                 num_classes=4, seed=seed)
+
+
+def _loader(spec, **kw):
+    kw.setdefault("global_batch_size", 4)
+    kw.setdefault("shuffle", True)
+    kw.setdefault("num_workers", 1)
+    kw.setdefault("seed", 7)
+    return ClipLoader(dpspec.build_source(spec), **kw)
+
+
+def _thread_worker(feed, decode_threads=1):
+    s = socket.create_connection(feed.address)
+    t = threading.Thread(target=DecodeWorker(s, decode_threads).run,
+                         daemon=True)
+    t.start()
+    return t, s
+
+
+def _drain(items):
+    """(batches, states) of one epoch_items pass; batches deep-copied out
+    of the wire buffers."""
+    batches, states = [], []
+    for batch, state in items:
+        states.append(state.to_dict())
+        if batch is not None:
+            batches.append({k: np.array(v) for k, v in batch.items()})
+    return batches, states
+
+
+# --- wire protocol ----------------------------------------------------------
+
+def test_wire_round_trip_zero_copy_arrays():
+    a, b = socket.socketpair()
+    arrays = {"video": np.arange(24, dtype=np.float32).reshape(2, 3, 4),
+              "label": np.array([1, 2], np.int32)}
+    wire.send_frame(a, "batch", {"epoch": 1, "index": 3},
+                    arrays=arrays, traceparent="00-" + "a" * 32 + "-"
+                    + "b" * 16 + "-01")
+    fr = wire.recv_frame(b)
+    assert fr.kind == "batch"
+    assert fr.meta == {"epoch": 1, "index": 3}
+    assert fr.traceparent.startswith("00-" + "a" * 32)
+    assert fr.arrays["video"].dtype == np.float32
+    np.testing.assert_array_equal(fr.arrays["video"], arrays["video"])
+    np.testing.assert_array_equal(fr.arrays["label"], arrays["label"])
+    a.close(), b.close()
+
+
+def test_wire_clean_eof_is_none_mid_frame_is_error():
+    a, b = socket.socketpair()
+    a.close()
+    assert wire.recv_frame(b) is None  # clean EOF at a frame boundary
+    b.close()
+    a, b = socket.socketpair()
+    parts = wire.pack_frame("lease", {"index": 0},
+                            arrays={"x": np.zeros(8, np.float32)})
+    blob = b"".join(bytes(p) for p in parts)
+    a.sendall(blob[:len(blob) - 5])  # truncated payload, then EOF
+    a.close()
+    with pytest.raises(wire.WireError, match="mid-frame"):
+        wire.recv_frame(b)
+    b.close()
+
+
+@pytest.mark.parametrize("garbage", [
+    b"XXXX" + struct.pack("<I", 10) + b"0123456789",      # bad magic
+    wire.MAGIC + struct.pack("<I", 0),                     # zero header
+    wire.MAGIC + struct.pack("<I", wire.MAX_HEADER_BYTES + 1),  # huge
+    wire.MAGIC + struct.pack("<I", 9) + b"not-json!",      # non-JSON
+    wire.MAGIC + struct.pack("<I", 2) + b"[]",             # wrong type
+])
+def test_wire_fuzz_garbage_raises_cleanly(garbage):
+    """A corrupt frame must be a WireError — never a hang, never a crash
+    elsewhere (the feed treats it like a dead peer)."""
+    a, b = socket.socketpair()
+    a.sendall(garbage)
+    a.close()
+    with pytest.raises(wire.WireError):
+        wire.recv_frame(b)
+    b.close()
+
+
+def test_wire_hostile_shape_rejected_before_allocation():
+    a, b = socket.socketpair()
+    header = (b'{"kind":"batch","meta":{},"arrays":'
+              b'[{"key":"x","dtype":"float64","shape":[1073741824,64]}]}')
+    a.sendall(wire.MAGIC + struct.pack("<I", len(header)) + header)
+    with pytest.raises(wire.WireError, match="implausible"):
+        wire.recv_frame(b)
+    a.close(), b.close()
+
+
+@pytest.mark.parametrize("shape,dtype", [
+    ("[-4]", "float32"),                           # negative dim
+    ("[4294967296,4294967296]", "float32"),        # int64-product wrap → 0
+    ("[0,18446744073709551616]", "float32"),       # 0-elems but intp overflow
+    ("[99999999999999999999999999]", "float32"),   # OverflowError bait
+    ("[4]", "object"),                             # non-plain dtype
+])
+def test_wire_hostile_manifests_rejected_as_wire_errors(shape, dtype):
+    """Every hostile shape/dtype manifest must be a WireError — not a
+    ValueError/OverflowError escaping from numpy (which would kill a
+    worker/reader thread instead of reading as a dead peer). The wrap
+    case was a live repro: np.prod(dtype=int64) silently wraps
+    2**32 x 2**32 to 0, passing the size bound and blowing up in
+    reshape."""
+    a, b = socket.socketpair()
+    header = ('{"kind":"batch","meta":{},"arrays":[{"key":"x","dtype":"%s",'
+              '"shape":%s}]}' % (dtype, shape)).encode()
+    a.sendall(wire.MAGIC + struct.pack("<I", len(header)) + header)
+    with pytest.raises(wire.WireError):
+        wire.recv_frame(b)
+    a.close(), b.close()
+
+
+# --- parity -----------------------------------------------------------------
+
+def test_remote_stream_byte_identical_to_local():
+    """Two in-thread workers must reproduce the local loader's batch AND
+    LoaderState sequences exactly — the contract that makes checkpoints,
+    resume, and loss curves dataplane-invariant."""
+    spec = _spec()
+    loader = _loader(spec)
+    try:
+        local_batches, local_states = _drain(
+            loader.epoch_items(0, from_start=True))
+    finally:
+        loader.close()
+
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        for _ in range(2):
+            _thread_worker(feed)
+        feed.wait_for_workers(2, timeout=30.0)
+        remote_batches, remote_states = _drain(
+            feed.epoch_items(0, from_start=True))
+    finally:
+        feed.close()
+        loader.close()
+    assert remote_states == local_states
+    assert len(remote_batches) == len(local_batches) > 0
+    for lb, rb in zip(local_batches, remote_batches):
+        assert set(lb) == set(rb)
+        for k in lb:
+            assert lb[k].dtype == rb[k].dtype
+            np.testing.assert_array_equal(lb[k], rb[k])
+
+
+def test_accum_and_padding_geometry_survive_the_wire():
+    """accum reshape (accum, B_local, ...) and the padded+masked val tail
+    happen WORKER-side via the shared assemble_batch — both shapes must
+    arrive intact."""
+    spec = _spec(num_videos=10)
+    loader = _loader(spec, global_batch_size=2, accum_steps=2,
+                     drop_last=False)
+    try:
+        local_batches, _ = _drain(loader.epoch_items(0, from_start=True))
+    finally:
+        loader.close()
+    loader = _loader(spec, global_batch_size=2, accum_steps=2,
+                     drop_last=False)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        _thread_worker(feed)
+        feed.wait_for_workers(1, timeout=30.0)
+        remote_batches, _ = _drain(feed.epoch_items(0, from_start=True))
+    finally:
+        feed.close()
+        loader.close()
+    assert len(remote_batches) == len(local_batches)
+    assert "mask" in remote_batches[-1]  # padded tail crossed the wire
+    for lb, rb in zip(local_batches, remote_batches):
+        for k in lb:
+            assert lb[k].shape == rb[k].shape
+            np.testing.assert_array_equal(lb[k], rb[k])
+
+
+# --- resume -----------------------------------------------------------------
+
+def test_mid_epoch_resume_with_leased_but_unconsumed_spans():
+    """A checkpoint taken mid-epoch records the CONSUMED position only;
+    spans that were leased (and maybe even decoded) but not consumed are
+    simply re-decoded after resume — the stream picks up exactly where the
+    state says, through a LoaderState dict round-trip."""
+    spec = _spec(num_videos=32)
+    loader = _loader(spec)
+    try:
+        local_batches, _ = _drain(loader.epoch_items(0, from_start=True))
+    finally:
+        loader.close()
+
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        _thread_worker(feed)
+        _thread_worker(feed)
+        feed.wait_for_workers(2, timeout=30.0)
+        it = feed.epoch_items(0, from_start=True)
+        got = []
+        for _ in range(3):  # consume 3; more are leased/buffered right now
+            batch, state = next(it)
+            got.append({k: np.array(v) for k, v in batch.items()})
+            feed.state = state
+        it.close()
+        assert feed.stats()["consumed"] == 3
+        # the "checkpoint": serialize the consumed position and round-trip
+        saved = feed.state.to_dict()
+        assert saved == {"epoch": 0, "position": 3}
+        feed.state = LoaderState.from_dict(saved)
+        rest, states = _drain(feed.epoch_items())
+        assert states[0] == {"epoch": 0, "position": 4}
+    finally:
+        feed.close()
+        loader.close()
+    resumed = got + rest
+    assert len(resumed) == len(local_batches)
+    for lb, rb in zip(local_batches, resumed):
+        for k in lb:
+            np.testing.assert_array_equal(lb[k], rb[k])
+
+
+# --- back-pressure ----------------------------------------------------------
+
+def test_backpressure_bound_holds_and_releases():
+    """With the consumer stalled, total decoded batches anywhere in the
+    plane must stop at the lease window (credits x workers) — asserted
+    non-vacuously: more batches WERE available, the workers sat idle at
+    the bound, and consuming one immediately bought exactly one more
+    lease."""
+    spec = _spec(num_videos=64)  # 16 batches >> the window of 2
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        _thread_worker(feed)
+        feed.wait_for_workers(1, timeout=30.0)
+        window = feed.credits * feed.worker_count()
+        it = feed.epoch_items(0, from_start=True)
+        next(it)  # start the pass (generators pump lazily) + consume ONE
+        # then stall: the plane may fill the window ahead of the consumer
+        # and not one batch more
+        bound = 1 + window
+        deadline = time.monotonic() + 30.0
+        while (feed.stats()["received"] < bound
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        time.sleep(0.3)  # grace: any bound violation would land here
+        s = feed.stats()
+        assert s["received"] == bound, s   # filled to the bound...
+        assert s["consumed"] == 1
+        assert s["unleased"] == 16 - bound  # ...with work left (non-vacuous)
+        next(it)  # consume ONE more: the window advances by exactly one
+        deadline = time.monotonic() + 30.0
+        while (feed.stats()["received"] < bound + 1
+               and time.monotonic() < deadline):
+            time.sleep(0.01)
+        time.sleep(0.3)
+        s = feed.stats()
+        assert s["received"] == bound + 1, s
+        it.close()
+    finally:
+        feed.close()
+        loader.close()
+
+
+# --- failure paths ----------------------------------------------------------
+
+def test_worker_death_releases_spans_stream_intact():
+    spec = _spec(num_videos=32)
+    loader = _loader(spec)
+    try:
+        local_batches, _ = _drain(loader.epoch_items(0, from_start=True))
+    finally:
+        loader.close()
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        _t1, s1 = _thread_worker(feed)
+        _thread_worker(feed)
+        feed.wait_for_workers(2, timeout=30.0)
+        remote = []
+        for i, (batch, _state) in enumerate(
+                feed.epoch_items(0, from_start=True)):
+            if batch is None:
+                continue
+            remote.append({k: np.array(v) for k, v in batch.items()})
+            if i == 0:
+                s1.close()  # one worker dies with leases outstanding
+        s = feed.stats()
+    finally:
+        feed.close()
+        loader.close()
+    assert s["workers_lost"] == 1
+    assert len(remote) == len(local_batches)
+    for lb, rb in zip(local_batches, remote):
+        for k in lb:
+            np.testing.assert_array_equal(lb[k], rb[k])
+
+
+def test_two_worker_deaths_interleaved_spans_stay_ordered():
+    """Regression: two deaths in a row can return INTERLEAVED span sets
+    (A held {2,5}, B held {3,4}); the re-lease merge must keep the lease
+    queue ascending or the window check strands the head span and the
+    pass stalls to timeout. Three workers, two killed mid-epoch — the
+    stream must stay byte-identical and complete."""
+    spec = _spec(num_videos=48)
+    loader = _loader(spec)
+    try:
+        local_batches, _ = _drain(loader.epoch_items(0, from_start=True))
+    finally:
+        loader.close()
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=30.0)
+    try:
+        socks = [_thread_worker(feed)[1] for _ in range(3)]
+        feed.wait_for_workers(3, timeout=30.0)
+        remote = []
+        for i, (batch, _state) in enumerate(
+                feed.epoch_items(0, from_start=True)):
+            if batch is None:
+                continue
+            remote.append({k: np.array(v) for k, v in batch.items()})
+            if i == 0:
+                socks[0].close()
+            elif i == 1:
+                socks[1].close()
+        s = feed.stats()
+    finally:
+        feed.close()
+        loader.close()
+    assert s["workers_lost"] == 2
+    assert len(remote) == len(local_batches)
+    for lb, rb in zip(local_batches, remote):
+        for k in lb:
+            np.testing.assert_array_equal(lb[k], rb[k])
+
+
+def test_all_workers_gone_raises_not_hangs():
+    spec = _spec()
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        _t, s = _thread_worker(feed)
+        feed.wait_for_workers(1, timeout=30.0)
+        it = feed.epoch_items(0, from_start=True)
+        next(it)
+        s.close()
+        with pytest.raises(NoWorkersError):
+            for _ in it:
+                pass
+    finally:
+        feed.close()
+        loader.close()
+
+
+def test_no_worker_ever_times_out_cleanly():
+    """No worker and none arriving: the consumer must get a clean timeout
+    error, never an unbounded hang (the fuzz contract's feed half)."""
+    spec = _spec(num_videos=8)
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=0.5)
+    try:
+        with pytest.raises(wire.WireError, match="no decode worker"):
+            next(feed.epoch_items(0, from_start=True))
+    finally:
+        feed.close()
+        loader.close()
+
+
+def test_quarantine_report_lands_in_trainer_sidecar(tmp_path):
+    """A remote decode failure must land in the TRAINER's persisted
+    Quarantine sidecar with the same budget semantics a local failure
+    gets. Exercised without a codec: a video spec over paths that don't
+    exist fails decode on every clip; the worker substitutes (and
+    eventually errors), and the reports count budget trainer-side."""
+    from pytorchvideo_accelerate_tpu.data.manifest import (
+        Manifest,
+        Quarantine,
+        VideoEntry,
+    )
+
+    manifest = Manifest(
+        entries=[VideoEntry(str(tmp_path / f"missing_{i}.mp4"), i % 2,
+                            f"class_{i % 2}") for i in range(4)],
+        class_names=["class_0", "class_1"])
+    spec = dpspec.video_spec(manifest, TSPEC, clip_duration=0.2,
+                             training=True, seed=7, decode_retries=1,
+                             retry_base_delay_s=0.001)
+    sidecar = str(tmp_path / "quarantine.json")
+    quarantine = Quarantine(sidecar, budget=1, site="dataplane")
+    loader = _loader(spec, global_batch_size=2)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          quarantine=quarantine, batch_timeout_s=60.0)
+    try:
+        _thread_worker(feed)
+        feed.wait_for_workers(1, timeout=30.0)
+        # every clip is unreadable: the worker exhausts substitution and
+        # reports an error frame; the consumer sees the SAME IOError the
+        # local loader would raise
+        with pytest.raises(IOError):
+            for _ in feed.epoch_items(0, from_start=True):
+                pass
+        assert len(quarantine) > 0
+        assert len(feed.stats()["qreports"]) > 0
+    finally:
+        feed.close()
+        loader.close()
+    # persisted: a fresh run's sidecar read-back excludes the same paths
+    assert len(Quarantine(sidecar, budget=1)) > 0
+
+
+def test_transform_bug_reports_as_error_frame_not_worker_death():
+    """A deterministic non-IO exception in decode/transform must cross the
+    wire as an `error` frame and raise in the CONSUMER — not kill the
+    worker (a poisoned span would then serially kill every worker it gets
+    re-leased to and surface as NoWorkersError instead of the cause)."""
+    # num_spatial_crops on a training transform raises ValueError inside
+    # make_transform — worker-side, during _configure... so instead poison
+    # the SOURCE: a synthetic spec whose raw_size is valid but whose
+    # transform crop exceeds the raw frame (cv2 resize contract violation
+    # surfaces as a non-IO exception during get())
+    spec = dpspec.synthetic_spec(
+        dict(num_frames=4, training=True, crop_size=64,
+             min_short_side_scale=8, max_short_side_scale=8),
+        num_videos=8, num_classes=4, seed=7, raw_frames=4,
+        raw_size=[32, 40])
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        _thread_worker(feed)
+        feed.wait_for_workers(1, timeout=30.0)
+        with pytest.raises(IOError):
+            for _ in feed.epoch_items(0, from_start=True):
+                pass
+        # the worker survived its own report: still a member
+        assert feed.worker_count() == 1
+        assert feed.stats()["workers_lost"] == 0
+    finally:
+        feed.close()
+        loader.close()
+
+
+def test_close_releases_a_blocked_consumer_promptly():
+    """close() racing an active pass must wake the blocked consumer NOW,
+    not after batch_timeout_s (the trainer-crash teardown path: fit()'s
+    finally closes the feed while the prefetcher thread still waits)."""
+    spec = _spec(num_videos=8)
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=300.0)
+    it = feed.epoch_items(0, from_start=True)
+    blocked = {}
+
+    def consume():
+        try:
+            next(it)  # no workers: blocks until close() releases it
+        except Exception as e:  # noqa: BLE001 - the release signal
+            blocked["error"] = type(e).__name__
+
+    t = threading.Thread(target=consume, daemon=True)
+    t.start()
+    time.sleep(0.2)
+    t0 = time.monotonic()
+    feed.close()
+    t.join(timeout=10.0)
+    loader.close()
+    assert not t.is_alive(), "consumer still blocked after close()"
+    assert time.monotonic() - t0 < 5.0
+    assert blocked.get("error") == "NoWorkersError"
+
+
+# --- trace propagation ------------------------------------------------------
+
+def test_lease_traceparent_reaches_worker_spans():
+    """Leases carry the consumer's trace context; the worker continues it
+    (remote_decode) and the feed records the hop (remote_batch) — the
+    cross-process propagation the trace lint rule guards."""
+    from pytorchvideo_accelerate_tpu.obs import trace
+
+    tracer = trace.configure_tracing(1.0, seed=0, capacity=256)
+    spec = _spec(num_videos=8)
+    loader = _loader(spec)
+    feed = RemoteClipFeed(loader, spec, spawn=0, credits=2,
+                          batch_timeout_s=60.0)
+    try:
+        _thread_worker(feed)
+        feed.wait_for_workers(1, timeout=30.0)
+        with tracer.start("epoch", force=True):
+            for _ in feed.epoch_items(0, from_start=True):
+                pass
+        events = tracer.export()["traceEvents"]
+        names = {e["name"] for e in events}
+        assert "remote_decode" in names, names
+        assert "remote_batch" in names, names
+        root = next(e for e in events if e["name"] == "epoch")
+        hop = next(e for e in events if e["name"] == "remote_batch")
+        assert hop["args"]["trace_id"] == root["args"]["trace_id"]
+    finally:
+        trace.disable_tracing()
+        feed.close()
+        loader.close()

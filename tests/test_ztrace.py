@@ -508,3 +508,51 @@ def test_rule_clean_on_the_real_tree():
         findings = [x for x in lint_source(source, path=suffix)
                     if x.rule == "trace-propagation"]
         assert findings == [], (suffix, [x.format() for x in findings])
+
+
+def test_rule_flags_wire_send_in_dataplane_module():
+    """The data plane's cross-PROCESS put site: `send_frame(...)` in a
+    dataplane module that never touches the trace helpers truncates every
+    trace at the process boundary."""
+    src = (
+        "from pytorchvideo_accelerate_tpu.dataplane.wire import send_frame\n"
+        "def ship(sock, batch):\n"
+        "    send_frame(sock, 'batch', arrays=batch)\n")
+    findings = _trace_findings(
+        src, path="pytorchvideo_accelerate_tpu/dataplane/feed.py")
+    assert len(findings) == 1
+    assert "process boundary" in findings[0].message
+    # a dotted spelling is the same site
+    src_dotted = (
+        "from pytorchvideo_accelerate_tpu.dataplane import wire\n"
+        "def ship(sock, batch):\n"
+        "    wire.send_frame(sock, 'batch', arrays=batch)\n")
+    assert len(_trace_findings(
+        src_dotted,
+        path="pytorchvideo_accelerate_tpu/dataplane/worker.py")) == 1
+
+
+def test_rule_wire_send_clean_when_module_continues_traces():
+    """continue_trace on a Tracer INSTANCE (the worker's shape:
+    `get_tracer().continue_trace(header, ...)`) counts as propagation —
+    the cross-process helpers are distinctive enough to recognize on any
+    receiver."""
+    src = (
+        "from pytorchvideo_accelerate_tpu.dataplane.wire import send_frame\n"
+        "from pytorchvideo_accelerate_tpu.obs import trace\n"
+        "def ship(sock, batch, header):\n"
+        "    t = trace.get_tracer()\n"
+        "    if t is not None:\n"
+        "        h = t.continue_trace(header, 'remote_decode')\n"
+        "    send_frame(sock, 'batch', arrays=batch)\n")
+    assert _trace_findings(
+        src, path="pytorchvideo_accelerate_tpu/dataplane/worker.py") == []
+
+
+def test_rule_send_frame_out_of_scope_in_cold_modules():
+    src = (
+        "from pytorchvideo_accelerate_tpu.dataplane.wire import send_frame\n"
+        "def ship(sock, batch):\n"
+        "    send_frame(sock, 'batch', arrays=batch)\n")
+    assert _trace_findings(
+        src, path="pytorchvideo_accelerate_tpu/models/x3d.py") == []
