@@ -1140,6 +1140,210 @@ def bench_fleet(args) -> dict:
     return out
 
 
+# forced-host slice for the smoke-mode PIPELINE lane (same 8 fake CPU
+# devices as the multichip lane); module-level so tests can shrink it
+PIPELINE_FORCED_DEVICES = 8
+# fp32 loss-parity tolerance across pipeline layouts (the lane runs fp32
+# by construction — the parity probe is the acceptance gate, and bf16
+# summation-order noise would compound across update steps)
+PIPELINE_PARITY_RTOL = 2e-2
+
+
+def bench_pipeline(args) -> dict:
+    """The PIPELINE lane (parallel/pipeline.py; docs/PARALLELISM.md §
+    pipeline): pipeline-parallel VideoMAE pretrain on the 2-D (data,
+    model) train mesh, with self-verifying numerics.
+
+    Probes, one honest record:
+    - PARITY (the acceptance gate): the same fixed global batch stepped K
+      times unpipelined (P=1) and through P=2 / P=4 stage pipelines at
+      fp32 must produce the same per-step loss trajectory — the stage
+      schedule changes WHEN each microbatch's blocks run, never the math;
+    - BUBBLE: the analytic fill/drain fraction (P-1)/(M+P-1) next to a
+      MEASURED one from a two-point (M, 2M) timing fit at fixed
+      microbatch size — t_tick = (T(2M) - T(M)) / M, bubble =
+      (P-1)*t_tick / T(M) — because a single run cannot separate
+      fill/drain idle from per-tick compute;
+    - THROUGHPUT: pipelined clips/s/chip at the P-stage point
+      (`pipeline_cps_per_chip`, perfdiff HIGHER_BETTER);
+    - DONATION: graphcheck's donation pass over the pipelined step —
+      declared donations must alias through the stage shard_map + scan;
+    - plus one short Trainer.fit() under the pipelined layout so the
+      steady-state-zero recompile contract holds there too
+      (`train_recompiles == 0`), with the pipeline perf keys present.
+
+    Smoke runs the whole lane on the forced-host CPU slice (honest
+    parity, never device numbers — the multichip convention)."""
+    import jax
+
+    from pytorchvideo_accelerate_tpu.config import (
+        DataConfig, MeshConfig, ModelConfig, OptimConfig, ParallelConfig,
+        TrainConfig,
+    )
+    from pytorchvideo_accelerate_tpu.parallel.pipeline import (
+        analytic_bubble_frac,
+    )
+    from pytorchvideo_accelerate_tpu.utils.bench_setup import (
+        build_step_setup, fetch_loss,
+    )
+
+    devices = jax.devices()
+    n = len(devices)
+    platform = devices[0].platform
+    out: dict = {
+        "n_devices": n,
+        "platform": platform,
+        "forced_host": bool(args.smoke),
+        "smoke": bool(args.smoke),
+        "suspect": platform == "cpu" and not args.smoke,
+    }
+    if n < 2:
+        out["error"] = f"pipeline lane needs >= 2 devices, have {n}"
+        return out
+    model_name = "videomae_t_pretrain"
+    frames, crop = (4, 32) if args.smoke else (16, 224)
+    # stage counts this slice supports: P must divide the trunk depth (4)
+    # AND the device count must split as (data, P)
+    stage_points = [p for p in (2, 4) if n % p == 0 and n // p >= 1]
+    if not stage_points:
+        # an odd slice (3/5/7 devices) fits no (data, P) split: refuse
+        # loudly rather than report a vacuously-true parity verdict for
+        # a sweep that never ran
+        out["error"] = (f"pipeline lane needs a device count divisible "
+                        f"by 2 or 4 for its (data, P) points, have {n}")
+        return out
+    # every layout must divide the SAME global batch: P=1 needs its n data
+    # shards, each P-stage point needs data_shards x microbatches
+    # = (n/p) x 2p = 2n — one fixed batch for the whole parity sweep
+    GB = math.lcm(n, *(2 * p * (n // p) for p in stage_points))
+    k_parity = 3
+    k_timed = args.steps if not args.smoke else 3
+    out.update(model=model_name, frames=frames, crop=crop, global_batch=GB,
+               mixed_precision="fp32", stage_points=stage_points)
+
+    def make_point(stages: int, micro: int = 0):
+        mesh_cfg = (MeshConfig(data=n // stages, model=stages)
+                    if stages > 1 else MeshConfig(data=n, model=1))
+        return build_step_setup(
+            model_name, frames=frames, crop=crop, batch_per_chip=1,
+            num_classes=16, global_batch=GB, devices=list(devices),
+            mesh_cfg=mesh_cfg, total_steps=k_parity + k_timed + 4,
+            mixed_precision="fp32", overrides={"dropout_rate": 0.0},
+            pipeline_stages=stages, pipeline_microbatches=micro,
+        )
+
+    def run_point(setup, label, timed=True):
+        losses = []
+        state = setup.state
+        gbs = [setup.device_batch(0), setup.device_batch(1)]
+        for i in range(k_parity):
+            state, metrics = setup.step(state, gbs[i % 2], jax.random.key(i))
+            losses.append(fetch_loss(metrics))
+        cps = dt = None
+        if timed:
+            t0 = time.perf_counter()
+            for i in range(k_timed):
+                state, metrics = setup.step(state, gbs[i % 2],
+                                            jax.random.key(100 + i))
+            fetch_loss(metrics)
+            dt = time.perf_counter() - t0
+            cps = GB * k_timed / dt
+        log(f"[pipeline] {label}: losses {[round(v, 4) for v in losses]}"
+            + (f", {cps:.2f} clips/s ({cps / n:.2f}/chip)" if cps else ""))
+        return losses, cps, dt
+
+    ref_losses, ref_cps, _ = run_point(make_point(1), "P=1 baseline")
+    parity_max_rel = 0.0
+    cps_points = {"1": round(ref_cps / n, 3)}
+    top_p = stage_points[-1] if stage_points else 1
+    for p in stage_points:
+        m = 2 * p  # fixed default schedule for the parity points
+        setup = make_point(p, m)
+        losses, cps, dt_m = run_point(setup, f"P={p} M={m}")
+        cps_points[str(p)] = round(cps / n, 3)
+        parity_max_rel = max(parity_max_rel, max(
+            abs(a - b) / max(abs(b), 1e-9)
+            for a, b in zip(losses, ref_losses)))
+        if p == top_p:
+            out["pipeline_cps_per_chip"] = round(cps / n, 3)
+            out["pipeline_stages"] = p
+            out["pipeline_microbatches"] = m
+            out["pipeline_bubble_frac_analytic"] = round(
+                analytic_bubble_frac(p, m), 4)
+            # two-point (M, 2M) fit at FIXED microbatch size: double the
+            # global batch with the microbatch count so each tick does
+            # identical work, then the timing difference isolates t_tick
+            setup2 = build_step_setup(
+                model_name, frames=frames, crop=crop, batch_per_chip=1,
+                num_classes=16, global_batch=2 * GB, devices=list(devices),
+                mesh_cfg=MeshConfig(data=n // p, model=p),
+                total_steps=k_timed + 4, mixed_precision="fp32",
+                overrides={"dropout_rate": 0.0},
+                pipeline_stages=p, pipeline_microbatches=2 * m,
+            )
+            _, _, dt_2m = run_point(setup2, f"P={p} M={2 * m} (fit point)")
+            t_m, t_2m = dt_m / k_timed, dt_2m / k_timed
+            t_tick = max((t_2m - t_m) / m, 0.0)
+            measured = ((p - 1) * t_tick / t_m) if t_m > 0 else None
+            out["pipeline_bubble_frac"] = (round(min(measured, 1.0), 4)
+                                           if measured is not None else None)
+            log(f"[pipeline] P={p}: bubble analytic "
+                f"{out['pipeline_bubble_frac_analytic']} measured "
+                f"{out['pipeline_bubble_frac']} "
+                f"(t_tick {t_tick * 1e3:.1f} ms)")
+            # donation through the stage scan, verified on the REAL lane
+            # step (the parent's graphcheck gate runs single-device and
+            # skips the pipelined target; this child has the mesh)
+            try:
+                from pytorchvideo_accelerate_tpu.analysis.gc_donation import (
+                    check_donation,
+                )
+
+                gb0 = setup.device_batch(0)
+                findings, summary = check_donation(
+                    setup.step, (setup.state, gb0, jax.random.key(0)))
+                out["pipeline_donation_verified"] = (
+                    summary.get("declared_unaliased") == 0
+                    and summary.get("undeclared_donatable") == 0
+                    and summary.get("aliased", 0) > 0)
+                log(f"[pipeline] donation: {summary}")
+            except Exception as e:  # noqa: BLE001 - verdict, not a crash
+                log(f"[pipeline] donation check failed: "
+                    f"{type(e).__name__}: {e}")
+                out["pipeline_donation_verified"] = None
+    out["cps_per_chip_by_stages"] = cps_points
+    out["parity_max_rel"] = round(parity_max_rel, 6)
+    out["pipeline_parity"] = bool(parity_max_rel <= PIPELINE_PARITY_RTOL)
+
+    # Trainer.fit() under the pipelined layout: the recompile contract and
+    # the pipeline perf keys must hold end to end, guard composition incl.
+    if stage_points:
+        p = stage_points[0]
+        tcfg = TrainConfig(
+            mesh=MeshConfig(data=n // p, model=p),
+            parallel=ParallelConfig(pipeline_stages=p),
+            model=ModelConfig(name=model_name, num_classes=16,
+                              dropout_rate=0.0),
+            data=DataConfig(synthetic=True,
+                            synthetic_num_videos=max(2 * (n // p) * p, 8),
+                            num_frames=frames, crop_size=crop,
+                            batch_size=GB // (n // p), num_workers=1,
+                            limit_val_batches=1),
+            optim=OptimConfig(num_epochs=1, lr=0.01),
+            mixed_precision="fp32",
+        )
+        from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+        res = Trainer(tcfg).fit()
+        out["train_recompiles"] = res.get("train_recompiles")
+        out["trainer_bubble_frac_analytic"] = res.get(
+            "pipeline_bubble_frac_analytic")
+        out["trainer_pipeline_cps_per_chip"] = res.get(
+            "pipeline_cps_per_chip")
+    log(f"[pipeline] {json.dumps(out)}")
+    return out
+
+
 # --- parent orchestration ---------------------------------------------------
 
 def bench_kbench(args) -> dict:
@@ -1239,6 +1443,12 @@ def child_main(args) -> None:
 
         os.environ["XLA_FLAGS"] = forced_host_env(
             MULTICHIP_FORCED_DEVICES)["XLA_FLAGS"]
+    if args.child == "__pipeline__" and args.smoke:
+        # forced-host slice for the PIPELINE lane (same latching rule)
+        from pytorchvideo_accelerate_tpu.utils.forcehost import forced_host_env
+
+        os.environ["XLA_FLAGS"] = forced_host_env(
+            PIPELINE_FORCED_DEVICES)["XLA_FLAGS"]
     if args.child == "__fleet__" and args.smoke and FLEET_SMOKE["devices"]:
         # SERVE_FLEET multi-device CI: each replica gets its own forced
         # CPU device, so routing/swap run against genuinely disjoint
@@ -1255,6 +1465,8 @@ def child_main(args) -> None:
         res = bench_trainer(args)
     elif args.child == "__multichip__":
         res = bench_multichip(args)
+    elif args.child == "__pipeline__":
+        res = bench_pipeline(args)
     elif args.child == "__fleet__":
         res = bench_fleet(args)
     elif args.child == "__kbench__":
@@ -1296,6 +1508,15 @@ def main():
                          "the 2-D (data, model) train mesh, with loss-parity "
                          "and mesh-reshape checkpoint probes; forced-host "
                          "CPU devices in smoke mode (never device numbers)")
+    ap.add_argument("--pipeline", action=argparse.BooleanOptionalAction,
+                    default=True,
+                    help="PIPELINE lane: pipeline-parallel VideoMAE "
+                         "pretrain — P=1 vs P=2/4 fp32 loss-parity at a "
+                         "fixed global batch, analytic + measured "
+                         "fill/drain bubble fraction, pipelined clips/s/"
+                         "chip, donation through the stage scan; forced-"
+                         "host CPU devices in smoke mode (--no-pipeline "
+                         "skips)")
     ap.add_argument("--data", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="host input-pipeline microbench (decode vs cache vs "
@@ -1620,6 +1841,37 @@ def main():
                         "multichip_mfu_peak_source"]
         flush_partial()
 
+    if args.pipeline:
+        # PIPELINE lane: child-isolated like the multichip lane (a wedged
+        # stage compile loses the lane, not the round); forced-host in
+        # smoke, and the same refusal rule — a non-smoke CPU fallback
+        # headlines pipeline_error INSTEAD of the perf keys while the
+        # parity verdict rides regardless
+        pc = run_child("__pipeline__", args, user_smoke or not device_ok,
+                       _model_timeout(args))
+        extras["pipeline"] = pc  # full record -> bench_partial.json
+        if "error" in pc:
+            extras["pipeline_error"] = str(pc["error"])[:120]
+        else:
+            extras["pipeline_parity"] = pc.get("pipeline_parity")
+            if pc.get("pipeline_donation_verified") is not None:
+                extras["pipeline_donation_verified"] = bool(
+                    pc["pipeline_donation_verified"])
+            if pc.get("train_recompiles") is not None:
+                extras["pipeline_train_recompiles"] = int(
+                    pc["train_recompiles"])
+            if pc.get("suspect"):
+                extras["pipeline_error"] = (
+                    "no trustworthy device numbers for the pipeline lane "
+                    "(cpu fallback); parity verdicts retained")
+            else:
+                for key in ("pipeline_cps_per_chip", "pipeline_bubble_frac",
+                            "pipeline_bubble_frac_analytic",
+                            "pipeline_stages"):
+                    if pc.get(key) is not None:
+                        extras[key] = pc[key]
+        flush_partial()
+
     if args.data:
         # host-side benches run in the parent but bounded: a wedged decode
         # or forked worker must not break the one-JSON-line contract (the
@@ -1872,6 +2124,27 @@ def main():
         assert extras.get("multichip_train_recompiles") in (0, None), (
             "steady-state recompiles under the 2-D mesh layout: "
             f"{extras.get('multichip_train_recompiles')}")
+    if user_smoke and args.pipeline:
+        # PIPELINE acceptance (docs/PARALLELISM.md § pipeline): the P=2/4
+        # stage pipelines hold the P=1 fp32 loss trajectory at identical
+        # steps, the bubble fraction is headlined (analytic AND measured),
+        # donation survives the stage scan, and the steady-state-zero
+        # recompile contract holds under the pipelined layout
+        pc = extras.get("pipeline", {})
+        assert "pipeline_error" not in extras, (
+            f"PIPELINE lane failed: {extras['pipeline_error']}: {pc}")
+        assert extras.get("pipeline_parity") is True, (
+            "pipelined VideoMAE pretrain diverged from the P=1 loss "
+            f"trajectory: {pc}")
+        for key in ("pipeline_cps_per_chip", "pipeline_bubble_frac",
+                    "pipeline_bubble_frac_analytic"):
+            assert extras.get(key) is not None, (
+                f"pipeline smoke ran but produced no {key!r}: {pc}")
+        assert extras.get("pipeline_donation_verified") is True, (
+            f"pipelined step donation not verified by graphcheck: {pc}")
+        assert extras.get("pipeline_train_recompiles") in (0, None), (
+            "steady-state recompiles under the pipelined layout: "
+            f"{extras.get('pipeline_train_recompiles')}")
     if user_smoke and args.serve_smoke:
         # smoke mode doubles as the CI check that the serving lane's
         # headline keys didn't silently fall out (same contract as the
@@ -2108,6 +2381,10 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     # INSTEAD of the numbers
     dataplane_perf = ("dataplane_cps", "dataplane_input_wait_frac",
                       "dataplane_workers")
+    # PIPELINE lane perf keys under the same refusal rule; the parity /
+    # donation / recompile verdicts ride regardless
+    pipeline_perf = ("pipeline_cps_per_chip", "pipeline_bubble_frac",
+                     "pipeline_bubble_frac_analytic", "pipeline_stages")
     for key in ("trainer_vs_rawstep", "trainer_cps_chip", "trainer_mfu",
                 "mfu_analytic", "mfu_source", "mfu_peak_source",
                 "trainer_input_wait_frac", "obs_step_s",
@@ -2116,12 +2393,17 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                 "tsan_findings", "chaos_findings", "graphcheck_findings",
                 "mesh_parity",
                 "mesh_ckpt_portable", "multichip_train_recompiles",
-                *mc_perf, *fleet_perf, *dataplane_perf):
+                "pipeline_parity", "pipeline_donation_verified",
+                "pipeline_train_recompiles",
+                *mc_perf, *fleet_perf, *dataplane_perf, *pipeline_perf):
         if key in extras and not (
                 (key in mc_perf and "multichip_error" in extras)
                 or (key in fleet_perf and "fleet_error" in extras)
-                or (key in dataplane_perf and "dataplane_error" in extras)):
+                or (key in dataplane_perf and "dataplane_error" in extras)
+                or (key in pipeline_perf and "pipeline_error" in extras)):
             out[key] = extras[key]
+    if "pipeline_error" in extras:
+        out["pipeline_error"] = str(extras["pipeline_error"])[:120]
     if "multichip_error" in extras:
         out["multichip_error"] = str(extras["multichip_error"])[:120]
     if "fleet_error" in extras:
@@ -2190,6 +2472,13 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               "multichip_mfu", "multichip_forced_host",
               "multichip_train_recompiles", "multichip_error",
               "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
+              # the PIPELINE lane sheds after the multichip curve (its
+              # bubble-frac headline is this arc's acceptance metric) but
+              # before the fleet/dataplane/kbench groups
+              "pipeline_error", "pipeline_train_recompiles",
+              "pipeline_donation_verified", "pipeline_stages",
+              "pipeline_bubble_frac_analytic", "pipeline_parity",
+              "pipeline_bubble_frac", "pipeline_cps_per_chip",
               "fleet_error", "fleet_shed_frac", "swap_blackout_ms",
               "serve_p99_ms_under_load", "serve_rps",
               "dataplane_error", "dataplane_workers",

@@ -203,6 +203,20 @@ def check_dtype(closed_jaxpr, policy: str = "bf16",
                     taint[ov] = ((not isinstance(inner_o, jcore.Literal))
                                  and sub_taint.get(inner_o, False))
                 continue
+            if name == "shard_map":
+                # SPMD-manual region (parallel/pipeline.py's stage
+                # pipeline): the body rides as an OPEN Jaxpr param, which
+                # the generic ClosedJaxpr recursion below misses — the
+                # pipelined trunk would get zero dtype coverage. Operands
+                # map 1:1 (per-shard avals, same dtypes).
+                inner = eqn.params["jaxpr"]
+                sub_taint = {inner_v: get(iv) for iv, inner_v
+                             in zip(eqn.invars, inner.invars)}
+                walk(inner, sub_taint)
+                for ov, inner_o in zip(eqn.outvars, inner.outvars):
+                    taint[ov] = ((not isinstance(inner_o, jcore.Literal))
+                                 and sub_taint.get(inner_o, False))
+                continue
             subs = []
             for v in eqn.params.values():
                 subs.extend(_sub_closed(v))

@@ -51,6 +51,13 @@ _REDUCE_PRIMS = frozenset({
     "reduce_sum", "reduce_max", "reduce_min", "reduce_prod",
     "reduce_and", "reduce_or", "argmax", "argmin",
 })
+# cross-shard collectives: data movement, zero math. Registered so the
+# pipelined step's stage rotation (ppermute) and the gradient syncs can
+# never read as uncounted compute; tracked in eqn_counts["collective"].
+_COLLECTIVE_PRIMS = frozenset({
+    "ppermute", "pshuffle", "psum", "psum2", "pmax", "pmin", "pgather",
+    "all_gather", "all_to_all", "reduce_scatter", "axis_index",
+})
 
 
 def _prod(xs) -> int:
@@ -211,7 +218,7 @@ def jaxpr_flops(closed_jaxpr) -> Dict[str, Any]:
     counts = {"dot": 0.0, "conv": 0.0, "elementwise": 0.0, "reduce": 0.0,
               "pallas": 0.0}
     eqn_counts = {"dot_general": 0, "conv_general_dilated": 0,
-                  "pallas_call": 0}
+                  "pallas_call": 0, "collective": 0}
     caveats: List[str] = []
     unregistered: List[str] = []
     hook_errors: List[str] = []
@@ -254,6 +261,32 @@ def jaxpr_flops(closed_jaxpr) -> Dict[str, Any]:
             elif name == "scan":
                 inner = eqn.params["jaxpr"]
                 walk(inner.jaxpr, mult * int(eqn.params.get("length", 1)))
+            elif name == "shard_map":
+                # SPMD-manual region (parallel/pipeline.py's stage
+                # pipeline): the body is an OPEN Jaxpr param describing
+                # ONE shard's program — the generic ClosedJaxpr recursion
+                # below misses it, silently zeroing the whole pipelined
+                # trunk out of mfu_analytic. Every manual mesh slice runs
+                # the body once, so global FLOPs = body x manual-shard
+                # count. (This counts the pipeline's fill/drain garbage
+                # ticks too: they execute on the MXU, so they belong in
+                # an achieved-utilization numerator — the waste is
+                # reported separately as pipeline_bubble_frac.)
+                mesh = eqn.params.get("mesh")
+                auto = eqn.params.get("auto") or frozenset()
+                shards = 1
+                if mesh is not None:
+                    for ax, sz in dict(mesh.shape).items():
+                        if ax not in auto:
+                            shards *= int(sz)
+                walk(eqn.params["jaxpr"], mult * shards)
+            elif name in _COLLECTIVE_PRIMS:
+                # cross-shard data movement, zero math: ppermute is the
+                # pipeline's stage rotation, psum/all_gather the gradient
+                # sync. Counted for visibility, never as FLOPs — but
+                # REGISTERED here so a new collective can't fall into the
+                # generic recursion and look like an uncounted op.
+                eqn_counts["collective"] += 1
             elif name == "while":
                 # dynamic trip count: count the body ONCE, flag it
                 caveats.append("while_loop counted once (dynamic trip "

@@ -149,6 +149,34 @@ def build_targets(model: str = "tiny3d", smoke: bool = True,
         name="eval_step", fn=eval_step, args=(state, gb),
         donation="skip"))
 
+    # pipelined pretrain step (parallel/pipeline.py): donation must
+    # survive the stage shard_map + microbatch scan, the dtype pass must
+    # stay clean through the stage region (gc_dtype descends into the
+    # open shard_map jaxpr), and the analytic counter must cost the
+    # manual region (gc_flops's shard_map multiplier) so mfu_analytic
+    # doesn't silently deflate under the pipelined layout. Needs >= 2
+    # devices on the model axis — the forced-host PIPELINE bench child
+    # and tests/test_zpipeline.py run it; a 1-device gate skips it.
+    n_dev = len(jax.devices())
+    if n_dev >= 2 and n_dev % 2 == 0:
+        from pytorchvideo_accelerate_tpu.config import MeshConfig
+
+        psetup = build_step_setup(
+            "videomae_t_pretrain", frames=4, crop=32,
+            batch_per_chip=2, num_classes=num_classes,
+            mesh_cfg=MeshConfig(data=n_dev // 2, model=2),
+            pipeline_stages=2, pipeline_microbatches=2,
+            overrides={"dropout_rate": 0.0})
+        targets.append(CheckTarget(
+            name="train_step_pipelined", fn=psetup.step,
+            args=(psetup.state, psetup.device_batch(0), key),
+            donation="require", partitions=psetup.mesh.size,
+            # the cost model books the partitioner's resharding/select
+            # machinery for the manual region differently per backend;
+            # the disarmed dense target stays the parity authority (the
+            # guard-armed precedent)
+            flops_costmodel=False))
+
     if setup.pretrain:
         # no serving surface for a pretraining objective: the fleet
         # serves classifiers (export_inference is supervised-only)

@@ -52,6 +52,8 @@ HIGHER_BETTER = (
     "kbench_pw_x3d_res3_speedup",
     "kbench_conv133_sf_res4_speedup",
     "kbench_conv311_sf_res4_speedup",
+    # PIPELINE lane: pipelined clips/s/chip at the lane's P-stage point
+    "pipeline_cps_per_chip",
 )
 LOWER_BETTER = (
     "step_ms_blocked",
@@ -63,6 +65,8 @@ LOWER_BETTER = (
     "trainer_input_wait_frac",
     "obs_input_wait_frac",
     "trace_overhead_frac",
+    # PIPELINE lane: realized fill/drain idle fraction (two-point fit)
+    "pipeline_bubble_frac",
 )
 
 

@@ -46,6 +46,33 @@ class MeshConfig:
 
 
 @dataclass
+class ParallelConfig:
+    """Pipeline parallelism over the mesh's model axis
+    (parallel/pipeline.py; docs/PARALLELISM.md § pipeline).
+
+    ``pipeline_stages`` > 1 partitions the transformer trunk's block
+    stack into that many stages placed one per model-axis slice (the 2-D
+    train mesh's ``model`` axis, or ``tensor`` on the library mesh — the
+    stage count must equal that axis's size), and the train step streams
+    microbatches through the stages (1F1B-style steady-state occupancy;
+    plain autodiff replays the schedule backwards). Transformer families
+    only (mvit/videomae); on the 2-D train mesh the stages SPEND the
+    model axis, so they exclude Megatron TP and ring/ulysses CP there —
+    compose pipeline x CP on the library mesh (tensor=P, context=C)
+    instead. Checkpoints are layout-portable: the param tree is identical
+    to the unpipelined model, so a run saved under (data, P) resumes
+    unpipelined on (N, 1) or a single chip (trainer/checkpoint.py)."""
+
+    pipeline_stages: int = 1
+    # microbatches streamed through the stages per step; 0 = auto (reuse
+    # optim.gradient_accumulation_steps when accumulation is on — the
+    # batch already carries the micro axis — else 2 x stages). More
+    # microbatches amortize the fill/drain bubble:
+    # bubble = (P-1)/(M+P-1) per direction.
+    pipeline_microbatches: int = 0
+
+
+@dataclass
 class DataConfig:
     """Data pipeline knobs (reference `run.py:140-183` + transform stack R6)."""
 
@@ -428,6 +455,7 @@ class TrackingConfig:
 @dataclass
 class TrainConfig:
     mesh: MeshConfig = field(default_factory=MeshConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
     data: DataConfig = field(default_factory=DataConfig)
     model: ModelConfig = field(default_factory=ModelConfig)
     optim: OptimConfig = field(default_factory=OptimConfig)

@@ -145,7 +145,7 @@ def _r2plus1d_r50(cfg: ModelConfig, dtype, mesh=None):
 
 
 @register_model("mvit_b")
-def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
+def _mvit_b(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
     if cfg.attention not in ("dense", "pallas", "ring", "ulysses"):
         raise NotImplementedError(
             f"attention backend {cfg.attention!r} not available for mvit_b"
@@ -156,6 +156,7 @@ def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
         shard_mesh=mesh,  # block-boundary activation anchors (GSPMD)
+        pipeline=pipeline,  # SPMD stage pipeline (parallel/pipeline.py)
         depthwise_impl=cfg.depthwise_impl,
         remat=cfg.remat,
         dtype=dtype,
@@ -163,16 +164,17 @@ def _mvit_b(cfg: ModelConfig, dtype, mesh=None):
 
 
 @register_model("mvit_b_32x3")
-def _mvit_b_32x3(cfg: ModelConfig, dtype, mesh=None):
+def _mvit_b_32x3(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
     """Hub `mvit_base_32x3` (32 frames x stride 3): structurally the same
     MViT-B — the pos embeds are input-sized, so only the training recipe
     (drop_path 0.3) and sampling geometry differ. Run with
     --num_frames 32 --sampling_rate 3."""
-    return _mvit_b(cfg, dtype, mesh=mesh).clone(drop_path_rate=0.3)
+    return _mvit_b(cfg, dtype, mesh=mesh,
+                   pipeline=pipeline).clone(drop_path_rate=0.3)
 
 
 @register_model("videomae_b")
-def _videomae_b(cfg: ModelConfig, dtype, mesh=None):
+def _videomae_b(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
     """Fine-tune path of BASELINE config 5 (SSv2/K400 classification)."""
     return VideoMAEClassifier(
         num_classes=cfg.num_classes,
@@ -180,13 +182,14 @@ def _videomae_b(cfg: ModelConfig, dtype, mesh=None):
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
         shard_mesh=mesh,  # block-boundary activation anchors (GSPMD)
+        pipeline=pipeline,  # SPMD stage pipeline (parallel/pipeline.py)
         remat=cfg.remat,
         dtype=dtype,
     )
 
 
 @register_model("videomae_b_pretrain")
-def _videomae_b_pretrain(cfg: ModelConfig, dtype, mesh=None):
+def _videomae_b_pretrain(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
     """MAE pretraining path of BASELINE config 5 (self-supervised; the
     reference stack has no SSL path — run.py is supervised-only)."""
     return VideoMAEForPretraining(
@@ -194,8 +197,37 @@ def _videomae_b_pretrain(cfg: ModelConfig, dtype, mesh=None):
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
         shard_mesh=mesh,  # block-boundary activation anchors (GSPMD)
+        pipeline=pipeline,  # SPMD stage pipeline (parallel/pipeline.py)
         remat=cfg.remat,
         dtype=dtype,
+    )
+
+
+@register_model("videomae_t")
+def _videomae_t(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
+    """Deliberately tiny VideoMAE classifier (the `tiny3d` of the
+    transformer family): CI smokes, the bench PIPELINE lane, and the
+    chaos pipeline-preemption leg compile it in seconds on a CPU host.
+    Not a reference architecture."""
+    return VideoMAEClassifier(
+        num_classes=cfg.num_classes, dim=32, depth=4, num_heads=2,
+        tubelet=(2, 8, 8), dropout_rate=cfg.dropout_rate,
+        attention_backend=cfg.attention,
+        context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        shard_mesh=mesh, pipeline=pipeline, remat=cfg.remat, dtype=dtype,
+    )
+
+
+@register_model("videomae_t_pretrain")
+def _videomae_t_pretrain(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
+    """Tiny VideoMAE pretraining twin of `videomae_t` (depth 4 encoder /
+    depth 2 decoder — both divide by 2 stages, the encoder by 4)."""
+    return VideoMAEForPretraining(
+        dim=32, depth=4, num_heads=2, decoder_dim=16, decoder_depth=2,
+        decoder_heads=2, tubelet=(2, 8, 8), mask_ratio=cfg.mask_ratio,
+        attention_backend=cfg.attention,
+        context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        shard_mesh=mesh, pipeline=pipeline, remat=cfg.remat, dtype=dtype,
     )
 
 
@@ -203,7 +235,8 @@ def available_models():
     return sorted(_REGISTRY)
 
 
-def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None):
+def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None,
+                 pipeline=None):
     """Build the Flax module for `cfg.name`.
 
     `mixed_precision="bf16"` sets compute dtype bf16 with fp32 params — the
@@ -218,6 +251,12 @@ def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None):
     ordinary auto-sharded (jit) training code. The transformer families also
     use it for block-boundary activation sharding constraints
     (parallel/sharding.constrain_block).
+
+    `pipeline`: an ACTIVE parallel/pipeline.PipelinePlan routes the
+    transformer trunk's block stack through the SPMD stage pipeline
+    (parallel.pipeline_stages > 1). Transformer families only — a family
+    whose builder has no stage-cut seam (the conv nets) refuses loudly
+    instead of silently training unpipelined.
     """
     if cfg.name not in _REGISTRY:
         raise ValueError(f"unknown model {cfg.name!r}; available: {available_models()}")
@@ -237,13 +276,28 @@ def create_model(cfg: ModelConfig, mixed_precision: str = "bf16", mesh=None):
     dtype = policy_compute_dtype(mixed_precision)
     builder = _REGISTRY[cfg.name]
     # user-registered builders may use the original (cfg, dtype) signature;
-    # pass the mesh only to builders that declare a parameter named "mesh"
+    # pass the mesh/pipeline only to builders that declare the parameter
     try:
-        takes_mesh = "mesh" in inspect.signature(builder).parameters
+        params = inspect.signature(builder).parameters
     except (TypeError, ValueError):
-        takes_mesh = False
+        params = {}
+    takes_mesh = "mesh" in params
+    takes_pipeline = "pipeline" in params
+    active_pipeline = pipeline is not None and getattr(pipeline, "active",
+                                                       False)
+    if active_pipeline and not takes_pipeline:
+        raise ValueError(
+            f"model {cfg.name!r} has no pipeline stage-cut seam "
+            "(parallel.pipeline_stages > 1 needs a transformer block "
+            "stack — mvit/videomae families); conv families spend the "
+            "model axis on replication, not stages")
+    kwargs = {}
     if takes_mesh:
-        return builder(cfg, dtype, mesh=mesh)
+        kwargs["mesh"] = mesh
+    if takes_pipeline:
+        kwargs["pipeline"] = pipeline
+    if kwargs:
+        return builder(cfg, dtype, **kwargs)
     return builder(cfg, dtype)
 
 

@@ -34,6 +34,11 @@ from flax import linen as nn
 from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
 from pytorchvideo_accelerate_tpu.precision import f32_island
 from pytorchvideo_accelerate_tpu.ops.depthwise import DepthwiseConv3D
+from pytorchvideo_accelerate_tpu.parallel.pipeline import (
+    PipelinePlan,
+    apply_pipelined_blocks,
+    stage_cuts,
+)
 from pytorchvideo_accelerate_tpu.parallel.sharding import constrain_block
 
 Dtype = Any
@@ -224,9 +229,51 @@ class MViT(nn.Module):
     # between blocks instead of drifting through pooled/resharded
     # intermediates. None (single-device use, conversion parity) = no-op.
     shard_mesh: Optional[Any] = None
+    # SPMD pipeline over the mesh's model axis (parallel/pipeline.py).
+    # MViT's block stack must be HOMOGENEOUS for the stage scan — the
+    # default multiscale schedule (stage_starts dim/head doubling,
+    # q-pooling, per-block drop-path) is not, and `pipeline_cut_check`
+    # says exactly why; a uniform configuration (stage_starts=(),
+    # drop_path_rate=0) pipelines. The token grid stays un-sharded inside
+    # the region, so the context-parallel attention backends don't
+    # compose with a pipelined MViT (use dense/pallas).
+    pipeline: Optional[PipelinePlan] = None
     depthwise_impl: str = "conv"  # conv | shift (ops/depthwise.py)
     remat: bool = False  # per-block jax.checkpoint: boundary activations only
     dtype: Any = jnp.float32
+
+    def pipeline_cut_check(self, stages: int) -> tuple:
+        """Validate that this configuration's block stack can be cut into
+        `stages` equal pipeline stages, returning the (uniform) block
+        schedule entry. Raises ValueError naming the first obstruction —
+        the stage-cut contract for heterogeneous multiscale trunks."""
+        stage_cuts(self.depth, stages)  # divisibility first
+        if self.stage_starts:
+            raise ValueError(
+                "mvit pipeline_stages>1 needs a homogeneous block stack, "
+                f"but stage_starts={tuple(self.stage_starts)} double dims/"
+                "heads and q-pool the token grid at those blocks — the "
+                "per-stage param trees and activation shapes differ, so "
+                "no equal stage cut exists. Pipeline the videomae trunk, "
+                "or configure a uniform MViT (stage_starts=()); see "
+                "docs/PARALLELISM.md § pipeline")
+        if self.drop_path_rate > 0:
+            raise ValueError(
+                "mvit pipeline_stages>1 needs rng-free, per-block-"
+                f"identical blocks; drop_path_rate={self.drop_path_rate} "
+                "gives every block its own stochastic-depth rate (and an "
+                "rng stream) — set model.dropout/drop_path off to "
+                "pipeline this trunk")
+        if self.attention_backend in ("ring", "ulysses"):
+            raise ValueError(
+                "mvit pipeline_stages>1 does not compose with the "
+                f"context-parallel attention backend "
+                f"{self.attention_backend!r}: the pipelined region keeps "
+                "MViT's (B,T,H,W,C) token grid un-sharded — use dense/"
+                "pallas attention, or pipeline the videomae trunk where "
+                "CP composes on the library mesh")
+        return (self.embed_dim, self.num_heads, (1, 1, 1),
+                tuple(self.initial_kv_stride))
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -254,28 +301,50 @@ class MViT(nn.Module):
         dim, heads = self.embed_dim, self.num_heads
         kv_stride = list(self.initial_kv_stride)
         dpr = [self.drop_path_rate * i / max(self.depth - 1, 1) for i in range(self.depth)]
-        # train is static (python control flow in _drop_path)
-        block_cls = (nn.remat(MViTBlock, static_argnums=(2,)) if self.remat
-                     else MViTBlock)
-        for i in range(self.depth):
-            if i in self.stage_starts:
-                heads *= 2
-                q_stride = (1, 2, 2)
-                kv_stride = [max(s // 2, 1) if j > 0 else s
-                             for j, s in enumerate(kv_stride)]
-            else:
-                q_stride = (1, 1, 1)
-            dim_out = dim * 2 if (i + 1) in self.stage_starts else dim
-            x = block_cls(
-                dim_out=dim_out, num_heads=heads, q_stride=q_stride,
-                kv_stride=tuple(kv_stride), mlp_ratio=self.mlp_ratio,
-                drop_path=dpr[i], attention_backend=self.attention_backend,
-                context_axis=self.context_axis, context_mesh=self.context_mesh,
-                depthwise_impl=self.depthwise_impl,
-                dtype=self.dtype, name=f"block{i}",
-            )(x, train)
-            x = constrain_block(x, self.shard_mesh)  # no-op without a mesh
-            dim = dim_out
+        plan = self.pipeline
+        pipelined = plan is not None and plan.active
+        if pipelined:
+            # validated on EVERY path (init included) so a heterogeneous
+            # config fails at construction, not deep inside shard_map
+            u_dim, u_heads, u_q, u_kv = self.pipeline_cut_check(plan.stages)
+        if pipelined and not self.is_initializing():
+            template = MViTBlock(
+                dim_out=u_dim, num_heads=u_heads, q_stride=u_q,
+                kv_stride=u_kv, mlp_ratio=self.mlp_ratio, drop_path=0.0,
+                attention_backend=self.attention_backend,
+                context_axis=None, context_mesh=None,
+                depthwise_impl=self.depthwise_impl, dtype=self.dtype)
+            # train is static; drop_path is validated 0, so the block fn
+            # is rng-free as the schedule scan requires
+            x = apply_pipelined_blocks(self, x, prefix="block",
+                                       depth=self.depth,
+                                       template=template, plan=plan,
+                                       apply_args=(train,))
+        else:
+            # train is static (python control flow in _drop_path)
+            block_cls = (nn.remat(MViTBlock, static_argnums=(2,))
+                         if self.remat else MViTBlock)
+            for i in range(self.depth):
+                if i in self.stage_starts:
+                    heads *= 2
+                    q_stride = (1, 2, 2)
+                    kv_stride = [max(s // 2, 1) if j > 0 else s
+                                 for j, s in enumerate(kv_stride)]
+                else:
+                    q_stride = (1, 1, 1)
+                dim_out = dim * 2 if (i + 1) in self.stage_starts else dim
+                x = block_cls(
+                    dim_out=dim_out, num_heads=heads, q_stride=q_stride,
+                    kv_stride=tuple(kv_stride), mlp_ratio=self.mlp_ratio,
+                    drop_path=dpr[i],
+                    attention_backend=self.attention_backend,
+                    context_axis=self.context_axis,
+                    context_mesh=self.context_mesh,
+                    depthwise_impl=self.depthwise_impl,
+                    dtype=self.dtype, name=f"block{i}",
+                )(x, train)
+                x = constrain_block(x, self.shard_mesh)  # no-op sans mesh
+                dim = dim_out
 
         x = nn.LayerNorm(dtype=self.dtype, name="norm")(x)
         x = jnp.mean(x, axis=(1, 2, 3))

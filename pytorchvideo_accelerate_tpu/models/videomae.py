@@ -42,6 +42,10 @@ from flax import linen as nn
 
 from pytorchvideo_accelerate_tpu.ops.attention import dot_product_attention
 from pytorchvideo_accelerate_tpu.precision import f32_island
+from pytorchvideo_accelerate_tpu.parallel.pipeline import (
+    PipelinePlan,
+    apply_pipelined_blocks,
+)
 from pytorchvideo_accelerate_tpu.parallel.sharding import constrain_block
 
 Dtype = Any
@@ -65,13 +69,20 @@ def sincos_pos_embed(n_pos: int, dim: int) -> np.ndarray:
 
 
 class ViTBlock(nn.Module):
-    """Standard pre-LN transformer block (attention backend routable)."""
+    """Standard pre-LN transformer block (attention backend routable).
+
+    `context_axis`: the already-inside-a-shard_map calling convention for
+    the context-parallel backends (ops/attention.py) — the pipelined
+    trunk (parallel/pipeline.py) runs its blocks inside a shard_map, so
+    ring/ulysses attention there must use the bound axis name instead of
+    opening a nested shard_map region via `context_mesh`."""
 
     dim: int
     num_heads: int
     mlp_ratio: float = 4.0
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
+    context_axis: Optional[str] = None
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -85,6 +96,7 @@ class ViTBlock(nn.Module):
         attn = dot_product_attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
             backend=self.attention_backend, mesh=self.context_mesh,
+            axis_name=self.context_axis,
         ).reshape(B, N, self.dim)
         x = x + nn.Dense(self.dim, dtype=self.dtype, name="proj")(attn)
 
@@ -114,6 +126,41 @@ class CubeEmbed(nn.Module):
         return x.reshape(B, t * h * w, self.dim), (t, h, w)
 
 
+def run_vit_blocks(mod: nn.Module, tokens, *, prefix: str, depth: int,
+                   dim: int, num_heads: int,
+                   pipeline: Optional[PipelinePlan]):
+    """Run a named stack of ViTBlocks, pipelined when a plan is active.
+
+    The pipelined path reads the blocks' param subtrees straight off the
+    bound module's variables — the SAME `block{i}` trees the plain loop
+    trains — and drives them through `parallel.pipeline.pipeline_blocks`
+    as a pure per-block function, so the param tree (and therefore every
+    checkpoint and converted artifact) is identical across the knob; at
+    init (and with no active plan) the plain loop runs and creates those
+    params. Inside the pipelined region the blocks use the
+    `context_axis` attention convention (already inside a shard_map;
+    `plan.cp_axis` is only set when CP composes on the library mesh)."""
+    plan = pipeline
+    if plan is not None and plan.active and not mod.is_initializing():
+        template = ViTBlock(
+            dim=dim, num_heads=num_heads,
+            attention_backend=mod.attention_backend,
+            context_mesh=None, context_axis=plan.cp_axis, dtype=mod.dtype)
+        return apply_pipelined_blocks(mod, tokens, prefix=prefix,
+                                      depth=depth, template=template,
+                                      plan=plan)
+    block_cls = nn.remat(ViTBlock) if mod.remat else ViTBlock
+    for i in range(depth):
+        tokens = block_cls(
+            dim=dim, num_heads=num_heads,
+            attention_backend=mod.attention_backend,
+            context_mesh=mod.context_mesh, dtype=mod.dtype,
+            name=f"{prefix}{i}",
+        )(tokens)
+        tokens = constrain_block(tokens, mod.shard_mesh)
+    return tokens
+
+
 class VideoMAEEncoder(nn.Module):
     """ViT encoder over (a subset of) cube tokens."""
 
@@ -128,6 +175,11 @@ class VideoMAEEncoder(nn.Module):
     # batch-over-data layout between blocks under the (data, model) train
     # mesh. None (single-device use, conversion parity) = no-op.
     shard_mesh: Optional[Any] = None
+    # SPMD pipeline over the mesh's model axis (parallel/pipeline.py): an
+    # active plan streams microbatches through P contiguous-block stages
+    # instead of the plain loop. Param tree identical either way (the
+    # plan is a lowering choice — checkpoints interchange).
+    pipeline: Optional[PipelinePlan] = None
     remat: bool = False  # per-block jax.checkpoint: boundary activations only
     final_norm: bool = True  # off for mean-pooling classifiers (fc_norm after
     # the pool instead — the official VideoMAE fine-tune arrangement)
@@ -144,15 +196,10 @@ class VideoMAEEncoder(nn.Module):
         tokens = tokens + pos.astype(tokens.dtype)
         if keep_idx is not None:
             tokens = jnp.take_along_axis(tokens, keep_idx[..., None], axis=1)
-        block_cls = nn.remat(ViTBlock) if self.remat else ViTBlock
-        for i in range(self.depth):
-            tokens = block_cls(
-                dim=self.dim, num_heads=self.num_heads,
-                attention_backend=self.attention_backend,
-                context_mesh=self.context_mesh, dtype=self.dtype,
-                name=f"block{i}",
-            )(tokens)
-            tokens = constrain_block(tokens, self.shard_mesh)
+        tokens = run_vit_blocks(self, tokens, prefix="block",
+                                depth=self.depth, dim=self.dim,
+                                num_heads=self.num_heads,
+                                pipeline=self.pipeline)
         if self.final_norm:
             tokens = nn.LayerNorm(dtype=self.dtype, name="norm")(tokens)
         return tokens, (t, h, w)
@@ -209,6 +256,11 @@ class VideoMAEForPretraining(nn.Module):
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
     shard_mesh: Optional[Any] = None  # block-boundary constraints (no-op when None)
+    # pipeline plan (parallel/pipeline.py): applied to the encoder stack
+    # (depth must divide by the stage count), and to the decoder stack
+    # too when `decoder_depth` divides — otherwise the narrow decoder
+    # runs unpipelined (replicated over the model axis, the status quo)
+    pipeline: Optional[PipelinePlan] = None
     remat: bool = False
     dtype: Dtype = jnp.float32
 
@@ -227,7 +279,7 @@ class VideoMAEForPretraining(nn.Module):
             dim=self.dim, depth=self.depth, num_heads=self.num_heads,
             tubelet=self.tubelet, attention_backend=self.attention_backend,
             context_mesh=self.context_mesh, shard_mesh=self.shard_mesh,
-            remat=self.remat,
+            pipeline=self.pipeline, remat=self.remat,
             dtype=self.dtype, name="encoder",
         )(x, keep_idx)                                   # (B, n_vis, dim)
 
@@ -250,15 +302,18 @@ class VideoMAEForPretraining(nn.Module):
              mask_token.astype(dec_in.dtype) + msk_pos.astype(dec_in.dtype)],
             axis=1,
         )                                               # (B, n, dec_dim)
-        dec_block_cls = nn.remat(ViTBlock) if self.remat else ViTBlock
-        for i in range(self.decoder_depth):
-            dec_tokens = dec_block_cls(
-                dim=self.decoder_dim, num_heads=self.decoder_heads,
-                attention_backend=self.attention_backend,
-                context_mesh=self.context_mesh, dtype=self.dtype,
-                name=f"dec_block{i}",
-            )(dec_tokens)
-            dec_tokens = constrain_block(dec_tokens, self.shard_mesh)
+        # decoder stack: pipelined only when its (narrow, shallow) depth
+        # divides into the plan's stages — a 4-block decoder rides P=2/4
+        # pipelines and silently stays unpipelined elsewhere
+        dec_plan = (self.pipeline
+                    if (self.pipeline is not None
+                        and self.pipeline.covers(self.decoder_depth))
+                    else None)
+        dec_tokens = run_vit_blocks(self, dec_tokens, prefix="dec_block",
+                                    depth=self.decoder_depth,
+                                    dim=self.decoder_dim,
+                                    num_heads=self.decoder_heads,
+                                    pipeline=dec_plan)
         dec_tokens = nn.LayerNorm(dtype=self.dtype, name="dec_norm")(dec_tokens)
         pred = nn.Dense(tt * p * p * 3, dtype=jnp.float32, name="dec_pred")(
             f32_island(dec_tokens[:, enc.shape[1]:])
@@ -295,6 +350,7 @@ class VideoMAEClassifier(nn.Module):
     attention_backend: str = "dense"
     context_mesh: Optional[Any] = None
     shard_mesh: Optional[Any] = None  # block-boundary constraints (no-op when None)
+    pipeline: Optional[PipelinePlan] = None  # parallel/pipeline.py plan
     remat: bool = False
     dtype: Dtype = jnp.float32
 
@@ -304,7 +360,7 @@ class VideoMAEClassifier(nn.Module):
             dim=self.dim, depth=self.depth, num_heads=self.num_heads,
             tubelet=self.tubelet, attention_backend=self.attention_backend,
             context_mesh=self.context_mesh, shard_mesh=self.shard_mesh,
-            remat=self.remat,
+            pipeline=self.pipeline, remat=self.remat,
             final_norm=False, dtype=self.dtype, name="encoder",
         )(x)
         feat = tokens.mean(axis=1)

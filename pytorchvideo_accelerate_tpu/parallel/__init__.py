@@ -36,3 +36,11 @@ from pytorchvideo_accelerate_tpu.parallel.distributed import (  # noqa: F401
     process_count,
     process_index,
 )
+from pytorchvideo_accelerate_tpu.parallel.pipeline import (  # noqa: F401
+    PipelinePlan,
+    analytic_bubble_frac,
+    make_plan as make_pipeline_plan,
+    pipeline_blocks,
+    stage_cuts,
+    stage_tag,
+)

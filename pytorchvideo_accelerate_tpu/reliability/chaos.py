@@ -494,6 +494,115 @@ def leg_preempt_mesh(report: dict, tmpdir: str, seed: int, log: Log) -> None:
         f"step and finished at {b['steps']}")
 
 
+# subprocess body for both phases of leg_preempt_pipeline: VideoMAE-tiny
+# PRETRAIN on a (data, model) train mesh, pipelined over the model axis in
+# the kill phase (parallel/pipeline.py) and UNPIPELINED on the reshaped
+# resume mesh — the checkpoint-interchange contract the pipeline's
+# param-tree identity exists to keep. Same forcehost one-JSON-line shape
+# as _MESH_LEG_CODE.
+_PIPELINE_LEG_CODE = """
+import json, os, sys, threading, time
+import jax
+jax.config.update("jax_platforms", "cpu")
+from pytorchvideo_accelerate_tpu.config import (
+    CheckpointConfig, DataConfig, MeshConfig, ModelConfig, OptimConfig,
+    ParallelConfig, TrainConfig)
+from pytorchvideo_accelerate_tpu.reliability.preemption import (
+    get_guard, read_emergency_record)
+from pytorchvideo_accelerate_tpu.trainer.loop import Trainer
+
+outdir, kill, data_ax, model_ax, stages, bsz, seed = (
+    {outdir!r}, {kill!r} == "kill", {data_ax}, {model_ax}, {stages},
+    {bsz}, {seed})
+cfg = TrainConfig(
+    mesh=MeshConfig(data=data_ax, model=model_ax),
+    parallel=ParallelConfig(pipeline_stages=stages,
+                            pipeline_microbatches=2 if stages > 1 else 0),
+    model=ModelConfig(name="videomae_t_pretrain", num_classes=4,
+                      dropout_rate=0.0),
+    data=DataConfig(synthetic=True, synthetic_num_videos=16, num_frames=4,
+                    crop_size=32, batch_size=bsz, num_workers=1,
+                    limit_val_batches=1),
+    optim=OptimConfig(num_epochs=2, lr=0.01),
+    checkpoint=CheckpointConfig(output_dir=outdir,
+                                resume_from_checkpoint="" if kill
+                                else "auto"),
+    seed=seed,
+)
+tr = Trainer(cfg)
+found = (tr.checkpointer.latest_step()
+         if (not kill and tr.checkpointer) else None)
+if kill:
+    get_guard().install()  # never race the dump-only default handler
+    t = threading.Thread(
+        target=lambda: (time.sleep(0.5),
+                        os.kill(os.getpid(), __import__("signal").SIGTERM)),
+        daemon=True)
+    t.start()
+res = tr.fit()
+rec = read_emergency_record(outdir)
+out = {{"mesh": [data_ax, model_ax], "stages": stages,
+        "preempted": bool(res.get("preempted")),
+        "steps": res.get("steps"), "total": tr.total_steps,
+        "emergency_step": rec and rec.get("step"), "found": found,
+        "bubble_frac": res.get("pipeline_bubble_frac_analytic")}}
+print("\\n" + json.dumps(out))
+"""
+
+
+def leg_preempt_pipeline(report: dict, tmpdir: str, seed: int,
+                         log: Log) -> None:
+    """Leg 14 — preemption grace across a PIPELINE layout change: SIGTERM
+    mid-pipelined-epoch on a (2, 2) mesh running the VideoMAE pretrain
+    trunk as a 2-stage pipeline, emergency save, `resume=auto` on (4, 1)
+    UNPIPELINED lands on the exact step and finishes. Extends
+    leg_preempt_mesh to the pipelined layout: the stage pipeline keeps
+    its param tree identical to the plain model (parallel/pipeline.py),
+    so the reshaped restore needs no conversion — this leg is the
+    runtime proof. Both phases keep the same GLOBAL batch (per-shard
+    batch_size compensates for the data-axis change), so steps/epoch and
+    the resume arithmetic line up across layouts."""
+    from pytorchvideo_accelerate_tpu.utils.forcehost import run_forced_host
+
+    leg = _leg(report, "preempt_pipeline")
+    outdir = os.path.join(tmpdir, "pipeline_run")
+
+    def phase(kill: str, shape, stages: int, bsz: int) -> dict:
+        return run_forced_host(
+            _PIPELINE_LEG_CODE.format(outdir=outdir, kill=kill,
+                                      data_ax=shape[0], model_ax=shape[1],
+                                      stages=stages, bsz=bsz, seed=seed),
+            _MESH_LEG_DEVICES, timeout=420.0)
+
+    # global batch 8 in both phases: (2,2) pipelined at 4/shard,
+    # (4,1) unpipelined at 2/shard
+    a = phase("kill", _MESH_LEG_TRAIN, stages=2, bsz=4)
+    leg["train"] = a
+    if not a.get("preempted"):
+        _finding(report, "preempt_pipeline",
+                 "SIGTERM did not take the grace path on the pipelined "
+                 "(2,2) mesh")
+        return
+    if not a.get("emergency_step"):
+        _finding(report, "preempt_pipeline",
+                 "no emergency checkpoint record from the pipelined run")
+        return
+    b = phase("resume", _MESH_LEG_RESUME, stages=1, bsz=2)
+    leg["resume"] = b
+    if b.get("found") != a["emergency_step"]:
+        _finding(report, "preempt_pipeline",
+                 f"resume=auto unpipelined on the reshaped mesh found step "
+                 f"{b.get('found')}, emergency saved {a['emergency_step']}")
+    if b.get("preempted") or (b.get("steps") or 0) < a["emergency_step"]:
+        _finding(report, "preempt_pipeline",
+                 f"unpipelined resume did not complete: {b}")
+        return
+    log(f"[chaos] preempt_pipeline: SIGTERM at step {a['emergency_step']} "
+        f"on pipelined mesh {a['mesh']} (P={a['stages']}), resume=auto "
+        f"unpipelined on {b['mesh']} landed on the same step and finished "
+        f"at {b['steps']}")
+
+
 # serving-control-plane engine double (bucket geometry + a host-side
 # forward slow enough to build a queue; no jax — the serving legs measure
 # the control plane, not the chip) — the shared serving/stub.py double
@@ -1233,6 +1342,7 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                     (leg_guard_nan, (report, tmpdir, seed, log)),
                     (leg_preempt, (report, tmpdir, seed, log)),
                     (leg_preempt_mesh, (report, tmpdir, seed, log)),
+                    (leg_preempt_pipeline, (report, tmpdir, seed, log)),
             ):
                 try:
                     fn(*args)

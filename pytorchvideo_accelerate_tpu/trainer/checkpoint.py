@@ -206,7 +206,12 @@ class Checkpointer:
         mesh, never the one the checkpoint was written under — orbax
         reshards at read time — so a run saved on a (1, N) train mesh
         resumes on (N, 1) or single-chip at the same step with identical
-        values (docs/PARALLELISM.md runbook). When a template leaf is
+        values (docs/PARALLELISM.md runbook). The pipeline knob rides the
+        same contract: a (data, P)-pipelined run's param tree is
+        byte-identical to the unpipelined model's (parallel/pipeline.py),
+        so it restores unpipelined on any shape — and back — with no
+        conversion (tests/test_zpipeline.py round-trip; chaos leg
+        preempt_pipeline). When a template leaf is
         already a committed array on this mesh (the trainer's
         freshly-built, shard_state-settled state), its OWN sharding is the
         target — that keeps every leaf bit-identical in layout to the

@@ -41,6 +41,11 @@ from pytorchvideo_accelerate_tpu.parallel.mesh import (
     make_train_mesh,
     model_axis,
 )
+from pytorchvideo_accelerate_tpu.parallel.pipeline import (
+    analytic_bubble_frac,
+    make_plan as make_pipeline_plan,
+    stage_tag,
+)
 from pytorchvideo_accelerate_tpu.parallel.sharding import (
     family_uses_tp,
     shard_params,
@@ -198,9 +203,26 @@ class Trainer:
         cp_spends_model_axis = (
             self._cp and m_axis is not None and cp_axis(self.mesh) == m_axis
         )
-        self._tp = family_uses_tp(cfg.model.name) and not cp_spends_model_axis
+        # pipeline parallelism (parallel/pipeline.py): stages SPEND the
+        # model axis — params stay replicated over it (no Megatron TP),
+        # and on the 2-D train mesh CP is excluded too (make_plan raises);
+        # on the library mesh CP keeps its own "context" axis and composes
+        self.pipeline_plan = None
+        if cfg.parallel.pipeline_stages > 1:
+            self.pipeline_plan = make_pipeline_plan(
+                self.mesh, cfg.parallel.pipeline_stages,
+                microbatches=cfg.parallel.pipeline_microbatches,
+                accum_steps=cfg.optim.gradient_accumulation_steps,
+                cp_axis_name=cp_axis(self.mesh) if self._cp else None,
+            )
+        pipelined = self.pipeline_plan is not None
+        self._tp = (family_uses_tp(cfg.model.name)
+                    and not cp_spends_model_axis and not pipelined)
         m_size = self.mesh.shape[m_axis] if m_axis else 1
-        mode = ("context-parallel" if cp_spends_model_axis
+        mode = (f"pipelined ({self.pipeline_plan.stages} stages, "
+                f"{self.pipeline_plan.microbatches} microbatches)"
+                if pipelined
+                else "context-parallel" if cp_spends_model_axis
                 else "tensor-parallel" if self._tp else "replicated")
         main_print(
             f"mesh: {dict(self.mesh.shape)} over {self.mesh.size} "
@@ -481,7 +503,17 @@ class Trainer:
         cfg = self.cfg
         if not cfg.model.num_classes:
             cfg.model.num_classes = self.num_classes
-        self.model = create_model(cfg.model, cfg.mixed_precision, mesh=self.mesh)
+        self.model = create_model(cfg.model, cfg.mixed_precision,
+                                  mesh=self.mesh,
+                                  pipeline=self.pipeline_plan)
+        # eval scores through an UNPIPELINED twin (identical param tree —
+        # the plan is a lowering choice, not a param-tree one): eval is
+        # forward-only, so there are no stored activations to fit, and the
+        # val loader's ragged/padded tail batches need not divide into the
+        # plan's microbatches
+        self.eval_model = (create_model(cfg.model, cfg.mixed_precision,
+                                        mesh=self.mesh)
+                           if self.pipeline_plan is not None else self.model)
 
         spec = model_input_spec(cfg.model, cfg.data)
         import jax.numpy as jnp
@@ -490,7 +522,19 @@ class Trainer:
             sample = (jnp.zeros(spec["slow"]), jnp.zeros(spec["fast"]))
         else:
             sample = jnp.zeros(spec["video"])
-        variables = self.model.init(self.rng.init_key(), sample)
+        # init through a mesh-free twin on multi-device meshes: the param
+        # tree is identical by contract (mesh/pipeline are lowering
+        # choices — init values depend only on module structure + rng),
+        # and the transformer families' block-boundary sharding
+        # constraints would reject the batch-1 init sample on a data>1
+        # TRAIN mesh (its leading dim can't divide the data axis — the
+        # pipelined-videomae configuration hit this). The CP backends
+        # keep the original mesh'd init: they REQUIRE the mesh at
+        # construction, and their library-mesh init predates this twin.
+        init_model = (create_model(cfg.model, cfg.mixed_precision)
+                      if self.mesh.size > 1 and not self._cp
+                      else self.model)
+        variables = init_model.init(self.rng.init_key(), sample)
 
         steps_per_epoch = self.train_loader.steps_per_epoch()
         # T_max semantics: optimizer steps over the whole run (run.py:193-195,
@@ -585,8 +629,10 @@ class Trainer:
                 ema_decay=cfg.optim.ema_decay,
                 health_metrics=self.obs_on,
                 guard_skip=cfg.guard.enabled,
+                pipeline=self.pipeline_plan,
             )
-            self.eval_step = make_pretrain_eval_step(self.model, self.mesh)
+            self.eval_step = make_pretrain_eval_step(self.eval_model,
+                                                     self.mesh)
         else:
             self.train_step = make_train_step(
                 self.model, self.tx, self.mesh,
@@ -600,9 +646,10 @@ class Trainer:
                 ema_decay=cfg.optim.ema_decay,
                 health_metrics=self.obs_on,
                 guard_skip=cfg.guard.enabled,
+                pipeline=self.pipeline_plan,
             )
             self.eval_step = make_eval_step(
-                self.model, self.mesh,
+                self.eval_model, self.mesh,
                 label_smoothing=cfg.optim.label_smoothing,
                 device_normalize=self._device_normalize,
             )
@@ -1004,9 +1051,24 @@ class Trainer:
         tguard = self.train_guard
         hang_watch = self.watchdog  # collective-hang attribution source
         host_tag = hangcheck_host_tag() if hang_watch is not None else ""
+        if hang_watch is not None and self.pipeline_plan is not None:
+            # pipelined layout: the step's stage-boundary collectives
+            # (ppermute rotations, the stage-output reduce) are what a
+            # wedged dispatch is actually stuck in, so the section detail
+            # names the stage slice this host computes — the dump then
+            # reads "stage i/P" before the external kill
+            host_tag = (f"{host_tag} "
+                        f"stage={stage_tag(self.mesh)}").strip()
         # distributed tracing: hoisted armed check — disarmed, the step
         # loop pays one bool test per step (obs.trace.NOOP is shared)
         traced = obs.trace.get_tracer() is not None
+        # per-stage span attribution (pipelined runs): sampled train_step
+        # trace roots carry the stage slice this process computes, so a
+        # merged multi-host timeline separates stage timing without any
+        # extra span nesting (nested consumer spans would double-count in
+        # the window sum-to-wall contract)
+        trace_tags = ({"stage": stage_tag(self.mesh)}
+                      if self.pipeline_plan is not None else {})
         window_t0 = time.perf_counter()
         try:
             # while (not for): a guard rollback restores an EARLIER
@@ -1064,7 +1126,7 @@ class Trainer:
                     # StepTraceAnnotation window carries, so a merged
                     # timeline and an XLA trace correlate by gstep
                     with (obs.trace.root("train_step", epoch=epoch,
-                                         gstep=gstep)
+                                         gstep=gstep, **trace_tags)
                           if traced else nullcontext()):
                         with (hang_watch.section(
                                 "collective",
@@ -1251,6 +1313,27 @@ class Trainer:
                     # the key stays present so consumers see "unknown"
                     # instead of a missing-key failure, and never a lying 0
                     last_perf["train_recompiles"] = recompile_guard.sample()
+                    if self.pipeline_plan is not None:
+                        # the analytic schedule numbers (the MEASURED
+                        # bubble comes from the bench lane's two-point
+                        # (M, 2M) timing fit — a single run can't separate
+                        # fill/drain idle from per-tick compute)
+                        plan = self.pipeline_plan
+                        # host ints by construction (PipelinePlan fields)
+                        last_perf["pipeline_stages"] = plan.stages
+                        last_perf["pipeline_microbatches"] = (
+                            plan.microbatches)
+                        last_perf["pipeline_bubble_frac_analytic"] = (
+                            analytic_bubble_frac(plan.stages,
+                                                 plan.microbatches))
+                        last_perf["pipeline_cps_per_chip"] = (
+                            last_perf["clips_per_sec"] / self.mesh.size)
+                        if self.obs_on:
+                            obs.get_registry().gauge(
+                                "pva_pipeline_bubble_frac",
+                                "pipeline fill/drain idle fraction, "
+                                "analytic (P-1)/(M+P-1)",
+                            ).set(last_perf["pipeline_bubble_frac_analytic"])
                     if tguard is not None:
                         # guard verdicts ride the perf dict -> bench
                         # headline; a clean run asserts both are 0

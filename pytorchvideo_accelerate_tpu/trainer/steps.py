@@ -130,6 +130,22 @@ def _topk_correct(logits, labels, mask, k: int = 5):
     return (hit * mask).sum()
 
 
+def _fold_micro_axis(batch: dict) -> dict:
+    """Fold the leading (G, B, ...) accumulation micro axis into the batch
+    dim — (G*B, ...). The pipelined step (parallel/pipeline.py) consumes
+    the WHOLE effective batch in one forward and re-slices it into the
+    plan's microbatches inside the stage schedule, so the outer
+    accumulation scan (which would serialize a full pipeline fill+drain
+    per micro-step) disappears; the loss over the folded batch equals the
+    mean of per-micro losses, and its gradient equals the accumulated
+    gradient over G micro-steps divided by G — the same update (bitwise
+    on the rng-free supervised path; an rng objective like the VideoMAE
+    tube mask draws ONE stream per effective batch here instead of one
+    per micro-step — both valid samplings, not a numerics drift)."""
+    return jax.tree.map(
+        lambda x: x.reshape((x.shape[0] * x.shape[1],) + x.shape[2:]), batch)
+
+
 def _make_update_step(
     grad_fn: Callable,
     tx: optax.GradientTransformation,
@@ -141,6 +157,7 @@ def _make_update_step(
     ema_decay: float = 0.0,
     health_metrics: bool = False,
     guard_skip: bool = False,
+    pipeline=None,
 ) -> Callable:
     """Shared machinery of the supervised and self-supervised steps.
 
@@ -159,12 +176,26 @@ def _make_update_step(
     detector decides whether to escalate. A data-dependent select on a
     static predicate shape: no recompile, one extra `metrics["skipped"]`
     flag. Off (the default): the branch is not traced at all —
-    structurally zero overhead."""
+    structurally zero overhead.
+
+    `pipeline` (parallel/pipeline.PipelinePlan, active): the model's trunk
+    runs as a P-stage SPMD pipeline, and the microbatch STREAM through the
+    stages replaces the outer accumulation scan — the (G, B, ...) micro
+    axis is folded into one (G*B, ...) forward whose in-graph schedule
+    keeps every stage busy (`_fold_micro_axis`; the outer scan would
+    serialize a pipeline fill+drain per micro-step, P-1 extra bubbles).
+    Plain autodiff through the stage scan, no custom VJP; state donation
+    is unchanged (graphcheck's donation pass covers the pipelined step as
+    its own target)."""
+    pipelined = pipeline is not None and getattr(pipeline, "active", False)
 
     def step(state: TrainState, batch: dict, key) -> tuple:
         if debug_asserts:
             assert_batch_contract(batch, leading_micro=accum_steps > 1)
-        if accum_steps == 1:
+        if accum_steps > 1 and pipelined:
+            batch = _constrain_batch(batch, mesh, leading_micro=True)
+            batch = _fold_micro_axis(batch)
+        if accum_steps == 1 or pipelined:
             batch = _constrain_batch(batch, mesh, leading_micro=False)
             (loss, (new_stats, correct, count)), grads = grad_fn(
                 state.params, state.batch_stats, batch, key
@@ -270,6 +301,7 @@ def make_train_step(
     ema_decay: float = 0.0,
     health_metrics: bool = False,
     guard_skip: bool = False,
+    pipeline=None,
 ) -> Callable:
     """Build the supervised `step(state, batch, dropout_key) ->
     (state, metrics)` (see `_make_update_step`). `device_normalize`:
@@ -377,7 +409,7 @@ def make_train_step(
                              with_accuracy=True, debug_asserts=debug_asserts,
                              ema_decay=ema_decay,
                              health_metrics=health_metrics,
-                             guard_skip=guard_skip)
+                             guard_skip=guard_skip, pipeline=pipeline)
 
 
 def make_pretrain_step(
@@ -390,11 +422,14 @@ def make_pretrain_step(
     ema_decay: float = 0.0,
     health_metrics: bool = False,
     guard_skip: bool = False,
+    pipeline=None,
 ) -> Callable:
     """Build the VideoMAE self-supervised step: `step(state, batch, key) ->
     (state, metrics)`. No labels; batch_stats pass through unchanged (pure-LN
     ViT keeps `{}`); the model returns its own reconstruction loss. The rng
-    key feeds both the tube mask and dropout streams."""
+    key feeds both the tube mask and dropout streams. `pipeline`: an
+    active plan folds the accumulation micro axis into the stage
+    schedule's microbatch stream (see `_make_update_step`)."""
 
     def forward_loss(params, batch_stats, batch, key):
         kmask, kdrop = jax.random.split(key)
@@ -410,7 +445,7 @@ def make_pretrain_step(
                              with_accuracy=False, debug_asserts=debug_asserts,
                              ema_decay=ema_decay,
                              health_metrics=health_metrics,
-                             guard_skip=guard_skip)
+                             guard_skip=guard_skip, pipeline=pipeline)
 
 
 def make_pretrain_eval_step(model, mesh) -> Callable:

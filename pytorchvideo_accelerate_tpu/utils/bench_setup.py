@@ -63,6 +63,12 @@ def build_step_setup(
     global_batch: Optional[int] = None,  # fixed TOTAL batch instead of
     #                 batch_per_chip * n_chips — the mesh-parity lane needs
     #                 the identical batch on every mesh shape
+    pipeline_stages: int = 1,  # >1: run the transformer trunk as a P-stage
+    #                 SPMD pipeline over the mesh's model axis
+    #                 (parallel/pipeline.py; stages must equal that axis's
+    #                 size — pass a matching mesh_cfg). Params stay
+    #                 replicated over the stage axis (no TP).
+    pipeline_microbatches: int = 0,  # 0 = auto (accum when >1, else 2P)
 ) -> StepSetup:
     import jax
     import jax.numpy as jnp
@@ -90,13 +96,22 @@ def build_step_setup(
     input_u8 = input_u8 and not pretrain  # MAE target needs the f32 clip
     cfg = ModelConfig(name=model_name, num_classes=num_classes,
                       slowfast_alpha=alpha, **(overrides or {}))
-    model = create_model(cfg, mixed_precision)
     if devices is None:
         devices = jax.devices()
     n_chips = len(devices)
     # the trainer's backbone layout (2-D (data, model) train mesh); a
     # legacy MeshConfig still resolves to the 4-axis library mesh
     mesh = make_train_mesh(mesh_cfg or MeshConfig(), devices=devices)
+    plan = None
+    if pipeline_stages > 1:
+        from pytorchvideo_accelerate_tpu.parallel.pipeline import (
+            make_plan as make_pipeline_plan,
+        )
+
+        plan = make_pipeline_plan(mesh, pipeline_stages,
+                                  microbatches=pipeline_microbatches,
+                                  accum_steps=accum)
+    model = create_model(cfg, mixed_precision, mesh=None, pipeline=plan)
     B = global_batch if global_batch is not None else batch_per_chip * n_chips
     if B % data_shard_count(mesh):
         raise ValueError(
@@ -156,15 +171,17 @@ def build_step_setup(
     # flag mirrors the trainer's per-family model-axis decision.
     state = shard_state(mesh, TrainState.create(
         variables["params"], variables.get("batch_stats", {}), tx),
-        tp=family_uses_tp(model_name))
+        tp=family_uses_tp(model_name) and plan is None)
     if pretrain:
-        step = make_pretrain_step(model, tx, mesh, accum_steps=accum)
+        step = make_pretrain_step(model, tx, mesh, accum_steps=accum,
+                                  pipeline=plan)
     else:
         d = DataConfig()  # canonical mean/std — the stats the u8
         #                   production path normalizes with
         step = make_train_step(
             model, tx, mesh, accum_steps=accum,
             device_normalize=(d.mean, d.std) if input_u8 else None,
+            pipeline=plan,
         )
     return StepSetup(model=model, mesh=mesh, state=state, step=step,
                      n_chips=n_chips, global_batch=B, host_batch=host_batch,

@@ -158,6 +158,11 @@ def test_finalize_line_fits_driver_capture():
         "dataplane_cps": 49.71, "dataplane_input_wait_frac": 0.8294,
         "dataplane_workers": 2,
         "dataplane_error": "remote batch stream diverged " + "d" * 200,
+        "pipeline_parity": True, "pipeline_donation_verified": True,
+        "pipeline_train_recompiles": 0, "pipeline_cps_per_chip": 6.195,
+        "pipeline_bubble_frac": 0.0171,
+        "pipeline_bubble_frac_analytic": 0.2727, "pipeline_stages": 4,
+        "pipeline_error": "no trustworthy device numbers " + "p" * 200,
         "kbench_platform": "cpu", "kbench_parity_ok": True,
         "kbench_best": "dw_x3d_res3:118.167x",
         "kbench_dw_x3d_res3_speedup": 118.167,
@@ -266,6 +271,40 @@ def test_finalize_multichip_keys_ride_the_headline():
         user_smoke=False)
     assert out["multichip_error"] == "cpu fallback"
     assert "multichip_cps_per_chip" not in out
+
+
+def test_finalize_pipeline_keys_ride_the_headline():
+    """The PIPELINE lane's verdicts (pipeline_parity /
+    pipeline_donation_verified / pipeline_train_recompiles — the values
+    `--smoke` asserts) and perf keys (pipeline_cps_per_chip, analytic +
+    measured bubble fractions, stage count) plumb through finalize; a
+    suspect/failed lane headlines pipeline_error INSTEAD of the perf
+    keys while the verdicts ride regardless (the multichip/fleet/
+    dataplane refusal rule)."""
+    extras = {"pipeline_parity": True, "pipeline_donation_verified": True,
+              "pipeline_train_recompiles": 0,
+              "pipeline_cps_per_chip": 6.195,
+              "pipeline_bubble_frac": 0.0171,
+              "pipeline_bubble_frac_analytic": 0.2727,
+              "pipeline_stages": 4}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["pipeline_parity"] is True
+    assert out["pipeline_donation_verified"] is True
+    assert out["pipeline_train_recompiles"] == 0
+    assert out["pipeline_cps_per_chip"] == 6.195
+    assert out["pipeline_bubble_frac"] == 0.0171
+    assert out["pipeline_bubble_frac_analytic"] == 0.2727
+    assert out["pipeline_stages"] == 4
+    # refusal: perf keys shed, verdicts retained
+    out = bench.finalize(
+        _model(), {"pipeline_parity": True,
+                   "pipeline_cps_per_chip": 6.195,
+                   "pipeline_error": "cpu fallback"},
+        user_smoke=False)
+    assert out["pipeline_error"] == "cpu fallback"
+    assert out["pipeline_parity"] is True
+    assert "pipeline_cps_per_chip" not in out
+    assert "pipeline_bubble_frac" not in out
 
 
 def test_finalize_kbench_keys_ride_the_headline():
