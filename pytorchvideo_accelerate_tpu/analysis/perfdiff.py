@@ -54,6 +54,9 @@ HIGHER_BETTER = (
     "kbench_conv311_sf_res4_speedup",
     # PIPELINE lane: pipelined clips/s/chip at the lane's P-stage point
     "pipeline_cps_per_chip",
+    # STREAM lane: per-label cost ratio, full-recompute / incremental
+    # (streaming/; docs/SERVING.md § streaming)
+    "stream_incremental_speedup",
 )
 LOWER_BETTER = (
     "step_ms_blocked",
@@ -67,6 +70,10 @@ LOWER_BETTER = (
     "trace_overhead_frac",
     # PIPELINE lane: realized fill/drain idle fraction (two-point fit)
     "pipeline_bubble_frac",
+    # STREAM lane: label-latency tail under open-loop stream load, and
+    # the exact per-advance H2D payload fraction (s/T)
+    "stream_p99_ms",
+    "stream_h2d_bytes_frac",
 )
 
 

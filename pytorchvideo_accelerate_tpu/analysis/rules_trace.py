@@ -45,14 +45,25 @@ from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HOT_MODULES
 # the hot modules PLUS the fleet tier's handoff surfaces (scheduler queue,
 # router dispatch, replica pool worker threads) PLUS the disaggregated
 # data plane's socket handoffs (feed leases/reader threads, worker frames)
+# PLUS the streaming-session tier (session-state handoffs: hot-swap state
+# carry, table adoption — the send sites a swap-time trace must not
+# truncate at)
 TRACE_HANDOFF_MODULES: Tuple[str, ...] = HOT_MODULES + (
     "fleet/scheduler.py",
     "fleet/router.py",
     "fleet/pool.py",
     "fleet/loadgen.py",
+    "fleet/hotswap.py",
     "dataplane/feed.py",
     "dataplane/worker.py",
+    "streaming/engine.py",
+    "streaming/session.py",
 )
+
+# session-handoff call tails treated as cross-context put sites (like
+# `send_frame`): a hot-swap carrying a whole session table between
+# engines is exactly the hop whose swap-timeline trace an operator needs
+_SESSION_HANDOFF_TAILS = ("carry_state_from", "adopt")
 
 # helper call tails that prove the module participates in propagation.
 # current_traceparent/format_traceparent are the cross-PROCESS halves (a
@@ -169,8 +180,13 @@ class TracePropagationRule(Rule):
         trace_mods = _trace_module_aliases(tree)
         helper_bare = _trace_helper_names(tree)
 
-        # does this module call ANY propagation helper?
+        # does this module call ANY propagation helper? (`has_span`
+        # tracked separately: opening a span joins the ACTIVE trace on
+        # the current thread, which satisfies same-thread session-state
+        # handoffs but not a cross-thread/queue hop — those still need
+        # the capture/attach pair)
         propagates = False
+        has_span = False
         for node in ast.walk(tree):
             if not isinstance(node, ast.Call):
                 continue
@@ -183,6 +199,8 @@ class TracePropagationRule(Rule):
                 if head in trace_mods and tail in _HELPER_TAILS:
                     propagates = True
                     break
+                if head in trace_mods and tail == "span":
+                    has_span = True
                 # the cross-process helpers have distinctive names and are
                 # typically called on a Tracer INSTANCE
                 # (`get_tracer().continue_trace(...)`), so any receiver
@@ -226,6 +244,18 @@ class TracePropagationRule(Rule):
                 # traceparent truncates the trace at the process boundary
                 sites.append(
                     (node, "`send_frame(...)` crosses a process boundary"))
+                continue
+            tail = dn.rsplit(".", 1)[-1]
+            if tail in _SESSION_HANDOFF_TAILS and not has_span:
+                # the streaming tier's session-state handoff (hot-swap
+                # state carry / table adoption): session rings move
+                # between engines during a swap — an uninstrumented
+                # carry leaves the swap invisible in the merged
+                # timeline. A same-thread handoff is satisfied by a
+                # `trace.span(...)` over the carry (has_span above).
+                sites.append(
+                    (node, f"`{tail}(...)` hands session state across "
+                           "engines without a span over the carry"))
                 continue
             f = node.func
             if (isinstance(f, ast.Attribute)

@@ -370,6 +370,105 @@ def _stress_fleet(log: Callable[[str], None]) -> None:
         "hot-swap + membership flap")
 
 
+def _stress_stream(log: Callable[[str], None]) -> None:
+    """Streaming-session churn (docs/SERVING.md § streaming): concurrent
+    clients establish/advance/end sessions through the affinity router
+    (windows attached — the re-establish-anywhere contract) while a
+    hot-swap cutover replaces the session-capable engine mid-stream and
+    the health poller flaps membership under the affinity map. The
+    registered SessionTable/StubStreamEngine/Router-affinity state under
+    real interleavings, plus a direct table-churn thread racing the
+    launch path (lease/evict/adopt against advance/sweep)."""
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        LocalReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+    from pytorchvideo_accelerate_tpu.obs.registry import Registry
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+    from pytorchvideo_accelerate_tpu.serving.stub import StubStreamEngine
+    from pytorchvideo_accelerate_tpu.streaming.session import SessionTable
+
+    replicas = []
+    for i in range(2):
+        stats = ServingStats(window=64, registry=Registry())
+        sched = Scheduler(StubStreamEngine(), stats=stats, max_queue=64,
+                          batch_max_wait_ms=1.0, name=f"tsan-stream-{i}")
+        replicas.append(LocalReplica(f"tsan-stream-{i}", sched))
+    pool = ReplicaPool(replicas, health_interval_s=0.02,
+                       registry=Registry())
+    router = Router(pool, registry=Registry())
+    T, S, HW = 4, 2, 4
+    served: List[str] = []
+
+    def client(k: int):
+        rng = np.random.default_rng(k)
+        win = rng.standard_normal((T, HW, HW, 3)).astype(np.float32)
+        sid = f"tsan-sess-{k}"
+        for i in range(8):
+            frames = rng.standard_normal((S, HW, HW, 3)).astype(np.float32)
+            win = np.concatenate([win[S:], frames], axis=0)
+            try:
+                fut = router.submit(
+                    {"video": frames},
+                    session={"sid": sid, "window": win, "stride": S,
+                             "end": i == 7})
+                if i % 2 == 0:
+                    fut.result(timeout=5.0)
+                    served.append("ok")
+            except Exception:  # noqa: BLE001 - close() races late submits
+                return
+
+    def swapper():
+        time.sleep(0.005)
+        try:  # session-capable green engine cuts over mid-stream
+            replicas[0].scheduler.swap_engine(StubStreamEngine(tag=1.0))
+        except Exception:
+            pass
+        pool.mark_down(replicas[1])  # flap membership under live affinity
+        time.sleep(0.03)
+
+    # direct table churn: the lease/evict/adopt surface racing itself the
+    # way a busy establish path + TTL sweeper + hot-swap adopt would
+    table = SessionTable(ttl_s=0.01, registry=Registry(),
+                         name="tsan-table")
+    table.register_pool(("g",), capacity=3)
+    twin = SessionTable(ttl_s=0.01, registry=Registry(), name="tsan-twin")
+
+    def table_churn(k: int):
+        for i in range(20):
+            sid = f"t{k}-{i % 4}"
+            try:
+                table.establish(sid, ("g",), stride=1, window=4)
+            except Exception:  # budget full: the admission verdict
+                pass
+            table.advanced(sid, 1)
+            if i % 3 == 0:
+                table.sweep()
+            if i % 5 == 0:
+                table.end(sid)
+            if i % 7 == 0:
+                twin.adopt(table)
+
+    ts = [make_thread(target=client, args=(k,), name=f"stream-client-{k}",
+                      daemon=True) for k in range(3)]
+    ts.append(make_thread(target=swapper, name="stream-swapper",
+                          daemon=True))
+    ts += [make_thread(target=table_churn, args=(k,),
+                       name=f"stream-table-{k}", daemon=True)
+           for k in range(2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=10.0)
+    router.close()
+    log(f"[tsan] stream churn: {len(served)} awaited labels through a "
+        "hot-swap + membership flap + table churn")
+
+
 def _stress_trackers(log: Callable[[str], None]) -> None:
     """TrackerHub fan-out from two threads with a tracker that raises: the
     disable-on-failure path mutates the tracker list under traffic."""
@@ -492,6 +591,7 @@ def run_stress(smoke: bool = True,
                     # 20ms concurrently with the legs' heartbeats/churn
                     _stress_batcher(wd, log)
                     _stress_fleet(log)
+                    _stress_stream(log)
                     _stress_trackers(log)
                     _stress_prefetcher(wd, log)
                     _stress_dataplane(log)

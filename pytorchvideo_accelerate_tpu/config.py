@@ -308,6 +308,28 @@ class ServeConfig:
     # tier wants multi-second tails — one ladder fits neither
     # (obs/registry.py family buckets).
     latency_buckets_ms: str = ""
+    # stateful streaming sessions (streaming/; docs/SERVING.md §
+    # streaming): wrap the engine in a StreamingEngine so /stream serves
+    # incremental rolling-window advances — each request ships only the
+    # new frames, the window ring stays device-resident, and the
+    # continuous-batching scheduler batches advances across sessions.
+    # /predict keeps serving stateless one-shot requests either way.
+    streaming: bool = False
+    # HBM budget for device-resident session rings; admission refuses a
+    # new session (503 + Retry-After) when every slot under the budget is
+    # held by a live session
+    stream_session_budget_mb: float = 256.0
+    # idle sessions past this are evicted (their slot reclaimed); a
+    # stream that stopped advancing is a leak, not a client
+    stream_session_ttl_s: float = 120.0
+    # strides to pre-compile at server build (comma-separated frames per
+    # advance) for the artifact's clip geometry: the first advance at an
+    # un-prewarmed (stride, bucket) pays a synchronous compile on the
+    # flush thread, which both stalls the launch AND poisons the
+    # service-time EWMA into transient deadline sheds — exactly the cold
+    # start `InferenceEngine.warmup` prevents for /predict. Strides that
+    # do not divide the window (or the model tubelet) are skipped.
+    stream_strides: str = "2"
 
 
 @dataclass

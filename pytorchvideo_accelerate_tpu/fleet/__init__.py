@@ -18,7 +18,10 @@ queues shape of the Podracer architecture, PAPERS.md):
 - `hotswap.hot_swap` — zero-downtime blue/green checkpoint swap with
   compiled-cache pre-warm and a measured `swap_blackout_ms`;
 - `loadgen.LoadGen` + `pva-tpu-loadgen` — open-loop Poisson load harness
-  with a heavy-tailed clip-size mix and SLO verdicts.
+  with a heavy-tailed clip-size mix and SLO verdicts;
+- `loadgen.StreamLoadGen` — open-loop arrivals of STREAMS (heavy-tail
+  durations, per-session label-latency honesty) driving the stateful
+  streaming mode (streaming/; router affinity, /stream).
 
 The router speaks the `MicroBatcher` interface, so `InferenceServer` (and
 the whole admission/drain/Retry-After vocabulary) fronts a fleet
@@ -31,6 +34,7 @@ from pytorchvideo_accelerate_tpu.fleet.hotswap import (  # noqa: F401
 )
 from pytorchvideo_accelerate_tpu.fleet.loadgen import (  # noqa: F401
     LoadGen,
+    StreamLoadGen,
     heavy_tail_clip_factory,
 )
 from pytorchvideo_accelerate_tpu.fleet.pool import (  # noqa: F401

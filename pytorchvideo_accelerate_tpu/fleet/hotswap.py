@@ -34,6 +34,7 @@ from typing import Dict, List, Optional
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
 
 logger = get_logger("pva_tpu")
@@ -42,13 +43,28 @@ logger = get_logger("pva_tpu")
 def prewarm_like(green, blue) -> int:
     """Compile `green` for every geometry `blue` has served; returns the
     number of compiled keys. Runs while blue is still serving — compiles
-    happen on the caller's thread, launches keep flowing."""
+    happen on the caller's thread, launches keep flowing. Streaming
+    engines also pre-compile every (bucket, stride, geometry) stream
+    step blue serves plus the whole-pool re-embed (`prepare_carry_from`)
+    — the session-state carry ITSELF happens later, inside
+    `Scheduler.swap_engine` under the launch lock, because blue keeps
+    launching (and donating its ring buffers) all through prewarm
+    (docs/SERVING.md § streaming)."""
     n = 0
     for key in blue.compiled_keys:
         batch = {name: np.zeros(shape, green.input_dtype)
                  for name, shape in key}
         green.predict(batch)
         n += 1
+    if hasattr(green, "prepare_carry_from") and hasattr(blue, "table"):
+        # traced: the prewarm half of the session-state handoff — the
+        # swap hop the trace-propagation rule guards (obs/trace.py);
+        # the carry itself runs at cutover, blue quiesced
+        with trace.span("session_carry_prewarm", sessions=len(
+                blue.table.sessions())):
+            n += green.prepare_carry_from(blue)
+        logger.info("hot-swap: pre-compiled the stream carry; live "
+                    "sessions move at cutover under the launch lock")
     return n
 
 
@@ -79,12 +95,30 @@ def hot_swap(replicas: List, artifact: str, *,
         # int8 replica pre-warms and cuts over to an int8 green even when
         # the new artifact ships fp weights (on-the-fly quantization), and
         # an fp fleet never silently picks up int8 from an embedded config
+        streaming_blue = getattr(blue, "supports_sessions", False)
+        inner_blue = blue.engine if streaming_blue else blue
         green = InferenceEngine.from_artifact(
-            artifact, mesh=blue.mesh,
+            artifact, mesh=inner_blue.mesh,
             max_batch_size=(max_batch_size if max_batch_size is not None
                             else blue.buckets[-1]),
             stats=replica.stats,
-            quantization=getattr(blue, "quantization", None))
+            quantization=getattr(inner_blue, "quantization", None))
+        if streaming_blue:
+            # a streaming replica cuts over to a streaming green: the
+            # session surface (budget/TTL) carries over, and prewarm_like
+            # performs the state carry (raw rings adopt; token rings
+            # re-embed under the GREEN weights so no cached feature ever
+            # outlives the weights that produced it)
+            from pytorchvideo_accelerate_tpu.streaming import (
+                StreamingEngine,
+            )
+
+            green = StreamingEngine(
+                green,
+                session_budget_mb=blue.session_budget_bytes / 1e6,
+                session_ttl_s=blue.table.ttl_s,
+                retry_after_s=blue.table.retry_after_s,
+                name=blue.name)
         blackout = swap_replica(replica, green, prewarm=prewarm)
         per[replica.name] = round(blackout * 1e3, 3)
         logger.info("hot-swap %s: cutover blackout %.2f ms",

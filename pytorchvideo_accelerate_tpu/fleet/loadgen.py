@@ -55,6 +55,31 @@ DEFAULT_VIEW_MIX = ((1, 0.85), (2, 0.12), (4, 0.03))
 OPEN_LOOP_LAG_TOLERANCE_MS = 250.0
 
 
+def _classify_outcome(err) -> str:
+    """One rule for both generators: completed / shed (the 503 family) /
+    failed — every request classified exactly once."""
+    if err is None:
+        return "ok"
+    if isinstance(err, QueueFullError):
+        return "shed"
+    return "failed"
+
+
+def _await_stragglers(gen, offered: int) -> list:
+    """Shared grace-wait: the open loop ends at the schedule; stragglers
+    get a bounded grace, then whatever never resolved counts as lost
+    (-> failed) in the caller's accounting. `gen` carries `_lock`,
+    `_done`, and `grace_s` (both generator classes)."""
+    deadline = time.monotonic() + gen.grace_s
+    while time.monotonic() < deadline:
+        with gen._lock:
+            if len(gen._done) >= offered:
+                break
+        time.sleep(0.01)
+    with gen._lock:
+        return list(gen._done)
+
+
 def heavy_tail_clip_factory(base_clip: Dict[str, np.ndarray],
                             view_mix: Sequence = DEFAULT_VIEW_MIX
                             ) -> Callable:
@@ -105,17 +130,11 @@ class LoadGen:
 
     def _on_done(self, t_submit: float, future, handle=None) -> None:
         latency = time.monotonic() - t_submit
-        err = None
         try:
             err = future.exception()
         except Exception as e:  # cancelled
             err = e
-        if err is None:
-            outcome = "ok"
-        elif isinstance(err, QueueFullError):
-            outcome = "shed"
-        else:
-            outcome = "failed"
+        outcome = _classify_outcome(err)
         self._record(outcome, latency)
         if handle is not None:
             # close the request's root trace span with its verdict — the
@@ -171,15 +190,7 @@ class LoadGen:
             fut.add_done_callback(
                 lambda f, t=t_submit, h=handle: self._on_done(t, f, h))
         wall = time.monotonic() - t0
-        # open loop ends at the schedule; stragglers get a bounded grace
-        grace_deadline = time.monotonic() + self.grace_s
-        while time.monotonic() < grace_deadline:
-            with self._lock:
-                if len(self._done) >= offered:
-                    break
-            time.sleep(0.01)
-        with self._lock:
-            done = list(self._done)
+        done = _await_stragglers(self, offered)
         lat_ok = sorted(lat for oc, lat in done if oc == "ok")
         completed = len(lat_ok)
         shed = sum(1 for oc, _ in done if oc == "shed")
@@ -225,6 +236,160 @@ def assert_slo(report: Dict[str, float], *, slo_p99_ms: float,
         violations.append(
             f"shed_frac {report['shed_frac']} > budget {max_shed_frac}")
     return violations
+
+
+@shared_state("_done")
+class StreamLoadGen:
+    """Open-loop STREAM arrivals (docs/SERVING.md § streaming): streams —
+    not independent requests — arrive as a seeded Poisson process at
+    ``stream_rate_sps``; each stream lives for a HEAVY-TAILED number of
+    advances (bounded Pareto — fleets die on the long-running tail
+    streams that pin session slots, not on the median), and emits one
+    label per ``advance_interval_s``.
+
+    Honesty rules, inherited from `LoadGen` and tightened for labels:
+
+    - the whole (stream x advance) event schedule is precomputed and
+      fired against the wall clock — an advance is never delayed because
+      an earlier one is in flight, and ``max_arrival_lag_ms`` /
+      ``open_loop_ok`` flag a generator that degraded toward closed-loop;
+    - **per-session label-latency honesty**: a label's latency is
+      measured from its SCHEDULED advance time, not from whenever the
+      generator got around to submitting it — a backed-up session cannot
+      hide queueing delay the way a submit-anchored clock would
+      (coordinated omission, applied per session);
+    - every advance is classified exactly once: completed / shed
+      (`QueueFullError` family) / failed.
+
+    Each stream's first advance carries the establish window + stride;
+    subsequent advances ship only the ``stride`` new frames, with the
+    client-maintained resendable window attached when ``attach_window``
+    (the re-establish-anywhere contract replica death recovery needs);
+    the last advance carries ``end=True``."""
+
+    def __init__(self, submit, *, stream_rate_sps: float, duration_s: float,
+                 window: int, stride: int, frame_shape: tuple,
+                 advance_interval_s: float, seed: int = 0,
+                 mean_advances: float = 8.0, max_advances: int = 64,
+                 attach_window: bool = True, dtype: str = "float32",
+                 priority: Optional[str] = None, grace_s: float = 15.0):
+        if stream_rate_sps <= 0 or duration_s <= 0:
+            raise ValueError("stream_rate_sps and duration_s must be "
+                             "positive")
+        if window % stride:
+            raise ValueError("stride must divide the window")
+        self.submit = submit
+        self.stream_rate_sps = float(stream_rate_sps)
+        self.duration_s = float(duration_s)
+        self.window = int(window)
+        self.stride = int(stride)
+        self.frame_shape = tuple(frame_shape)  # (H, W, C)
+        self.advance_interval_s = float(advance_interval_s)
+        self.seed = int(seed)
+        self.mean_advances = float(mean_advances)
+        self.max_advances = int(max_advances)
+        self.attach_window = bool(attach_window)
+        self.dtype = dtype
+        self.priority = priority
+        self.grace_s = float(grace_s)
+        self._lock = make_lock("StreamLoadGen._lock")
+        self._done: List = []  # (outcome, label_latency_s)
+
+    def _schedule(self, rng) -> List[tuple]:
+        """-> [(t, stream_idx, k, n_stream)] sorted by time: Poisson
+        stream arrivals x heavy-tail per-stream advance counts."""
+        gaps = rng.exponential(
+            1.0 / self.stream_rate_sps,
+            size=max(int(self.stream_rate_sps * self.duration_s * 2), 8))
+        arrivals = np.cumsum(gaps)
+        arrivals = arrivals[arrivals < self.duration_s]
+        events = []
+        for i, t_arr in enumerate(arrivals):
+            # bounded Pareto (alpha 1.5): mostly short streams, a heavy
+            # tail of long ones; +1 so every stream emits >= 1 label
+            n = int(min(1 + rng.pareto(1.5) * self.mean_advances / 3.0,
+                        self.max_advances))
+            for k in range(n):
+                events.append((t_arr + k * self.advance_interval_s, i, k, n))
+        events.sort()
+        return events
+
+    def _record(self, outcome: str, latency_s: float) -> None:
+        with self._lock:
+            self._done.append((outcome, latency_s))
+
+    def _on_done(self, t_sched: float, t0: float, future) -> None:
+        # label latency anchored to the SCHEDULED time (see class docs)
+        latency = time.monotonic() - (t0 + t_sched)
+        try:
+            err = future.exception()
+        except Exception as e:  # cancelled
+            err = e
+        self._record(_classify_outcome(err), latency)
+
+    def run(self) -> Dict[str, float]:
+        rng = np.random.default_rng(self.seed)
+        events = self._schedule(rng)
+        windows: Dict[int, np.ndarray] = {}
+        shape = (self.window,) + self.frame_shape
+        offered = 0
+        max_lag = 0.0
+        t0 = time.monotonic()
+        for t_evt, i, k, n in events:
+            now = time.monotonic() - t0
+            if now < t_evt:
+                time.sleep(t_evt - now)
+            max_lag = max(max_lag, (time.monotonic() - t0) - t_evt)
+            if k == 0:
+                windows[i] = rng.standard_normal(shape).astype(self.dtype)
+            frames = rng.standard_normal(
+                (self.stride,) + self.frame_shape).astype(self.dtype)
+            windows[i] = np.concatenate([windows[i][self.stride:], frames],
+                                        axis=0)
+            session: dict = {"sid": f"lg-{self.seed}-{i}",
+                             "stride": self.stride,
+                             "end": k == n - 1}
+            if k == 0 or self.attach_window:
+                session["window"] = windows[i]
+            kwargs: dict = {"session": session}
+            if self.priority is not None:
+                kwargs["priority"] = self.priority
+            offered += 1
+            try:
+                fut = self.submit({"video": frames}, **kwargs)
+            except QueueFullError:
+                self._record("shed", 0.0)
+                continue
+            except Exception:  # noqa: BLE001 - a dead front is a failure
+                self._record("failed", 0.0)
+                continue
+            fut.add_done_callback(
+                lambda f, t=t_evt: self._on_done(t, t0, f))
+            if k == n - 1:
+                windows.pop(i, None)
+        wall = time.monotonic() - t0
+        done = _await_stragglers(self, offered)
+        lat_ok = sorted(lat for oc, lat in done if oc == "ok")
+        completed = len(lat_ok)
+        shed = sum(1 for oc, _ in done if oc == "shed")
+        failed = sum(1 for oc, _ in done if oc == "failed")
+        lost = offered - len(done)
+        n_streams = len({i for _, i, _, _ in events})
+        return {
+            "streams": float(n_streams),
+            "advances_offered": float(offered),
+            "completed": float(completed),
+            "achieved_lps": round(completed / wall, 3) if wall > 0 else 0.0,
+            "shed": float(shed),
+            "failed": float(failed + lost),
+            "shed_frac": round(shed / offered, 4) if offered else 0.0,
+            "label_p50_ms": round(_percentile(lat_ok, 50) * 1e3, 3),
+            "label_p99_ms": round(_percentile(lat_ok, 99) * 1e3, 3),
+            "max_arrival_lag_ms": round(max_lag * 1e3, 3),
+            "open_loop_ok": bool(max_lag * 1e3
+                                 <= OPEN_LOOP_LAG_TOLERANCE_MS),
+            "duration_s": round(wall, 3),
+        }
 
 
 def _http_clip_factory(url: str) -> Callable:

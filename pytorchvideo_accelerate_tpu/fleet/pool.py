@@ -144,12 +144,29 @@ class HttpReplica:
                 body["priority"] = kwargs["priority"]
             if kwargs.get("deadline_ms") is not None:
                 body["deadline_ms"] = float(kwargs["deadline_ms"])
+            path = "/predict"
+            session = kwargs.get("session")
+            if session is not None:
+                # streaming advance -> the replica's /stream endpoint;
+                # the "video" clip carries the s new frames, the session
+                # envelope the id (+ resendable window when the caller
+                # chose to ship it — the re-establish-anywhere tradeoff,
+                # docs/SERVING.md § streaming)
+                path = "/stream"
+                body["session"] = str(session.get("sid"))
+                if session.get("window") is not None:
+                    body["window"] = np.asarray(session["window"]).tolist()
+                if session.get("stride"):
+                    body["stride"] = int(session["stride"])
+                if session.get("end"):
+                    body["end"] = True
+                body["frames"] = body.pop("video", None)
             headers = {"Content-Type": "application/json"}
             tp = trace.current_traceparent()
             if tp:
                 headers["traceparent"] = tp
             req = urllib.request.Request(
-                self.url + "/predict", data=json.dumps(body).encode(),
+                self.url + path, data=json.dumps(body).encode(),
                 headers=headers)
             try:
                 with urllib.request.urlopen(req,
@@ -163,6 +180,16 @@ class HttpReplica:
                 if e.code == 400:
                     raise ValueError(f"{self.name}: bad request: "
                                      f"{e.read()[:200]!r}") from e
+                if e.code == 409:
+                    # streaming session unknown on this replica and no
+                    # window rode along: the caller must resend its window
+                    from pytorchvideo_accelerate_tpu.streaming.session import (
+                        SessionUnknownError,
+                    )
+
+                    raise SessionUnknownError(
+                        f"{self.name}: session unknown (409); resend "
+                        "window") from e
                 raise RuntimeError(f"{self.name}: HTTP {e.code}") from e
             except (urllib.error.URLError, ConnectionError, OSError) as e:
                 raise ReplicaDeadError(f"{self.name}: {e}") from e

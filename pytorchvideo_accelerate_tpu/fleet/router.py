@@ -7,9 +7,18 @@ vocabulary — works unchanged with a fleet behind it.
 
 Routing policy, per request:
 
-- pick the ROUTABLE replica (pool membership, health-gated) with the
-  fewest outstanding requests (router-tracked; queue depth would lag and
-  cost an RPC for HTTP replicas), FIFO-seq tiebreak;
+- **affinity-then-least-outstanding**: a streaming-session request
+  (`session={"sid": ...}`) routes to the replica that HOLDS the session's
+  device-resident ring (docs/SERVING.md § streaming) — the affinity map
+  is router-tracked, updated at every successful dispatch; when the
+  affinity replica is down/shedding, the request falls through to the
+  ordinary policy and the ACCEPTING replica becomes the new affinity —
+  deterministic re-establish from the request's resendable window makes
+  the move client-invisible;
+- stateless requests (and affinity fall-through) pick the ROUTABLE
+  replica (pool membership, health-gated) with the fewest outstanding
+  requests (router-tracked; queue depth would lag and cost an RPC for
+  HTTP replicas), FIFO-seq tiebreak;
 - a replica-level shed (`QueueFullError`) tries the next-least-loaded
   replica before giving up: one hot replica must not shed traffic the
   rest of the fleet has capacity for. Only when EVERY candidate sheds
@@ -45,11 +54,22 @@ from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
 logger = get_logger("pva_tpu")
 
 
-@shared_state("_outstanding", "_rr")
+# affinity-map bound: sessions beyond this evict oldest-first — a stream
+# that outlives its map entry just re-establishes from its resendable
+# window on its next advance (correct, merely one launch less warm)
+MAX_AFFINITY_SESSIONS = 65536
+
+
+@shared_state("_outstanding", "_rr", "_affinity")
 class Router:
-    """Least-outstanding routing + route-around over a `ReplicaPool`."""
+    """Affinity-then-least-outstanding routing + route-around over a
+    `ReplicaPool`."""
 
     supports_priority = True
+    # session envelopes forward through `submit(..., session=...)`; the
+    # REPLICA's scheduler decides capability (a non-stream replica answers
+    # with a 400-shaped ValueError the client sees)
+    supports_sessions = True
 
     def __init__(self, pool: ReplicaPool, *, retries: int = 2,
                  retry_after_s: float = 1.0, registry=None):
@@ -60,6 +80,9 @@ class Router:
         self._lock = make_lock("Router._lock")
         self._outstanding: Dict[str, int] = {}
         self._rr = 0  # rotation counter: round-robin among outstanding ties
+        # session id -> replica name holding its ring (insertion-ordered
+        # dict = oldest-first eviction past the bound)
+        self._affinity: Dict[str, str] = {}
         # every series is scoped by the POOL's name: registry metrics are
         # get-or-create by name, so two routers on the process-default
         # registry would otherwise sum each other's sheds/retries into
@@ -85,15 +108,21 @@ class Router:
     # --- the batcher interface -------------------------------------------
 
     def submit(self, clip, *, priority: Optional[str] = None,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               session: Optional[dict] = None) -> Future:
         """Route ONE request; returns a Future that survives replica death
         (re-dispatched) and resolves with logits, `QueueFullError` (shed),
-        or the terminal error once retries are exhausted."""
+        or the terminal error once retries are exhausted. A `session`
+        envelope routes with affinity (see module docstring) and carries
+        the resendable window a survivor re-establishes from when the
+        affinity replica dies mid-flight."""
         kwargs: dict = {}
         if priority is not None:
             kwargs["priority"] = priority
         if deadline_ms is not None:
             kwargs["deadline_ms"] = deadline_ms
+        if session is not None:
+            kwargs["session"] = session
         outer: Future = Future()
         # capture the submitter's trace context ONCE: first dispatch runs
         # on this thread (context already active), but a re-dispatch after
@@ -132,10 +161,13 @@ class Router:
         with self._lock:
             return any(v > 0 for v in self._outstanding.values())
 
-    def _pick(self, exclude: frozenset) -> List:
+    def _pick(self, exclude: frozenset, sid: Optional[str] = None) -> List:
         """Routable replicas, least-outstanding first; ties rotate
         round-robin (an idle fleet must spread load, not pile onto the
-        alphabetically-first replica)."""
+        alphabetically-first replica). A session id promotes its affinity
+        replica to the FRONT of the order — the rest stay as the shed/
+        death fallback chain, so losing the affinity replica degrades to
+        ordinary routing instead of failing."""
         candidates = [r for r in self.pool.routable()
                       if r.name not in exclude]
         if not candidates:
@@ -145,8 +177,26 @@ class Router:
                      for r in candidates}
             self._rr += 1
             rot = self._rr % len(candidates)
+            pinned = self._affinity.get(sid) if sid else None
         rotated = candidates[rot:] + candidates[:rot]
-        return sorted(rotated, key=lambda r: order[r.name])  # stable sort
+        picked = sorted(rotated, key=lambda r: order[r.name])  # stable sort
+        if pinned is not None:
+            picked.sort(key=lambda r: r.name != pinned)  # stable: pin first
+        return picked
+
+    def _record_affinity(self, sid: str, replica_name: str) -> None:
+        with self._lock:
+            moved = self._affinity.pop(sid, None)
+            self._affinity[sid] = replica_name  # re-insert = LRU refresh
+            while len(self._affinity) > MAX_AFFINITY_SESSIONS:
+                self._affinity.pop(next(iter(self._affinity)))
+        if moved is not None and moved != replica_name:
+            logger.info("fleet: session %s re-routed %s -> %s", sid,
+                        moved, replica_name)
+
+    def forget_session(self, sid: str) -> None:
+        with self._lock:
+            self._affinity.pop(sid, None)
 
     def _track(self, name: str, delta: int) -> None:
         with self._lock:
@@ -162,7 +212,9 @@ class Router:
         if outer.cancelled():  # the client gave up (504) before dispatch
             return
         last_shed: Optional[QueueFullError] = None
-        for replica in self._pick(exclude):
+        session = kwargs.get("session")
+        sid = str(session["sid"]) if session and session.get("sid") else None
+        for replica in self._pick(exclude, sid=sid):
             try:
                 with trace.attach(ctx):
                     inner = replica.submit(clip, **kwargs)
@@ -172,6 +224,14 @@ class Router:
             except ReplicaDeadError:
                 self.pool.mark_down(replica)
                 continue
+            if sid is not None:
+                # the ACCEPTING replica holds (or will now establish) the
+                # session ring: later advances pin here; a fall-through
+                # move re-establishes deterministically from the
+                # request's resendable window on the new replica
+                self._record_affinity(sid, replica.name)
+                if session.get("end"):
+                    self.forget_session(sid)
             # the request is now engine-bound: mark the outer future
             # RUNNING so a later client cancel (the 504 path) loses the
             # race — exactly the MicroBatcher/Scheduler claim semantics —
@@ -268,7 +328,9 @@ class Router:
         remote = [r for r in self.pool.replicas if r not in local]
         with self._lock:
             outstanding = dict(self._outstanding)
+            affine = len(self._affinity)
         merged = ServingStats.merge([r.stats for r in local], extra={
+            "sessions_affine": float(affine),
             "router_shed": self._c_shed.value(pool=self._pool_label),
             "router_retries": self._c_retried.value(pool=self._pool_label),
             "replicas_routable": float(len(self.pool.routable())),

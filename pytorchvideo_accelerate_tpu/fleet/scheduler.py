@@ -89,6 +89,12 @@ class _SchedRequest:
     # submitter's trace context, carried with the payload across the
     # pending-queue hop (None = disarmed tracing or untraced caller)
     ctx: Optional[object] = None
+    # streaming session envelope (docs/SERVING.md § streaming): {"sid",
+    # optional "window"/"stride"/"end"}. Session requests launch through
+    # the engine's `advance_batch` instead of `predict`, and their key
+    # carries a "stream" marker so stateful and stateless traffic of a
+    # coincidentally equal geometry never share a launch.
+    session: Optional[dict] = None
 
     def rank(self) -> Tuple[int, float, int]:
         """EDF order, realtime class strictly first; seq breaks ties FIFO."""
@@ -109,6 +115,12 @@ class Scheduler:
     # the HTTP front forwards per-request priority/deadline only to fronts
     # that declare support (a plain MicroBatcher ignores both by design)
     supports_priority = True
+
+    @property
+    def supports_sessions(self) -> bool:
+        """Streaming-session capability: true iff the CURRENT engine can
+        run incremental advances (`StreamingEngine.advance_batch`)."""
+        return bool(getattr(self.engine, "supports_sessions", False))
 
     def __init__(self, engine, *, max_queue: int = 256, stats=None,
                  heartbeat=None, realtime_deadline_ms: float = 500.0,
@@ -149,22 +161,48 @@ class Scheduler:
 
     def submit(self, clip: Dict[str, np.ndarray], *,
                priority: str = REALTIME,
-               deadline_ms: Optional[float] = None) -> Future:
+               deadline_ms: Optional[float] = None,
+               session: Optional[dict] = None) -> Future:
         """Enqueue ONE clip — leaves (T, H, W, C) or (V, T, H, W, C) — and
         get a Future resolving to its fp32 logits (num_classes,). A missed
         queue bound or an unmeetable deadline resolves the future (or
-        raises here) with a `QueueFullError`/`ShedError` → 503."""
+        raises here) with a `QueueFullError`/`ShedError` → 503.
+
+        `session` (docs/SERVING.md § streaming) marks a streaming-session
+        advance: ``{"sid": str, "window": optional resendable (T,H,W,C),
+        "stride": optional int, "end": bool}`` with the *s* new frames as
+        the "video" clip. Session requests ride the same queue/deadline/
+        shed machinery but launch through the engine's incremental
+        `advance_batch`; they require a session-capable engine
+        (`streaming/engine.StreamingEngine`)."""
         if priority not in PRIORITIES:
             raise ValueError(
                 f"priority must be one of {PRIORITIES}, got {priority!r}")
         clips = {k: np.asarray(v) for k, v in clip.items() if k in CLIP_KEYS}
-        if not clips:
-            raise ValueError("request has neither 'video' nor 'slow'/'fast'")
-        for k, v in clips.items():
-            if v.ndim not in (4, 5):
+        if session is not None:
+            if not getattr(self.engine, "supports_sessions", False):
                 raise ValueError(
-                    f"clip {k!r} must be (T,H,W,C) or (V,T,H,W,C), "
-                    f"got shape {v.shape}")
+                    "this replica serves no streaming sessions "
+                    "(engine lacks advance_batch; serve.streaming off?)")
+            if not session.get("sid"):
+                raise ValueError("session request carries no 'sid'")
+            win = session.get("window")
+            if not clips and win is None:
+                raise ValueError(
+                    "session request needs new frames ('video') or a "
+                    "resendable 'window'")
+            geo = (clip_key(clips) if clips
+                   else (("window", tuple(np.shape(win))),))
+            key = ("stream",) + geo
+        elif not clips:
+            raise ValueError("request has neither 'video' nor 'slow'/'fast'")
+        else:
+            for k, v in clips.items():
+                if v.ndim not in (4, 5):
+                    raise ValueError(
+                        f"clip {k!r} must be (T,H,W,C) or (V,T,H,W,C), "
+                        f"got shape {v.shape}")
+            key = clip_key(clips)
         if self._closed.is_set():
             raise RuntimeError("scheduler is closed")
         now = time.monotonic()
@@ -172,7 +210,7 @@ class Scheduler:
                if deadline_ms is None else max(float(deadline_ms), 1.0) / 1e3)
         req = _SchedRequest(clip=clips, future=Future(), t_enqueue=now,
                             deadline=now + ttl, priority=priority,
-                            key=clip_key(clips), ctx=trace.capture())
+                            key=key, ctx=trace.capture(), session=session)
         with self._lock:
             if self._closed.is_set():
                 raise RuntimeError("scheduler is closed")
@@ -246,6 +284,18 @@ class Scheduler:
                 "(in-flight padding plans assume stable buckets)")
         t0 = time.perf_counter()
         with self._launch_lock:
+            # streaming-session state carry happens HERE, with the old
+            # engine quiesced by the launch lock: any earlier (prewarm
+            # time) and the old engine's still-flowing stream launches
+            # would donate away the very ring buffers the green engine
+            # adopted (deleted-array crashes after cutover), and
+            # sessions established mid-prewarm would be silently lost.
+            # prepare_carry_from pre-compiled everything, so this is
+            # bounded EXECUTION — honestly measured in the blackout.
+            old = self.engine
+            if (hasattr(new_engine, "carry_state_from")
+                    and hasattr(old, "table") and old is not new_engine):
+                new_engine.carry_state_from(old)
             self.engine = new_engine
         blackout = time.perf_counter() - t0
         obs.get_recorder().record("fleet", "hot-swap", scheduler=self.name,
@@ -365,6 +415,9 @@ class Scheduler:
                     if r.future.set_running_or_notify_cancel()]
             if not reqs:
                 return
+            if reqs[0].session is not None:
+                self._launch_stream(reqs)
+                return
             n = len(reqs)
             bucket = self._bucket_for(n)
             stacked: Dict[str, np.ndarray] = {}
@@ -427,3 +480,61 @@ class Scheduler:
                         req.future.set_exception(e)
                     except Exception:
                         pass
+
+    def _launch_stream(self, reqs: List[_SchedRequest]) -> None:
+        """One continuous-batching launch of streaming-session advances:
+        the engine owns slot resolution, bucket padding, and the
+        incremental compiled step (`StreamingEngine.advance_batch`); the
+        scheduler's job here is the same claim/trace/stats discipline as
+        the stateless launch. Per-item failures resolve per-item — one
+        malformed session must not fail its co-batched neighbours."""
+        n = len(reqs)
+        items = []
+        for req in reqs:
+            s = req.session or {}
+            items.append({
+                "sid": s.get("sid"),
+                "frames": req.clip.get("video"),
+                "window": s.get("window"),
+                "stride": s.get("stride"),
+                "end": bool(s.get("end")),
+            })
+        rt = trace.get_tracer()
+        head_ctx = None
+        if rt is not None:
+            now_w, now_m = time.time(), time.monotonic()
+            for req in reqs:
+                if req.ctx is not None:
+                    if head_ctx is None:
+                        head_ctx = req.ctx
+                    waited = now_m - req.t_enqueue
+                    rt.event(req.ctx, "sched_wait", now_w - waited,
+                             waited, priority=req.priority)
+        bucket = self._bucket_for(n)
+        t0 = time.perf_counter()
+        with trace.attach(head_ctx):
+            with trace.span("stream_dispatch", batch=n, bucket=bucket):
+                with self._launch_lock:
+                    outs = self.engine.advance_batch(items)
+        svc = time.perf_counter() - t0
+        done = time.monotonic()
+        latencies = []
+        for req, out in zip(reqs, outs):
+            latencies.append(done - req.t_enqueue)
+            try:
+                if isinstance(out, BaseException):
+                    req.future.set_exception(out)
+                else:
+                    req.future.set_result(out)
+            except Exception:
+                pass  # cancelled between claim and resolve
+        if self.stats is not None:
+            self.stats.observe_batch(
+                n, bucket, latencies,
+                trace_ids=[getattr(r.ctx, "trace_id", None)
+                           for r in reqs])
+        with self._lock:
+            prev = self._svc.get(bucket)
+            self._svc[bucket] = (svc if prev is None else
+                                 (1 - _SVC_ALPHA) * prev
+                                 + _SVC_ALPHA * svc)

@@ -53,7 +53,16 @@ Legs (all seeded via one `--seed`, CPU-only, replayable):
   under open-loop load; one replica is SIGKILLed mid-load — the router
   must route around it (mid-flight requests re-dispatched, ZERO non-shed
   failures), pool membership must drop it within the health-check
-  interval, and the surviving replica's p99 must return under the SLO.
+  interval, and the surviving replica's p99 must return under the SLO;
+- **stream_replica_kill**: two real serving processes (session-capable
+  stub stream engine behind the REAL `InferenceServer` /stream +
+  `Scheduler` session launches) behind the affinity router, holding
+  LIVE streaming sessions; the replica holding sessions is SIGKILLed
+  mid-stream — affinity re-routes to the survivor, every affected
+  session re-establishes DETERMINISTICALLY from its client's resendable
+  window (each label's logits must equal the window-content expectation
+  recomputed client-side, i.e. the stream resumes at the correct window
+  position), with zero non-shed client-visible failures.
 
 Exit codes: 0 clean, 1 findings, 2 usage.
 """
@@ -859,6 +868,156 @@ def leg_replica_kill(report: dict, seed: int, log: Log) -> None:
                 pass
 
 
+# subprocess body for leg_stream_replica_kill: the session-capable stub
+# stream engine behind the REAL fleet Scheduler (session launches) +
+# InferenceServer (/stream endpoint, 409 resend protocol). One JSON line
+# {{"url": ...}} once bound, then serve.
+_STREAM_SRV_CODE = """
+import json
+from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+from pytorchvideo_accelerate_tpu.serving.server import InferenceServer
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+from pytorchvideo_accelerate_tpu.serving.stub import StubStreamEngine
+
+engine = StubStreamEngine(forward_s={forward_s})
+engine.model_name = "chaos-stream-stub"
+stats = ServingStats(window=512)
+sched = Scheduler(engine, stats=stats, max_queue=128,
+                  realtime_deadline_ms=10000.0)
+srv = InferenceServer(engine, sched, stats, host="127.0.0.1", port=0,
+                      request_timeout_s=30.0)
+host, port = srv.address
+print(json.dumps({{"url": "http://%s:%d" % (host, port)}}), flush=True)
+srv.serve_forever(drain_on_sigterm=False)
+"""
+
+
+def leg_stream_replica_kill(report: dict, seed: int, log: Log) -> None:
+    """SIGKILL the replica holding live streaming sessions mid-stream:
+    affinity re-routes every session to the survivor, re-establish from
+    the client's resendable window is deterministic (label logits equal
+    the window-content expectation recomputed client-side — the stream
+    resumes at the correct window position, not merely 'somewhere'),
+    and nothing fails non-shed."""
+    import signal as _signal
+    import subprocess
+
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        HttpReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.serving.stub import stub_stream_logits
+
+    leg = _leg(report, "stream_replica_kill")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    T, S, HW, NCLS = 8, 2, 4, 4
+    n_sessions, n_advances, kill_after = 4, 10, 4
+    procs: List[subprocess.Popen] = []
+    router = None
+    rng = np.random.default_rng(seed)
+    try:
+        for _ in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 _STREAM_SRV_CODE.format(forward_s=0.002)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True))
+        replicas = [HttpReplica(f"skill-{i}", _read_url_line(p),
+                                pid=p.pid, timeout_s=20.0)
+                    for i, p in enumerate(procs)]
+        pool = ReplicaPool(replicas, health_interval_s=0.25)
+        router = Router(pool, retries=3)
+
+        windows = {f"st-{i}": rng.standard_normal(
+            (T, HW, HW, 3)).astype(np.float32) for i in range(n_sessions)}
+        failures, mismatches, sheds = 0, 0, 0
+        # establish every session (spreads over both replicas: idle ties
+        # rotate round-robin)
+        for sid, win in windows.items():
+            fut = router.submit({}, session={"sid": sid, "window": win,
+                                             "stride": S})
+            out = np.asarray(fut.result(timeout=30))
+            want = stub_stream_logits(win, NCLS)
+            if abs(out[0] - want[0]) > 1e-4:
+                mismatches += 1
+        holders = {sid: router._affinity.get(sid) for sid in windows}
+        victim_name = replicas[0].name
+        victim_sessions = [s for s, h in holders.items()
+                           if h == victim_name]
+        leg["victim_sessions"] = len(victim_sessions)
+        killed = {"done": False}
+        for k in range(n_advances):
+            if k == kill_after and not killed["done"]:
+                os.kill(procs[0].pid, _signal.SIGKILL)
+                killed["done"] = True
+                log(f"[chaos] stream_replica_kill: killed {victim_name} "
+                    f"holding {len(victim_sessions)} live session(s)")
+            futs = {}
+            for sid in windows:
+                frames = rng.standard_normal(
+                    (S, HW, HW, 3)).astype(np.float32)
+                windows[sid] = np.concatenate(
+                    [windows[sid][S:], frames], axis=0)
+                # the resendable window rides every advance — the
+                # re-establish-anywhere contract replica death needs
+                futs[sid] = router.submit(
+                    {"video": frames},
+                    session={"sid": sid, "window": windows[sid],
+                             "stride": S})
+            for sid, fut in futs.items():
+                try:
+                    out = np.asarray(fut.result(timeout=30))
+                except Exception as e:  # noqa: BLE001 - verdict, not crash
+                    from pytorchvideo_accelerate_tpu.serving.batcher import (
+                        QueueFullError,
+                    )
+
+                    if isinstance(e, QueueFullError):
+                        sheds += 1
+                    else:
+                        failures += 1
+                    continue
+                want = stub_stream_logits(windows[sid], NCLS)
+                if abs(out[0] - want[0]) > 1e-4:
+                    mismatches += 1
+        moved = [s for s in victim_sessions
+                 if router._affinity.get(s) not in (None, victim_name)]
+        leg.update(advances=n_advances * n_sessions, failed=failures,
+                   shed=sheds, mismatches=mismatches,
+                   moved=len(moved))
+        if failures:
+            _finding(report, "stream_replica_kill",
+                     f"{failures} non-shed client-visible failure(s) "
+                     "across the kill (affinity re-route + re-establish "
+                     "must absorb replica death)")
+        if mismatches:
+            _finding(report, "stream_replica_kill",
+                     f"{mismatches} label(s) diverged from the client-"
+                     "window expectation (session did not resume at the "
+                     "correct window position)")
+        if victim_sessions and not moved:
+            _finding(report, "stream_replica_kill",
+                     "no victim session re-routed off the killed replica")
+        log(f"[chaos] stream_replica_kill: {n_advances * n_sessions} "
+            f"advances over {n_sessions} sessions through the kill "
+            f"({failures} failed, {sheds} shed, {mismatches} position "
+            f"mismatches, {len(moved)}/{len(victim_sessions)} victim "
+            "sessions re-homed)")
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10.0)
+            except Exception:
+                pass
+
+
 def leg_guard_nan(report: dict, tmpdir: str, seed: int, log: Log) -> None:
     """NaN spike mid-epoch (seeded ``nan`` faults at `step.dispatch`): the
     in-graph skip absorbs the first poisoned step, the second crosses the
@@ -1338,6 +1497,7 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                     (leg_tracker, (report, tmpdir, seed, log)),
                     (leg_serve, (report, seed, log)),
                     (leg_replica_kill, (report, seed, log)),
+                    (leg_stream_replica_kill, (report, seed, log)),
                     (leg_collective_hang, (report, seed, log)),
                     (leg_guard_nan, (report, tmpdir, seed, log)),
                     (leg_preempt, (report, tmpdir, seed, log)),

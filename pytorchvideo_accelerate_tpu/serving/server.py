@@ -106,6 +106,10 @@ class _Handler(BaseHTTPRequestHandler):
                 "buckets": list(eng.buckets),
                 "platform": srv.platform,
                 "queue_depth": srv.batcher.queue_depth(),
+                # streaming capability: whether /stream serves sessions
+                # here (routers/load balancers may key affinity on it)
+                "streaming": bool(getattr(srv.batcher,
+                                          "supports_sessions", False)),
             }
             if srv.expected_spec is not None:  # per-request (T, H, W, C)
                 health["clip_spec"] = {k: list(v[1:])
@@ -134,6 +138,9 @@ class _Handler(BaseHTTPRequestHandler):
 
     def do_POST(self):  # noqa: N802 - stdlib API
         srv: "InferenceServer" = self.server.owner
+        if self.path == "/stream":
+            self._do_stream(srv)
+            return
         if self.path != "/predict":
             self._reply(404, {"error": f"no route {self.path}"})
             return
@@ -243,6 +250,120 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {
                 "logits": np.asarray(logits, np.float32).tolist(),
                 "top1": int(np.argmax(logits)),
+                "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
+            }, headers=echo)
+
+    def _do_stream(self, srv: "InferenceServer") -> None:
+        """POST /stream — one incremental session advance (docs/SERVING.md
+        § streaming). Body: ``{"session": id, "frames": [s new frames],
+        "window": optional resendable (T,H,W,C), "stride": int,
+        "end": bool, "priority"/"deadline_ms": as /predict}``. Responds
+        with the logits over the session's rolling window. Error map:
+        admission/budget shed -> 503 + Retry-After (like /predict),
+        malformed -> 400, session unknown with no window -> 409 (resend
+        the window), budget miss -> 504."""
+        from pytorchvideo_accelerate_tpu.streaming.session import (
+            SessionUnknownError,
+        )
+
+        # same shed-before-body-read admission as /predict: a shed must
+        # stay the cheapest response under overload
+        admitted, retry_after = srv.admission.admit(
+            srv.batcher.queue_depth())
+        if not admitted:
+            state = srv.admission.state()
+            srv.stats.observe_shed(state)
+            self.close_connection = True
+            self._reject(503, f"load shed (service {state}); retry later",
+                         retry_after)
+            return
+        if not getattr(srv.batcher, "supports_sessions", False):
+            srv.stats.observe_rejected("400")
+            self._reply(400, {"error": "this replica serves no streaming "
+                                       "sessions (serve.streaming off)"})
+            return
+        try:
+            length = int(self.headers.get("Content-Length", 0))
+            body = json.loads(self.rfile.read(length) or b"{}")
+            sid = str(body.get("session") or "")
+            if not sid:
+                raise ValueError("body needs a 'session' id")
+            clip = {}
+            if body.get("frames") is not None:
+                clip["video"] = np.asarray(body["frames"],
+                                           dtype=srv.engine.input_dtype)
+            session = {"sid": sid, "end": bool(body.get("end"))}
+            if body.get("window") is not None:
+                session["window"] = np.asarray(
+                    body["window"], dtype=srv.engine.input_dtype)
+            if body.get("stride") is not None:
+                session["stride"] = int(body["stride"])
+            kwargs: dict = {"session": session}
+            if "priority" in body:
+                kwargs["priority"] = str(body["priority"])
+            if "deadline_ms" in body:
+                kwargs["deadline_ms"] = float(body["deadline_ms"])
+        except (ValueError, TypeError, KeyError) as e:
+            srv.stats.observe_rejected("400")
+            self._reply(400, {"error": f"bad request: {e}"})
+            return
+        rt = trace.get_tracer()
+        handle = None
+        if rt is not None:
+            tp = self.headers.get("traceparent")
+            handle = rt.continue_trace(tp, "http_stream") if tp else None
+            if handle is None:
+                handle = rt.start("http_stream")
+        tid = handle.ctx.trace_id if handle is not None else None
+        echo = {"x-pva-trace-id": tid} if tid else None
+        with (handle if handle is not None else trace.NOOP):
+            try:
+                future = srv.batcher.submit(clip, **kwargs)
+            except QueueFullError as e:
+                self._reject(503, str(e), e.retry_after_s, headers=echo)
+                return
+            except ValueError as e:
+                srv.stats.observe_rejected("400")
+                self._reply(400, {"error": f"bad request: {e}"},
+                            headers=echo)
+                return
+            t0 = time.monotonic()
+            try:
+                logits = future.result(timeout=srv.request_timeout_s)
+            except FutureTimeout:
+                if future.cancel():
+                    srv.stats.observe_rejected("504")
+                else:
+                    obs.get_recorder().warn(
+                        "504 after engine claim (stream advance completed "
+                        "but client timed out)",
+                        budget_s=srv.request_timeout_s)
+                self._reject(
+                    504, f"request exceeded {srv.request_timeout_s}s budget",
+                    srv.admission.retry_after_s, headers=echo)
+                return
+            except QueueFullError as e:
+                self._reject(503, str(e), e.retry_after_s, headers=echo)
+                return
+            except SessionUnknownError as e:
+                # not a bad request: the client holds the stream and can
+                # re-establish — 409 tells it to resend its window
+                self._reply(409, {"error": str(e)}, headers=echo)
+                return
+            except ValueError as e:
+                srv.stats.observe_rejected("400")
+                self._reply(400, {"error": f"bad request: {e}"},
+                            headers=echo)
+                return
+            except Exception as e:  # noqa: BLE001 - per-request failure
+                srv.stats.observe_error()
+                self._reply(500, {"error": f"inference failed: {e}"},
+                            headers=echo)
+                return
+            self._reply(200, {
+                "logits": np.asarray(logits, np.float32).tolist(),
+                "top1": int(np.argmax(logits)),
+                "session": sid,
                 "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
             }, headers=echo)
 
@@ -447,6 +568,40 @@ def build_server(cfg) -> InferenceServer:
         logger.info("warmup: compiling buckets %s for %s",
                     engine.buckets, {k: v.shape for k, v in sample.items()})
         engine.warmup(sample)
+    front_engine = engine
+    if s.streaming:
+        # stateful streaming mode (streaming/engine.py): /stream advances
+        # run incrementally against device-resident session rings; the
+        # scheduler batches them across sessions. /predict still serves
+        # stateless one-shot requests through the same wrapped engine.
+        from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+        front_engine = StreamingEngine(
+            engine, session_budget_mb=s.stream_session_budget_mb,
+            session_ttl_s=s.stream_session_ttl_s,
+            retry_after_s=s.retry_after_s)
+        if s.scheduler != "edf":
+            raise SystemExit(
+                "--serve.streaming needs the continuous-batching "
+                "scheduler (--serve.scheduler edf); the MicroBatcher has "
+                "no session launch path")
+        if spec is not None and "video" in spec:
+            # pre-compile establish+advance at every (stride, bucket) for
+            # the served geometry: a first advance compiling on the flush
+            # thread would stall the launch AND poison the service-time
+            # EWMA into transient deadline sheds (serve.stream_strides)
+            _, t, h, w, c = spec["video"]
+            for tok in s.stream_strides.split(","):
+                if not tok.strip():
+                    continue
+                try:
+                    n = front_engine.warmup_stream(t, h, w, c,
+                                                   int(tok))
+                    logger.info("stream warmup: stride %s -> %d "
+                                "compiled steps", tok.strip(), n)
+                except Exception as e:  # noqa: BLE001 - invalid stride for this model
+                    logger.warning("stream warmup skipped stride %s: %s",
+                                   tok.strip(), e)
     heartbeat = watchdog.beat_fn("serve_batcher") if watchdog else None
     if s.scheduler == "edf":
         # the continuous-batching scheduler (fleet/scheduler.py) is the
@@ -456,7 +611,7 @@ def build_server(cfg) -> InferenceServer:
         from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
 
         batcher = Scheduler(
-            engine, max_queue=s.max_queue, stats=stats,
+            front_engine, max_queue=s.max_queue, stats=stats,
             realtime_deadline_ms=s.realtime_deadline_ms,
             batch_deadline_ms=s.batch_deadline_ms,
             batch_max_wait_ms=s.max_wait_ms,

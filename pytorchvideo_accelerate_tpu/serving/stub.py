@@ -18,6 +18,8 @@ from typing import Tuple
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
+
 
 class StubEngine:
     """Bucket geometry + a host-side forward; `tag` fills column 1 of the
@@ -51,4 +53,72 @@ class StubEngine:
         out = np.zeros((n, self.num_classes), np.float32)
         if self.num_classes > 1:
             out[:, 1] = self.tag
+        return out
+
+
+def stub_stream_logits(window: np.ndarray, num_classes: int,
+                       tag: float = 0.0) -> np.ndarray:
+    """The stub's deterministic per-window verdict: logits[0] is a pure
+    function of the WINDOW CONTENTS (mean over all pixels), so a chaos/
+    test client can verify a replica resumed a session at the correct
+    window position by recomputing the expectation from its own
+    resendable window. One module-level definition — the server-side stub
+    and every client-side reference import the same arithmetic."""
+    out = np.zeros((num_classes,), np.float32)
+    out[0] = np.asarray(window, np.float64).mean()
+    if num_classes > 1:
+        out[1] = tag
+    return out
+
+
+@shared_state("_rings")
+class StubStreamEngine(StubEngine):
+    """Session-capable stub: the streaming CONTROL-PLANE double (affinity
+    routing, /stream, scheduler session launches, chaos replica kills)
+    without a jax model. Host-side numpy rings mirror the real
+    `StreamingEngine` window semantics — establish from a window, roll by
+    stride per advance, deterministic logits over the rolled window via
+    `stub_stream_logits` — so 'resumed at the correct position' is a
+    checkable equality, not a liveness hand-wave."""
+
+    supports_sessions = True
+
+    def __init__(self, tag: float = 0.0, forward_s: float = 0.001,
+                 buckets: Tuple[int, ...] = (2, 4), num_classes: int = 4):
+        super().__init__(tag=tag, forward_s=forward_s, buckets=buckets,
+                         num_classes=num_classes)
+        self._lock = make_lock("StubStreamEngine._lock")
+        self._rings: dict = {}  # sid -> (T, H, W, C) window, in order
+
+    def advance_batch(self, items) -> list:
+        if self.forward_s > 0:
+            time.sleep(self.forward_s)
+        out = []
+        for item in items:
+            sid = str(item.get("sid") or "")
+            frames = item.get("frames")
+            window = item.get("window")
+            with self._lock:
+                ring = self._rings.get(sid)
+                if ring is None or (frames is None and window is not None):
+                    if window is None:
+                        from pytorchvideo_accelerate_tpu.streaming.session import (  # noqa: E501
+                            SessionUnknownError,
+                        )
+
+                        out.append(SessionUnknownError(
+                            f"stub session {sid!r} unknown; resend window"))
+                        continue
+                    # establish/re-establish: the resendable window is the
+                    # CURRENT window (inclusive of this request's newest
+                    # frames, the client contract) — use it verbatim
+                    ring = np.asarray(window, np.float32)
+                elif frames is not None:
+                    f = np.asarray(frames, np.float32)
+                    ring = np.concatenate([ring[f.shape[0]:], f], 0)
+                if item.get("end"):
+                    self._rings.pop(sid, None)
+                else:
+                    self._rings[sid] = ring
+            out.append(stub_stream_logits(ring, self.num_classes, self.tag))
         return out

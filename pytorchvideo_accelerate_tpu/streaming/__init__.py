@@ -1,0 +1,31 @@
+"""pva-tpu-stream: incremental streaming inference (docs/SERVING.md §
+streaming).
+
+A *session* owns a device-resident rolling window ring inside an
+`InferenceEngine`'s mesh; each advance ships only the new frames host->
+device, updates the ring in place through a jitted donated update, and
+re-scores the cached window — so monitoring a live stream at stride *s*
+stops paying the ``T/s``x redundant decode / H2D / patch-embed tax the
+one-shot clip-classification path charges per emitted label.
+
+Layers:
+- `streaming/session.py`  — the session table: ids, ring-slot leases,
+  TTL + HBM-budget admission (`SessionTable`);
+- `streaming/engine.py`   — `StreamingEngine`: ring pools, the compiled
+  (bucket, stride, geometry) advance/establish functions, hot-swap state
+  carry (`carry_state_from`), and the full-recompute parity reference.
+
+The fleet integration (affinity routing, scheduler session launches,
+`/stream`, the stream load generator) lives where the fleet lives:
+fleet/router.py, fleet/scheduler.py, serving/server.py, fleet/loadgen.py.
+"""
+
+from pytorchvideo_accelerate_tpu.streaming.engine import (  # noqa: F401
+    StreamingEngine,
+)
+from pytorchvideo_accelerate_tpu.streaming.session import (  # noqa: F401
+    SessionAdmissionError,
+    SessionError,
+    SessionTable,
+    SessionUnknownError,
+)

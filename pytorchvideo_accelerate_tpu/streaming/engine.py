@@ -1,0 +1,885 @@
+"""StreamingEngine: device-resident rolling-window rings + incremental
+advance steps, compiled once per (bucket, stride, geometry).
+
+The recompute this eliminates (docs/SERVING.md § streaming): one-shot
+clip classification re-ships and re-embeds the whole ``(T, H, W, C)``
+window per emitted label, so a live stream scored at stride *s* pays
+``T/s``x redundant H2D and patch-embed work. Here a session's window
+lives ON DEVICE in a slot of a pre-allocated ring pool; an advance ships
+only the *s* new frames, writes them into the ring in place (jitted,
+pool donated — graphcheck-style zero double-buffering), and re-scores
+the cached window.
+
+Two ring families, chosen by the served model:
+
+- **frame ring** (conv families — tiny3d/x3d/resnet/csn/r2plus1d/c2d,
+  and any model without a token seam): the ring holds raw frames in the
+  engine's input dtype; the advance saves H2D + host staging and the
+  full trunk re-runs over the cached window (3-D convs mix time
+  globally — there is no exact partial re-use seam).
+- **token ring** (`VideoMAEClassifier`): the cube embedding is a VALID
+  conv with kernel == stride, so each tubelet's token depends only on
+  its own pixels — the ring caches PRE-positional patch tokens per
+  temporal slot, the advance embeds just the new frames, and the trunk
+  runs over cached tokens (positional embeddings are added at trunk
+  time in window order, so the rotating ring start is invisible to the
+  model). A raw-frame ring is kept alongside as the weight-independent
+  carry substrate: across a blue/green hot-swap the green engine
+  re-embeds every live ring from raw frames under ITS weights at
+  cutover (`carry_state_from`, compiled in advance by
+  `prepare_carry_from`), so cached tokens can never go stale against
+  swapped weights. MViT's overlapping patch stem
+  ((3,7,7) kernel, stride (2,4,4)) has no per-frame token independence
+  and rides the frame ring.
+
+Parity contract: the incremental logits match `InferenceEngine.predict`
+over the assembled host window (`full_recompute`) — gated in the bench
+STREAM lane and tests/test_zstream.py. SlowFast's dual-rate window pair
+is refused loudly (two coupled rings at different strides — not built).
+
+Compile discipline: advance/establish functions are jitted per
+(kind, geometry, stride, bucket) and cached forever; session slots and
+write offsets are TRACED arguments, so steady-state streaming touches
+zero new executables (`compiled_stream_cache_sizes` is the
+RecompileGuard-style probe the bench lane asserts flat).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from pytorchvideo_accelerate_tpu.streaming.session import (
+    SessionAdmissionError,
+    SessionError,
+    SessionTable,
+    SessionUnknownError,
+)
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+from pytorchvideo_accelerate_tpu.utils.sync import make_lock, shared_state
+
+logger = get_logger("pva_tpu")
+
+# compiled stream-executable bound, same rationale as the engine's
+# MAX_COMPILED_KEYS: every (geometry, stride, bucket) costs a synchronous
+# compile + permanent executable memory
+MAX_STREAM_KEYS = 64
+
+
+def _np_dtype(name: str):
+    return np.dtype(name)
+
+
+@shared_state("_pools", "_fns", "_committed", benign={
+    "_tok_meta": "written once at construction, read-only afterwards"})
+class StreamingEngine:
+    """Session-stateful wrapper around one `InferenceEngine`.
+
+    Presents the engine surface the scheduler/hot-swap stack already
+    speaks (`predict`/`buckets`/`warmup`/`compiled_keys` delegate to the
+    wrapped engine) plus the session surface (`advance_batch`,
+    `end_session`, `carry_state_from`). `supports_sessions` is the
+    capability flag the scheduler/server check before routing session
+    traffic."""
+
+    supports_sessions = True
+
+    def __init__(self, engine, *, session_budget_mb: float = 256.0,
+                 session_ttl_s: float = 120.0, retry_after_s: float = 1.0,
+                 registry=None, name: str = "stream"):
+        import jax.numpy as jnp
+
+        from pytorchvideo_accelerate_tpu.models import VideoMAEClassifier
+
+        self.engine = engine
+        self.name = name
+        self.session_budget_bytes = int(session_budget_mb * 1e6)
+        self.table = SessionTable(ttl_s=session_ttl_s,
+                                  retry_after_s=retry_after_s,
+                                  registry=registry, name=name)
+        self._lock = make_lock("StreamingEngine._lock")
+        # pool_key -> {"raw": device (cap,T,H,W,C), "tok": device or None}
+        self._pools: Dict[tuple, Dict[str, Any]] = {}
+        self._committed = 0  # ring-pool bytes allocated against the budget
+        self._fns: Dict[tuple, Any] = {}  # (op, kind, geom, stride, bucket)
+        model = engine.model
+        if isinstance(model, VideoMAEClassifier):
+            self.kind = "tokens"
+            tt, p, _ = model.tubelet
+            self._tok_meta = {"tt": int(tt), "p": int(p),
+                              "dim": int(model.dim),
+                              "dtype": model.dtype}
+        else:
+            self.kind = "frames"
+            self._tok_meta = None
+        if getattr(model, "__class__", type(None)).__name__ == "SlowFast" \
+                or engine.model_name.startswith("slowfast"):
+            raise SessionError(
+                "streaming sessions are single-clip ('video') families; "
+                "slowfast's dual-rate (slow, fast) window pair needs two "
+                "coupled rings at different strides and is not supported "
+                "(docs/SERVING.md § streaming)")
+        self._jnp = jnp
+
+    # --- delegated engine surface ----------------------------------------
+
+    @property
+    def buckets(self):
+        return self.engine.buckets
+
+    @property
+    def mesh(self):
+        return self.engine.mesh
+
+    @property
+    def model(self):
+        return self.engine.model
+
+    @property
+    def model_name(self):
+        return self.engine.model_name
+
+    @property
+    def num_classes(self):
+        return self.engine.num_classes
+
+    @property
+    def input_dtype(self):
+        return self.engine.input_dtype
+
+    @property
+    def stats(self):
+        return self.engine.stats
+
+    @property
+    def quantization(self):
+        return getattr(self.engine, "quantization", "off")
+
+    @property
+    def compiled_keys(self):
+        return self.engine.compiled_keys
+
+    def bucket_for(self, n: int) -> int:
+        return self.engine.bucket_for(n)
+
+    def predict(self, batch):
+        return self.engine.predict(batch)
+
+    def warmup(self, sample_clip) -> None:
+        self.engine.warmup(sample_clip)
+
+    # --- geometry ---------------------------------------------------------
+
+    @staticmethod
+    def geom_key(window: int, h: int, w: int, c: int, dtype: str) -> tuple:
+        return (int(window), int(h), int(w), int(c), str(dtype))
+
+    def ring_bytes(self, geom: tuple) -> int:
+        """Device bytes ONE session's ring(s) cost — the unit of the HBM
+        session budget."""
+        t, h, w, c, dtype = geom
+        raw = t * h * w * c * _np_dtype(dtype).itemsize
+        if self.kind == "tokens":
+            m = self._tok_meta
+            tok_itemsize = np.dtype(
+                self._jnp.zeros((), m["dtype"]).dtype).itemsize
+            raw += (t // m["tt"]) * (h // m["p"]) * (w // m["p"]) \
+                * m["dim"] * tok_itemsize
+        return raw
+
+    def advance_h2d_bytes(self, geom: tuple, stride: int) -> int:
+        """Host->device payload bytes per incremental advance (exact)."""
+        _, h, w, c, dtype = geom
+        return stride * h * w * c * _np_dtype(dtype).itemsize
+
+    def full_h2d_bytes(self, geom: tuple) -> int:
+        """Host->device payload bytes per full-window recompute (exact)."""
+        t, h, w, c, dtype = geom
+        return t * h * w * c * _np_dtype(dtype).itemsize
+
+    def _validate(self, geom: tuple, stride: int) -> None:
+        t, h, w, c, _ = geom
+        if stride <= 0 or t % stride != 0:
+            raise SessionError(
+                f"stride {stride} must divide the window length {t} "
+                "(ring writes must never wrap mid-advance)")
+        if self.kind == "tokens":
+            m = self._tok_meta
+            if stride % m["tt"] != 0:
+                raise SessionError(
+                    f"stride {stride} must be a multiple of the model's "
+                    f"temporal tubelet {m['tt']} (token-ring granularity)")
+            if t % m["tt"] or h % m["p"] or w % m["p"]:
+                raise SessionError(
+                    f"window geometry {(t, h, w)} does not tile the "
+                    f"tubelet {(m['tt'], m['p'], m['p'])}")
+
+    # --- pools ------------------------------------------------------------
+
+    def _pool(self, geom: tuple) -> Dict[str, Any]:
+        """Get-or-create the ring pool for `geom` (replicated over the
+        engine's mesh — per-replica single-device meshes are the fleet
+        pattern, so replication is free there; a multi-device serving
+        mesh pays HBM for simplicity, documented).
+
+        The session budget is GLOBAL across pools: each new geometry's
+        pool is sized from the budget's REMAINING bytes (first geometry
+        gets most of it), and a geometry whose pool would hold zero
+        sessions is refused — a client fanning out novel window shapes
+        must exhaust the budget into 503s, never allocate
+        budget-per-shape until the device OOMs."""
+        with self._lock:
+            pool = self._pools.get(geom)
+            if pool is not None:
+                return pool
+            ring = max(self.ring_bytes(geom), 1)
+            remaining = self.session_budget_bytes - self._committed
+            cap = remaining // ring
+            if cap < 1:
+                raise SessionAdmissionError(
+                    f"session budget exhausted ({self.name}: "
+                    f"{self._committed / 1e6:.0f} MB committed of "
+                    f"{self.session_budget_bytes / 1e6:.0f} MB; a "
+                    f"{ring / 1e6:.1f} MB/session pool for {geom} does "
+                    "not fit); retry later",
+                    retry_after_s=self.table.retry_after_s)
+            # +1 scratch slot: padded launch rows write here, never into a
+            # leased ring
+            pool = {"raw": self._alloc_raw(geom, int(cap) + 1),
+                    "tok": (self._alloc_tok(geom, int(cap) + 1)
+                            if self.kind == "tokens" else None),
+                    "cap": int(cap),
+                    "bytes": int(cap + 1) * ring}
+            self._pools[geom] = pool
+            self._committed += pool["bytes"]
+            self.table.register_pool(geom, int(cap))
+            logger.info(
+                "stream: pool %s = %d session slots (+1 scratch), "
+                "%.1f MB/session; %.0f/%.0f MB budget committed",
+                geom, cap, ring / 1e6, self._committed / 1e6,
+                self.session_budget_bytes / 1e6)
+            return pool
+
+    def _replicated(self, arr):
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+
+    def _alloc_raw(self, geom: tuple, rows: int):
+        t, h, w, c, dtype = geom
+        return self._replicated(np.zeros((rows, t, h, w, c),
+                                         _np_dtype(dtype)))
+
+    def _alloc_tok(self, geom: tuple, rows: int):
+        t, h, w, c, _ = geom
+        m = self._tok_meta
+        return self._replicated(np.zeros(
+            (rows, t // m["tt"], (h // m["p"]) * (w // m["p"]), m["dim"]),
+            self._jnp.zeros((), m["dtype"]).dtype))
+
+    # --- compiled steps ---------------------------------------------------
+
+    def _forward_windows(self, params, bstats, windows):
+        """The wrapped engine's exact forward over in-graph windows
+        (B, T, H, W, C): constrain -> normalize -> model — the op sequence
+        of `InferenceEngine._make_forward`, so incremental logits carry
+        serving parity by construction."""
+        import jax.numpy as jnp
+
+        from pytorchvideo_accelerate_tpu.serving.quantize import (
+            dequantize_tree,
+        )
+        from pytorchvideo_accelerate_tpu.trainer.steps import (
+            _constrain_batch,
+            device_normalize_batch,
+            model_inputs,
+            multiview_logits,
+        )
+
+        eng = self.engine
+        if self.quantization == "int8":
+            params = dequantize_tree(params, eng._compute_dtype)
+        batch = _constrain_batch({"video": windows}, eng.mesh,
+                                 leading_micro=False)
+        batch = device_normalize_batch(batch, eng._device_normalize)
+        logits = multiview_logits(
+            lambda x: eng.model.apply(
+                {"params": params, "batch_stats": bstats}, x, train=False),
+            model_inputs(batch))
+        return logits.astype(jnp.float32)
+
+    def _embed_tokens(self, params, frames):
+        """Patch-embed (B, t, H, W, C) frames -> (B, t/tt, hw, dim)
+        pre-positional tokens: normalize (u8 engines) then the
+        classifier's own CubeEmbed applied from its param subtree — each
+        tubelet's token is a pure function of its own pixels, which is
+        the whole reason the token ring is exact. `params` must already
+        be dequantized (the compiled step dequantizes once at its top)."""
+        from pytorchvideo_accelerate_tpu.models.videomae import CubeEmbed
+        from pytorchvideo_accelerate_tpu.trainer.steps import (
+            device_normalize_batch,
+        )
+
+        m = self._tok_meta
+        model = self.engine.model
+        x = device_normalize_batch({"video": frames},
+                                   self.engine._device_normalize)["video"]
+        tokens, (t, h, w) = CubeEmbed(
+            model.dim, model.tubelet, model.dtype, name="patch_embed",
+        ).apply({"params": params["encoder"]["patch_embed"]}, x)
+        return tokens.reshape(tokens.shape[0], t, h * w, m["dim"])
+
+    def _forward_tokens(self, params, tok_windows):
+        """Trunk from cached tokens: + window-order positional embedding
+        -> ViT blocks -> mean-pool -> fc_norm -> head, mirroring
+        `VideoMAEClassifier.__call__` op for op (final_norm=False,
+        deterministic dropout). `params` arrive dequantized."""
+        import jax.numpy as jnp
+        from flax import linen as nn
+
+        from pytorchvideo_accelerate_tpu.models.videomae import (
+            ViTBlock,
+            sincos_pos_embed,
+        )
+        from pytorchvideo_accelerate_tpu.parallel.sharding import (
+            constrain_block,
+        )
+        from pytorchvideo_accelerate_tpu.precision import f32_island
+
+        model = self.engine.model
+        b, t, hw, dim = tok_windows.shape
+        tokens = tok_windows.reshape(b, t * hw, dim)
+        pos = jnp.asarray(sincos_pos_embed(t * hw, dim))[None]
+        tokens = tokens + pos.astype(tokens.dtype)
+        for i in range(model.depth):
+            tokens = ViTBlock(
+                dim=model.dim, num_heads=model.num_heads,
+                attention_backend=model.attention_backend,
+                context_mesh=model.context_mesh, dtype=model.dtype,
+            ).apply({"params": params["encoder"][f"block{i}"]}, tokens)
+            tokens = constrain_block(tokens,
+                                     getattr(model, "shard_mesh", None))
+        feat = tokens.mean(axis=1)
+        feat = nn.LayerNorm(dtype=model.dtype).apply(
+            {"params": params["fc_norm"]}, feat)
+        logits = nn.Dense(model.num_classes, dtype=jnp.float32).apply(
+            {"params": params["head"]}, f32_island(feat))
+        return logits.astype(jnp.float32)
+
+    def _get_fn(self, op: str, geom: tuple, stride: int, bucket: int):
+        key = (op, self.kind, geom, int(stride), int(bucket))
+        fn = self._fns.get(key)
+        if fn is not None:
+            return fn
+        with self._lock:
+            fn = self._fns.get(key)
+            if fn is None:
+                if len(self._fns) >= MAX_STREAM_KEYS:
+                    raise SessionError(
+                        f"engine already compiled {len(self._fns)} stream "
+                        "geometries; refusing a new one (clients should "
+                        "send the serving stream geometry)")
+                fn = self._build_fn(op, geom, stride, bucket)
+                self._fns[key] = fn
+                logger.info("stream: compiling %s for %s stride=%d B=%d",
+                            op, geom, stride, bucket)
+        return fn
+
+    def _build_fn(self, op: str, geom: tuple, stride: int, bucket: int):
+        import jax
+        import jax.numpy as jnp
+
+        tokens = self.kind == "tokens"
+        m = self._tok_meta
+
+        def dq(params):
+            # token-path dequant happens ONCE here: the embed and the
+            # trunk both read the same fp view, and XLA fuses q*scale
+            # into the weight reads exactly like the engine forward
+            if tokens and self.quantization == "int8":
+                from pytorchvideo_accelerate_tpu.serving.quantize import (
+                    dequantize_tree,
+                )
+
+                return dequantize_tree(params, self.engine._compute_dtype)
+            return params
+
+        def write(pool, rows, slots, offs):
+            """Write per-session rows into the donated pool at traced
+            (slot, offset) — a sequential fori_loop of
+            dynamic_update_slice, which XLA applies IN PLACE on the
+            donated buffer: the update moves only the new rows' bytes,
+            never whole rings (the gather-modify-scatter formulation
+            copied every ring three times and cost more than the H2D it
+            saved). Offsets never wrap because stride divides the
+            window; scratch-slot duplicates are benign (sequential)."""
+            def body(i, p):
+                return jax.lax.dynamic_update_slice(
+                    p, rows[i][None].astype(p.dtype),
+                    (slots[i], offs[i]) + (0,) * (p.ndim - 2))
+
+            return jax.lax.fori_loop(0, rows.shape[0], body, pool)
+
+        if op == "advance" and not tokens:
+            def fn(params, bstats, raw, frames, slots, offs):
+                raw = write(raw, frames, slots, offs)
+                windows = jax.vmap(
+                    lambda r, o: jnp.roll(r, -(o + stride), axis=0)
+                )(raw[slots], offs)
+                return raw, self._forward_windows(params, bstats, windows)
+
+            return jax.jit(fn, donate_argnums=(2,))
+
+        if op == "advance" and tokens:
+            tstride = stride // m["tt"]
+
+            def fn(params, bstats, raw, tok, frames, slots, offs):
+                params = dq(params)
+                raw = write(raw, frames, slots, offs)
+                new_tok = self._embed_tokens(params, frames)
+                tok = write(tok, new_tok, slots, offs // m["tt"])
+                tok_windows = jax.vmap(
+                    lambda r, o: jnp.roll(r, -(o // m["tt"] + tstride),
+                                          axis=0))(tok[slots], offs)
+                return (raw, tok,
+                        self._forward_tokens(params, tok_windows))
+
+            return jax.jit(fn, donate_argnums=(2, 3))
+
+        if op == "establish" and not tokens:
+            def fn(params, bstats, raw, windows, slots):
+                raw = write(raw, windows, slots, jnp.zeros_like(slots))
+                # the freshly-written rings ARE the input windows (offset
+                # 0): forward from the input, no gather-back needed
+                return raw, self._forward_windows(
+                    params, bstats, windows.astype(raw.dtype))
+
+            return jax.jit(fn, donate_argnums=(2,))
+
+        if op == "establish" and tokens:
+            def fn(params, bstats, raw, tok, windows, slots):
+                params = dq(params)
+                zeros = jnp.zeros_like(slots)
+                raw = write(raw, windows, slots, zeros)
+                new_tok = self._embed_tokens(params, windows)
+                tok = write(tok, new_tok, slots, zeros)
+                return raw, tok, self._forward_tokens(params, new_tok)
+
+            return jax.jit(fn, donate_argnums=(2, 3))
+
+        raise SessionError(f"unknown stream op {op!r}")
+
+    # --- the session surface ---------------------------------------------
+
+    def advance_batch(self, items: List[dict]) -> List[Any]:
+        """Score one launch of session advances. Each item:
+        ``{"sid": str, "frames": (s, H, W, C), "window": optional
+        (T, H, W, C) resendable window, "end": bool}``.
+
+        Routing per item: a session this replica holds advances
+        incrementally; an unknown/mismatched one re-establishes
+        DETERMINISTICALLY from the item's resendable window (how replica
+        death and affinity re-routes stay client-invisible) or fails
+        with `SessionUnknownError` when no window rides along. Items are
+        grouped into same-(geometry, stride) compiled launches; duplicate
+        sids within one call are serialized into waves (a ring must never
+        be read and written by two rows of one launch). Returns one entry
+        PER ITEM in order: fp32 logits, or the Exception that item earned
+        — a malformed item must fail ITS future, never its co-batched
+        neighbours'."""
+        self.table.sweep()
+        results: List[Any] = [None] * len(items)
+        pending = list(enumerate(items))
+        while pending:
+            wave: List[tuple] = []
+            seen: set = set()
+            rest: List[tuple] = []
+            for idx, item in pending:
+                sid = str(item.get("sid", ""))
+                if sid in seen:
+                    rest.append((idx, item))
+                else:
+                    seen.add(sid)
+                    wave.append((idx, item))
+            self._run_wave(wave, results)
+            pending = rest
+        for item in items:
+            if item.get("end"):
+                self.table.end(str(item.get("sid", "")))
+        return results
+
+    def _classify(self, item: dict) -> tuple:
+        """-> (mode, sid, payload np, geom, stride) for one item; decides
+        advance vs re-establish and validates against the session/ring
+        contract."""
+        sid = str(item.get("sid") or "")
+        if not sid:
+            raise SessionError("stream item carries no session id")
+        frames = item.get("frames")
+        window = item.get("window")
+        if frames is None and window is None:
+            raise SessionError(f"stream item for {sid!r} carries neither "
+                               "frames nor a window")
+        dtype = self.input_dtype
+        if window is not None:
+            window = np.asarray(window, dtype)
+            if window.ndim != 4:
+                raise SessionError(
+                    f"window for {sid!r} must be (T, H, W, C), got "
+                    f"{window.shape}")
+        if frames is not None:
+            frames = np.asarray(frames, dtype)
+            if frames.ndim != 4:
+                raise SessionError(
+                    f"frames for {sid!r} must be (s, H, W, C), got "
+                    f"{frames.shape}")
+        state = self.table.get(sid)
+        if state is not None and frames is not None:
+            geom = state.pool_key
+            if (frames.shape[0] == state.stride
+                    and tuple(frames.shape[1:]) == tuple(geom[1:4])):
+                return ("advance", sid, frames, geom, state.stride)
+            # stride/geometry drift: fall through to re-establish (window
+            # required — silently writing drifted frames would corrupt
+            # the ring)
+        if window is None:
+            raise SessionUnknownError(
+                f"session {sid!r} is not established on this replica and "
+                "the request carries no resendable window")
+        t, h, w, c = window.shape
+        stride = int(item.get("stride") or
+                     (frames.shape[0] if frames is not None else 0) or 0)
+        if stride <= 0:
+            raise SessionError(
+                f"establish for {sid!r} needs a stride (frames payload or "
+                "explicit 'stride')")
+        geom = self.geom_key(t, h, w, c, dtype)
+        self._validate(geom, stride)
+        return ("establish", sid, window, geom, stride)
+
+    def _run_wave(self, wave: List[tuple], results: List[Any]) -> None:
+        """Group one duplicate-free wave by (mode, geom, stride) and run
+        each group as one bucketed compiled launch. Per-item
+        classification/admission failures land in `results` as
+        exceptions; the rest of the wave still launches."""
+        groups: Dict[tuple, List[tuple]] = {}
+        for idx, item in wave:
+            try:
+                mode, sid, payload, geom, stride = self._classify(item)
+            except Exception as e:  # noqa: BLE001 - per-item verdict
+                results[idx] = e
+                continue
+            groups.setdefault((mode, geom, stride), []).append(
+                (idx, sid, payload))
+        for (mode, geom, stride), rows in groups.items():
+            try:
+                if mode == "establish":
+                    self._launch_establish(geom, stride, rows, results)
+                else:
+                    self._launch_advance(geom, stride, rows, results)
+            except Exception as e:  # noqa: BLE001 - contain to THIS group
+                # a group-level failure (MAX_STREAM_KEYS refusal for a
+                # novel geometry, a compile error) must fail the group
+                # that caused it — never the other geometries co-batched
+                # in the same flush
+                for idx, _, _ in rows:
+                    if results[idx] is None:
+                        results[idx] = e
+
+    def _stack(self, rows, pool) -> tuple:
+        """Pad a group to its bucket: payload rows stacked with zero
+        rows, slots padded with the pool's scratch row, offsets 0."""
+        n = len(rows)
+        bucket = self.bucket_for(n)
+        payload = np.stack([p for _, _, p in rows])
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + payload.shape[1:], payload.dtype)
+            payload = np.concatenate([payload, pad], axis=0)
+        return payload, bucket, pool["cap"]
+
+    def _launch_establish(self, geom, stride, rows, results) -> None:
+        pool = self._pool(geom)
+        live = []
+        states = []
+        for idx, sid, payload in rows:
+            try:
+                # the admission decision (TTL eviction vs 503) happens
+                # here, per session, against the HBM budget
+                states.append(self.table.establish(
+                    sid, geom, stride=stride, window=geom[0]))
+                live.append((idx, sid, payload))
+            except Exception as e:  # noqa: BLE001 - per-item verdict
+                results[idx] = e
+        if not live:
+            return
+        payload, bucket, scratch = self._stack(live, pool)
+        slots = np.asarray([s.slot for s in states]
+                           + [scratch] * (bucket - len(live)), np.int32)
+        fn = self._get_fn("establish", geom, stride, bucket)
+        logits = self._guarded_call(fn, geom, pool, payload, slots, None)
+        for i, (idx, sid, _) in enumerate(live):
+            # establish resets the write offset to 0; the committed
+            # position is "window seen, next write at 0"
+            results[idx] = np.asarray(logits[i], np.float32)
+
+    def _launch_advance(self, geom, stride, rows, results) -> None:
+        pool = self._pool(geom)
+        live = []
+        states = []
+        for idx, sid, payload in rows:
+            s = self.table.get(sid)
+            if s is None:  # evicted between classify and launch
+                results[idx] = SessionUnknownError(
+                    f"session {sid!r} evicted mid-launch; resend window")
+                continue
+            states.append(s)
+            live.append((idx, sid, payload))
+        if not live:
+            return
+        payload, bucket, scratch = self._stack(live, pool)
+        slots = np.asarray([s.slot for s in states]
+                           + [scratch] * (bucket - len(live)), np.int32)
+        offs = np.asarray([s.off for s in states]
+                          + [0] * (bucket - len(live)), np.int32)
+        fn = self._get_fn("advance", geom, stride, bucket)
+        logits = self._guarded_call(fn, geom, pool, payload, slots, offs)
+        for i, (idx, sid, _) in enumerate(live):
+            self.table.advanced(sid, stride)
+            results[idx] = np.asarray(logits[i], np.float32)
+
+    def _guarded_call(self, fn, geom, pool, payload, slots, offs):
+        """`_call` with donated-buffer failure recovery: if the compiled
+        step raises mid-execution (transient device OOM, XLA runtime
+        error), the donated pool buffers are already deleted while the
+        pool dict still references them — every later launch on this
+        geometry would fail with 'array has been deleted' forever. Drop
+        the pool and its sessions instead: clients re-establish from
+        their resendable windows (the designed recovery path), and only
+        THIS group's futures see the original error."""
+        try:
+            return self._call(fn, pool, payload, slots, offs)
+        except Exception:
+            dropped = self._invalidate_pool(geom)
+            logger.exception(
+                "stream: launch failed on %s; dropped the pool and its "
+                "%d session(s) (donated ring buffers are gone — clients "
+                "re-establish from their resendable windows)", geom,
+                dropped)
+            raise
+
+    def _invalidate_pool(self, geom) -> int:
+        """Forget a pool whose device buffers are lost; ends every
+        session leased on it (their slots return to the free list, so a
+        fresh pool of the same geometry starts clean). Returns the
+        number of sessions dropped."""
+        with self._lock:
+            pool = self._pools.pop(geom, None)
+            if pool is not None:
+                self._committed -= pool["bytes"]
+        dropped = 0
+        for s in self.table.sessions():
+            if s.pool_key == geom and self.table.end(s.sid):
+                dropped += 1
+        return dropped
+
+    def _call(self, fn, pool, payload, slots, offs):
+        """Run one compiled stream step, threading the donated pool(s)
+        through and committing the returned buffers."""
+        eng = self.engine
+        payload = self._replicated(payload)
+        slots = self._replicated(slots)
+        args = [eng.params, eng.batch_stats, pool["raw"]]
+        if self.kind == "tokens":
+            args.append(pool["tok"])
+        args.append(payload)
+        args.append(slots)
+        if offs is not None:
+            args.append(self._replicated(offs))
+        out = fn(*args)
+        if self.kind == "tokens":
+            pool["raw"], pool["tok"], logits = out
+        else:
+            pool["raw"], logits = out
+        return logits
+
+    def end_session(self, sid: str) -> bool:
+        return self.table.end(sid)
+
+    def warmup_stream(self, window: int, h: int, w: int, c: int,
+                      stride: int) -> int:
+        """Pre-compile establish+advance at EVERY bucket for one stream
+        geometry (the cold-start analog of `InferenceEngine.warmup`, and
+        what `prewarm_from` does for a hot-swap): scratch-slot launches,
+        so no session is created and no ring is disturbed. Without this,
+        the first lone-session arrival at each bucket size pays a
+        synchronous compile on the scheduler's flush thread."""
+        geom = self.geom_key(window, h, w, c, self.input_dtype)
+        self._validate(geom, stride)
+        pool = self._pool(geom)
+        t, _, _, _, dtype = geom
+        scratch = pool["cap"]
+        n = 0
+        for b in self.buckets:
+            slots = np.full((b,), scratch, np.int32)
+            fn = self._get_fn("establish", geom, stride, b)
+            self._guarded_call(fn, geom, pool,
+                               np.zeros((b, t, h, w, c), _np_dtype(dtype)),
+                               slots, None)
+            fn = self._get_fn("advance", geom, stride, b)
+            self._guarded_call(fn, geom, pool,
+                               np.zeros((b, stride, h, w, c),
+                                        _np_dtype(dtype)),
+                               slots, np.zeros((b,), np.int32))
+            n += 2
+        return n
+
+    # --- parity + probes --------------------------------------------------
+
+    def full_recompute(self, windows: np.ndarray) -> np.ndarray:
+        """The baseline the parity gate compares against: assemble the
+        host windows (B, T, H, W, C), pad to the engine bucket, and run
+        the ordinary one-shot `predict` — full H2D + full embed + trunk."""
+        n = windows.shape[0]
+        bucket = self.bucket_for(n)
+        if bucket > n:
+            pad = np.zeros((bucket - n,) + windows.shape[1:], windows.dtype)
+            windows = np.concatenate([windows, pad], axis=0)
+        return self.engine.predict({"video": windows})[:n]
+
+    def compiled_stream_keys(self) -> tuple:
+        with self._lock:
+            return tuple(sorted(self._fns))
+
+    def compiled_stream_cache_sizes(self) -> Dict[tuple, Optional[int]]:
+        """Per-compiled-function jit cache sizes — the RecompileGuard
+        probe: steady-state streaming must keep every entry at 1."""
+        from pytorchvideo_accelerate_tpu.analysis.recompile_guard import (
+            cache_size,
+        )
+
+        with self._lock:
+            return {k: cache_size(fn) for k, fn in self._fns.items()}
+
+    # --- hot-swap state carry ---------------------------------------------
+
+    def carry_state_from(self, blue: "StreamingEngine") -> int:
+        """Cutover-time state carry (`Scheduler.swap_engine` calls this
+        UNDER the launch lock, fleet/hotswap.py): adopt the blue engine's
+        session table and RAW ring pools (raw frames are
+        weight-independent), then re-derive every token pool under THIS
+        engine's weights — cached embeddings must never outlive the
+        weights that produced them. Returns the number of carried
+        sessions.
+
+        Why cutover and not prewarm: blue keeps LAUNCHING during prewarm,
+        and every blue stream advance DONATES its pool buffer — a pool
+        adopted early would be a deleted jax array by the time green
+        serves it (and sessions established after an early carry would be
+        silently lost). Under the launch lock blue is quiesced, so the
+        adopt is race-free; `prepare_carry_from` pre-compiles the
+        re-embed + stream steps at prewarm time so the only cutover cost
+        is bounded execution (measured in swap_blackout_ms, honestly)."""
+        from pytorchvideo_accelerate_tpu.obs import trace
+
+        # traced: the carry is the session-state handoff between engines
+        # (the swap-timeline hop the trace-propagation rule guards)
+        with trace.span("stream_state_carry", engine=self.name):
+            self.table.adopt(blue.table)
+            carried = len(self.table.sessions())
+            with blue._lock:
+                blue_pools = dict(blue._pools)
+            # re-embed OUTSIDE self._lock: _reembed_fn takes the same
+            # non-reentrant lock on a compile-cache miss (a geometry blue
+            # grew mid-prewarm), and the scheduler's launch lock already
+            # serializes this whole carry against launches
+            adopted = {}
+            for geom, pool in blue_pools.items():
+                mine = {"raw": pool["raw"], "tok": None,
+                        "cap": pool["cap"], "bytes": pool["bytes"]}
+                if self.kind == "tokens":
+                    mine["tok"] = self._reembed_pool(geom, pool["raw"])
+                adopted[geom] = mine
+            with self._lock:
+                for geom, mine in adopted.items():
+                    prior = self._pools.pop(geom, None)
+                    if prior is not None:
+                        self._committed -= prior["bytes"]
+                    self._pools[geom] = mine
+                    self._committed += mine["bytes"]
+        logger.info("stream: carried %d session(s), %d pool(s) across "
+                    "hot-swap", carried, len(blue_pools))
+        return carried
+
+    def _reembed_fn(self, rows: int):
+        """Jitted whole-pool re-embed, cached per row count (compiled at
+        `prepare_carry_from` so the cutover-time carry only executes)."""
+        import jax
+
+        key = ("reembed", rows)
+        fn = self._fns.get(key)
+        if fn is None:
+            with self._lock:
+                fn = self._fns.get(key)
+                if fn is None:
+                    def reembed(params, frames):
+                        if self.quantization == "int8":
+                            from pytorchvideo_accelerate_tpu.serving.quantize import (  # noqa: E501
+                                dequantize_tree,
+                            )
+
+                            params = dequantize_tree(
+                                params, self.engine._compute_dtype)
+                        return self._embed_tokens(params, frames)
+
+                    fn = jax.jit(reembed)
+                    self._fns[key] = fn
+        return fn
+
+    def _reembed_pool(self, geom, raw):
+        """Re-embed a whole raw pool ((rows, T, H, W, C)) into a token
+        pool under this engine's params — one jitted batch (compiled in
+        advance by `prepare_carry_from`)."""
+        m = self._tok_meta
+        tok = self._reembed_fn(raw.shape[0])(self.engine.params, raw)
+        expect = (raw.shape[0], geom[0] // m["tt"],
+                  (geom[1] // m["p"]) * (geom[2] // m["p"]), m["dim"])
+        assert tuple(tok.shape) == expect, (tok.shape, expect)
+        return tok
+
+    def prepare_carry_from(self, blue: "StreamingEngine") -> int:
+        """Prewarm half of the state carry (fleet/hotswap.prewarm_like):
+        COMPILE every stream step the blue engine serves plus the
+        whole-pool re-embed, by executing scratch/dummy calls — jax.jit
+        is lazy, so merely constructing the wrappers would leave the
+        first post-swap advance to compile on the flush thread (the cold
+        start `warmup_stream` exists to prevent). Touches no blue
+        buffer: blue keeps launching (and donating) during prewarm."""
+        n = 0
+        seen = set()
+        for key in blue.compiled_stream_keys():
+            if key[0] not in ("establish", "advance"):
+                continue
+            _, _, geom, stride, _ = key
+            if (geom, stride) in seen:
+                continue
+            seen.add((geom, stride))
+            t, h, w, c, _ = geom
+            n += self.warmup_stream(t, h, w, c, stride)
+        if self.kind == "tokens":
+            with blue._lock:
+                shapes = {g: p["raw"].shape for g, p in blue._pools.items()}
+            for geom, shape in shapes.items():
+                dummy = self._replicated(
+                    np.zeros(shape, _np_dtype(geom[4])))
+                self._reembed_pool(geom, dummy)
+                n += 1
+        return n
+
+    def snapshot(self) -> Dict[str, float]:
+        snap = self.table.snapshot()
+        with self._lock:
+            snap["stream_compiled"] = float(len(self._fns))
+            snap["stream_pools"] = float(len(self._pools))
+        return snap

@@ -163,6 +163,10 @@ def test_finalize_line_fits_driver_capture():
         "pipeline_bubble_frac": 0.0171,
         "pipeline_bubble_frac_analytic": 0.2727, "pipeline_stages": 4,
         "pipeline_error": "no trustworthy device numbers " + "p" * 200,
+        "stream_incremental_speedup": 4.144,
+        "stream_h2d_bytes_frac": 0.125, "stream_p99_ms": 62.75,
+        "stream_parity": True, "stream_recompiles": 0,
+        "stream_error": "no trustworthy device numbers " + "s" * 200,
         "kbench_platform": "cpu", "kbench_parity_ok": True,
         "kbench_best": "dw_x3d_res3:118.167x",
         "kbench_dw_x3d_res3_speedup": 118.167,
@@ -371,6 +375,61 @@ def test_finalize_trace_keys_ride_the_headline():
         user_smoke=False)
     assert "trace_sampled" not in out
     assert "trace_overhead_frac" not in out
+
+
+def test_finalize_stream_keys_ride_the_headline():
+    """The STREAM lane's headline keys (per-label full/incremental cost
+    ratio, exact per-advance H2D byte fraction, label p99 under open-loop
+    stream load — the numbers `--smoke` asserts) plumb through finalize
+    with the parity/recompile verdicts; a failed, parity-broken, or
+    cpu-fallback lane headlines stream_error INSTEAD of the numbers
+    while the verdicts ride regardless (the fleet/dataplane refusal
+    rule)."""
+    extras = {"stream_incremental_speedup": 4.1,
+              "stream_h2d_bytes_frac": 0.125,
+              "stream_p99_ms": 62.8,
+              "stream_parity": True, "stream_recompiles": 0}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["stream_incremental_speedup"] == 4.1
+    assert out["stream_h2d_bytes_frac"] == 0.125
+    assert out["stream_p99_ms"] == 62.8
+    assert out["stream_parity"] is True
+    assert out["stream_recompiles"] == 0
+
+    out = bench.finalize(
+        _model(), {**extras, "stream_error": "cpu fallback"},
+        user_smoke=False)
+    assert out["stream_error"] == "cpu fallback"
+    for key in ("stream_incremental_speedup", "stream_h2d_bytes_frac",
+                "stream_p99_ms"):
+        assert key not in out
+    # verdicts ride the refusal, like pipeline_parity does
+    assert out["stream_parity"] is True
+    assert out["stream_recompiles"] == 0
+
+
+def test_finalize_stream_keys_shed_order_and_line_budget():
+    """The STREAM keys participate in the size-shed ladder (after the
+    fleet group, before dataplane/kbench) and the worst-case payload
+    still fits the driver's capture window with them present."""
+    import json
+
+    models = {}
+    for name in bench.WORKLOADS:
+        models.update(_model(name))
+    extras = {
+        "serve_rps": 123.456, "serve_p99_ms_under_load": 87.654,
+        "swap_blackout_ms": 12.345, "fleet_shed_frac": 0.0123,
+        "stream_incremental_speedup": 4.144,
+        "stream_h2d_bytes_frac": 0.125, "stream_p99_ms": 62.75,
+        "stream_parity": True, "stream_recompiles": 0,
+        "stream_error": "no trustworthy device numbers " + "s" * 200,
+        "dataplane_cps": 49.71, "dataplane_workers": 2,
+        "error": "watchdog fired: " + "y" * 3000,
+    }
+    out = bench.finalize(models, extras, user_smoke=False)
+    line = json.dumps(out)
+    assert len(line.encode()) <= bench.MAX_LINE_BYTES, len(line.encode())
 
 
 def test_finalize_serving_lane_keys():
