@@ -57,6 +57,14 @@ HIGHER_BETTER = (
     # STREAM lane: per-label cost ratio, full-recompute / incremental
     # (streaming/; docs/SERVING.md § streaming)
     "stream_incremental_speedup",
+    # STREAM lane trunk reuse: per-label advance cost ratio, full-trunk
+    # token ring / KV-ring incremental trunk (docs/SERVING.md
+    # § trunk-reuse) — only headlined when the top-1 quality gate holds
+    "stream_trunk_speedup",
+    # incremental banded attention vs full-recompute attention at the
+    # videomae_b stream shape (ops/attention.incremental_band_attention)
+    "kbench_attn_causal_inc_speedup",
+    "kbench_attn_windowed_inc_speedup",
 )
 LOWER_BETTER = (
     "step_ms_blocked",
@@ -74,6 +82,10 @@ LOWER_BETTER = (
     # the exact per-advance H2D payload fraction (s/T)
     "stream_p99_ms",
     "stream_h2d_bytes_frac",
+    # trunk-reuse quality gate: |top-1(full) - top-1(banded)| on the
+    # fixed-seed synthetic eval — the gate that decides whether
+    # stream_trunk_speedup may headline at all
+    "stream_trunk_top1_delta",
 )
 
 

@@ -188,6 +188,16 @@ class ModelConfig:
     # trading one extra forward of recompute for the activation HBM that
     # gates long clips / bigger batches on a fixed chip
     remat: bool = False
+    # temporal attention band for the VideoMAE classifier trunk
+    # (models/videomae.py; docs/SERVING.md § trunk-reuse): "none" =
+    # bidirectional, byte-for-byte the pre-knob graph; "causal" = a
+    # token attends only its own and earlier temporal slots; "windowed"
+    # = only the trailing attn_window slots. The banded trunk is what
+    # makes per-tubelet states KV-cacheable for streaming serving
+    # (--serve.stream_trunk) — finetune with the mask on so serving
+    # accuracy recovers (the recipe in docs/SERVING.md).
+    attn_mask: str = "none"  # none | causal | windowed
+    attn_window: int = 0     # temporal slots (= frames / tubelet_t)
 
 
 @dataclass
@@ -330,6 +340,17 @@ class ServeConfig:
     # start `InferenceEngine.warmup` prevents for /predict. Strides that
     # do not divide the window (or the model tubelet) are skipped.
     stream_strides: str = "2"
+    # streaming trunk-compute reuse (streaming/engine.py KV rings;
+    # docs/SERVING.md § trunk-reuse): "full" = today's graph
+    # byte-for-byte (the trunk re-runs over the cached token ring each
+    # advance); "causal"/"windowed" = the banded-attention trunk whose
+    # per-tubelet K/V are cached in device-resident KV rings, so an
+    # advance computes only the new tubelets' queries. Changes the math:
+    # serve a backbone FINETUNED with the matching model.attn_mask (the
+    # quality gate + recipe in docs/SERVING.md), or eat the top-1 delta
+    # the bench STREAM lane reports. VideoMAE classifiers only —
+    # MViT/conv/dual-rate families refuse loudly.
+    stream_trunk: str = "full"  # full | causal | windowed
 
 
 @dataclass

@@ -105,10 +105,11 @@ def hot_swap(replicas: List, artifact: str, *,
             quantization=getattr(inner_blue, "quantization", None))
         if streaming_blue:
             # a streaming replica cuts over to a streaming green: the
-            # session surface (budget/TTL) carries over, and prewarm_like
-            # performs the state carry (raw rings adopt; token rings
-            # re-embed under the GREEN weights so no cached feature ever
-            # outlives the weights that produced it)
+            # session surface (budget/TTL) AND the trunk mode carry over,
+            # and prewarm_like performs the state carry (raw/slow rings
+            # adopt; token/stem rings re-embed and KV rings recompute
+            # their masked trunk under the GREEN weights so no cached
+            # activation ever outlives the weights that produced it)
             from pytorchvideo_accelerate_tpu.streaming import (
                 StreamingEngine,
             )
@@ -118,7 +119,9 @@ def hot_swap(replicas: List, artifact: str, *,
                 session_budget_mb=blue.session_budget_bytes / 1e6,
                 session_ttl_s=blue.table.ttl_s,
                 retry_after_s=blue.table.retry_after_s,
-                name=blue.name)
+                name=blue.name,
+                trunk=getattr(blue, "trunk", "full"),
+                attn_window=getattr(blue, "attn_window", 0))
         blackout = swap_replica(replica, green, prewarm=prewarm)
         per[replica.name] = round(blackout * 1e3, 3)
         logger.info("hot-swap %s: cutover blackout %.2f ms",

@@ -67,6 +67,22 @@ def _slowfast_r50(cfg: ModelConfig, dtype, mesh=None):
     )
 
 
+@register_model("slowfast_t")
+def _slowfast_t(cfg: ModelConfig, dtype, mesh=None):
+    """Deliberately tiny SlowFast (the `tiny3d` of the dual-pathway
+    family): one block per stage, 16-channel stem — the dual-rate
+    streaming-ring tests and chaos legs compile it in seconds on a CPU
+    host. Not a reference architecture."""
+    return SlowFast(
+        num_classes=cfg.num_classes, depths=(1, 1, 1, 1),
+        stem_features=16,
+        alpha=cfg.slowfast_alpha,
+        dropout_rate=cfg.dropout_rate,
+        fused=cfg.fused_kernels,
+        dtype=dtype,
+    )
+
+
 @register_model("slowfast_r101")
 def _slowfast_r101(cfg: ModelConfig, dtype, mesh=None):
     return SlowFast(
@@ -184,6 +200,8 @@ def _videomae_b(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
         shard_mesh=mesh,  # block-boundary activation anchors (GSPMD)
         pipeline=pipeline,  # SPMD stage pipeline (parallel/pipeline.py)
         remat=cfg.remat,
+        attn_mask=cfg.attn_mask,  # banded trunk (streaming KV reuse)
+        attn_window=cfg.attn_window,
         dtype=dtype,
     )
 
@@ -214,7 +232,25 @@ def _videomae_t(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
         tubelet=(2, 8, 8), dropout_rate=cfg.dropout_rate,
         attention_backend=cfg.attention,
         context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
-        shard_mesh=mesh, pipeline=pipeline, remat=cfg.remat, dtype=dtype,
+        shard_mesh=mesh, pipeline=pipeline, remat=cfg.remat,
+        attn_mask=cfg.attn_mask, attn_window=cfg.attn_window, dtype=dtype,
+    )
+
+
+@register_model("mvit_t")
+def _mvit_t(cfg: ModelConfig, dtype, mesh=None, pipeline=None):
+    """Deliberately tiny MViT (the `videomae_t` of the multiscale family):
+    depth 2, dim 16, uniform schedule — CI smokes and the streaming
+    stem-seam tests compile it in seconds on a CPU host. Not a reference
+    architecture."""
+    return MViT(
+        num_classes=cfg.num_classes, depth=2, embed_dim=16, num_heads=2,
+        stage_starts=(), drop_path_rate=0.0,
+        dropout_rate=cfg.dropout_rate,
+        attention_backend=cfg.attention,
+        context_mesh=mesh if cfg.attention in ("ring", "ulysses") else None,
+        shard_mesh=mesh, pipeline=pipeline,
+        depthwise_impl=cfg.depthwise_impl, remat=cfg.remat, dtype=dtype,
     )
 
 

@@ -276,14 +276,24 @@ class MViT(nn.Module):
                 tuple(self.initial_kv_stride))
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, from_stem: bool = False):
+        """`from_stem=True` (streaming token seam, streaming/engine.py):
+        `x` is the POST-stem, pre-positional token grid (B, T', H', W',
+        embed_dim) and the patch-embed conv is skipped — the streaming
+        engine caches stem tokens per temporal slot (the (3,7,7)/(2,4,4)
+        stem's temporal receptive field is one frame of left halo, which
+        the raw-frame ring supplies) and re-enters the trunk here. The
+        learned pos_embed is added at trunk time in window order, so the
+        rotating ring start is invisible to the model. Param tree is
+        identical on both paths (init always traces the conv)."""
         x = x.astype(self.dtype)
-        x = nn.Conv(
-            self.embed_dim, kernel_size=self.patch_kernel,
-            strides=self.patch_stride,
-            padding=[(k // 2, k // 2) for k in self.patch_kernel],
-            dtype=self.dtype, name="patch_embed",
-        )(x)
+        if not from_stem:
+            x = nn.Conv(
+                self.embed_dim, kernel_size=self.patch_kernel,
+                strides=self.patch_stride,
+                padding=[(k // 2, k // 2) for k in self.patch_kernel],
+                dtype=self.dtype, name="patch_embed",
+            )(x)
         B, T, H, W, _ = x.shape
         pos = self.param(
             "pos_embed", nn.initializers.truncated_normal(0.02),

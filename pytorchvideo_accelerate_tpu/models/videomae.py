@@ -86,7 +86,7 @@ class ViTBlock(nn.Module):
     dtype: Dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, mask=None):
         B, N, _ = x.shape
         head_dim = self.dim // self.num_heads
         y = nn.LayerNorm(dtype=self.dtype, name="norm1")(x)
@@ -96,7 +96,7 @@ class ViTBlock(nn.Module):
         attn = dot_product_attention(
             q.reshape(shape), k.reshape(shape), v.reshape(shape),
             backend=self.attention_backend, mesh=self.context_mesh,
-            axis_name=self.context_axis,
+            axis_name=self.context_axis, mask=mask,
         ).reshape(B, N, self.dim)
         x = x + nn.Dense(self.dim, dtype=self.dtype, name="proj")(attn)
 
@@ -128,7 +128,7 @@ class CubeEmbed(nn.Module):
 
 def run_vit_blocks(mod: nn.Module, tokens, *, prefix: str, depth: int,
                    dim: int, num_heads: int,
-                   pipeline: Optional[PipelinePlan]):
+                   pipeline: Optional[PipelinePlan], mask=None):
     """Run a named stack of ViTBlocks, pipelined when a plan is active.
 
     The pipelined path reads the blocks' param subtrees straight off the
@@ -142,6 +142,12 @@ def run_vit_blocks(mod: nn.Module, tokens, *, prefix: str, depth: int,
     `plan.cp_axis` is only set when CP composes on the library mesh)."""
     plan = pipeline
     if plan is not None and plan.active and not mod.is_initializing():
+        if mask is not None:
+            raise ValueError(
+                "attn_mask trunks do not compose with pipeline_stages>1: "
+                "the stage scan's per-block fn takes no mask operand (and "
+                "the causal band would cross stage cuts) — run the masked "
+                "trunk unpipelined, or drop model.attn_mask")
         template = ViTBlock(
             dim=dim, num_heads=num_heads,
             attention_backend=mod.attention_backend,
@@ -156,7 +162,7 @@ def run_vit_blocks(mod: nn.Module, tokens, *, prefix: str, depth: int,
             attention_backend=mod.attention_backend,
             context_mesh=mod.context_mesh, dtype=mod.dtype,
             name=f"{prefix}{i}",
-        )(tokens)
+        )(tokens, mask)
         tokens = constrain_block(tokens, mod.shard_mesh)
     return tokens
 
@@ -183,6 +189,17 @@ class VideoMAEEncoder(nn.Module):
     remat: bool = False  # per-block jax.checkpoint: boundary activations only
     final_norm: bool = True  # off for mean-pooling classifiers (fc_norm after
     # the pool instead — the official VideoMAE fine-tune arrangement)
+    # temporal attention band (streaming trunk-compute reuse,
+    # docs/SERVING.md § trunk-reuse): "none" = bidirectional (the
+    # baseline, byte-for-byte); "causal" = a token attends only its own
+    # and earlier temporal slots; "windowed" = only the trailing
+    # `attn_window` slots. The banded trunk makes per-tubelet states a
+    # pure function of their trailing context, which is what lets the
+    # streaming engine cache K/V per ring slot — and it changes the
+    # math, so serving it rides the evaluate() quality gate and the
+    # short finetune recipe that adapts a bidirectional backbone.
+    attn_mask: str = "none"  # none | causal | windowed
+    attn_window: int = 0     # temporal slots, "windowed" only
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -194,12 +211,36 @@ class VideoMAEEncoder(nn.Module):
         n = tokens.shape[1]
         pos = jnp.asarray(sincos_pos_embed(n, self.dim))[None]
         tokens = tokens + pos.astype(tokens.dtype)
+        mask = None
+        if self.attn_mask != "none":
+            if keep_idx is not None:
+                raise ValueError(
+                    "attn_mask trunks do not compose with tube-masked "
+                    "pretraining (keep_idx gathers break the temporal-"
+                    "slot band); finetune the classifier instead")
+            from pytorchvideo_accelerate_tpu.ops.attention import (
+                temporal_band_mask,
+            )
+
+            if self.attn_mask == "causal":
+                window = t
+            elif self.attn_mask == "windowed":
+                if not (1 <= self.attn_window <= t):
+                    raise ValueError(
+                        f"attn_mask='windowed' needs 1 <= attn_window <= "
+                        f"{t} temporal slots, got {self.attn_window}")
+                window = self.attn_window
+            else:
+                raise ValueError(
+                    f"unknown attn_mask {self.attn_mask!r} "
+                    "(none|causal|windowed)")
+            mask = temporal_band_mask(t, h * w, window)[None, None]
         if keep_idx is not None:
             tokens = jnp.take_along_axis(tokens, keep_idx[..., None], axis=1)
         tokens = run_vit_blocks(self, tokens, prefix="block",
                                 depth=self.depth, dim=self.dim,
                                 num_heads=self.num_heads,
-                                pipeline=self.pipeline)
+                                pipeline=self.pipeline, mask=mask)
         if self.final_norm:
             tokens = nn.LayerNorm(dtype=self.dtype, name="norm")(tokens)
         return tokens, (t, h, w)
@@ -352,6 +393,11 @@ class VideoMAEClassifier(nn.Module):
     shard_mesh: Optional[Any] = None  # block-boundary constraints (no-op when None)
     pipeline: Optional[PipelinePlan] = None  # parallel/pipeline.py plan
     remat: bool = False
+    # temporal attention band (see VideoMAEEncoder.attn_mask): the
+    # finetune-facing knob — `--model.attn_mask causal` fine-tunes a
+    # backbone whose trunk states the streaming engine can KV-cache
+    attn_mask: str = "none"  # none | causal | windowed
+    attn_window: int = 0     # temporal slots, "windowed" only
     dtype: Dtype = jnp.float32
 
     @nn.compact
@@ -361,6 +407,7 @@ class VideoMAEClassifier(nn.Module):
             tubelet=self.tubelet, attention_backend=self.attention_backend,
             context_mesh=self.context_mesh, shard_mesh=self.shard_mesh,
             pipeline=self.pipeline, remat=self.remat,
+            attn_mask=self.attn_mask, attn_window=self.attn_window,
             final_norm=False, dtype=self.dtype, name="encoder",
         )(x)
         feat = tokens.mean(axis=1)

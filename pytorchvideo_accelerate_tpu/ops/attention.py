@@ -14,6 +14,13 @@ implementation is a deployment choice, not a model choice:
 
 Shapes: q (B, Nq, H, D), k/v (B, Nkv, H, D) — BNHD, heads separate, the
 layout XLA:TPU prefers for attention (no pre-transpose of the token axis).
+
+Masked variants (`mask=`): a boolean mask broadcastable to
+(B, H, Nq, Nk), True = attend. Used by the causal/windowed trunk
+variants (models/videomae.py `attn_mask`) and the streaming KV-ring
+incremental step (streaming/engine.py); the banded-time helpers below
+build the masks from temporal-slot indices, so every caller shares one
+definition of "slot qi may read slot kj iff 0 <= qi - kj < window".
 """
 
 from __future__ import annotations
@@ -26,30 +33,80 @@ import jax.numpy as jnp
 from pytorchvideo_accelerate_tpu.precision import f32_island
 
 
-def dense_attention(q, k, v, scale: Optional[float] = None, kmask=None):
+def dense_attention(q, k, v, scale: Optional[float] = None, kmask=None,
+                    mask=None):
     """Reference attention. `kmask`: optional (Nk,) bool — False keys are
-    excluded from the softmax (used for padded keys by the CP wrappers)."""
+    excluded from the softmax (used for padded keys by the CP wrappers).
+    `mask`: optional bool broadcastable to (B, H, Nq, Nk), True = attend
+    (the banded-trunk contract)."""
     if scale is None:
         scale = q.shape[-1] ** -0.5
     # f32 softmax logits: the designed island every attention impl shares
     logits = f32_island(jnp.einsum("bqhd,bkhd->bhqk", q, k)) * scale
     if kmask is not None:
         logits = jnp.where(kmask[None, None, None, :], logits, -1e30)
+    if mask is not None:
+        logits = jnp.where(mask, logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
 
 
-def fused_attention(q, k, v, scale: Optional[float] = None, kmask=None):
+def fused_attention(q, k, v, scale: Optional[float] = None, kmask=None,
+                    mask=None):
     """XLA's fused attention (flash-style chunking on TPU — no materialized
     N^2 score matrix) with the same key-mask contract as `dense_attention`.
     The CP wrappers use this for their local attention so peak memory stays
     O(N) at the long sequences that motivate context parallelism."""
-    mask = None if kmask is None else kmask[None, None, None, :]
+    if kmask is not None:
+        km = kmask[None, None, None, :]
+        mask = km if mask is None else jnp.logical_and(mask, km)
     return jax.nn.dot_product_attention(q, k, v, mask=mask, scale=scale)
 
 
+def banded_time_mask(q_idx, k_idx, window: int):
+    """Boolean band mask over ABSOLUTE temporal-slot indices: query slot
+    qi may attend key slot kj iff ``0 <= qi - kj < window``.
+
+    `q_idx` (..., Nq) / `k_idx` (..., Nk) int arrays (traced or static) ->
+    (..., Nq, Nk) bool. Absolute indices are the wraparound-proof
+    formulation the streaming KV rings rely on: a ring slot's position
+    never aliases a future slot because the band is on the un-wrapped
+    index, not the ring offset (docs/SERVING.md § trunk-reuse)."""
+    delta = q_idx[..., :, None] - k_idx[..., None, :]
+    return jnp.logical_and(delta >= 0, delta < window)
+
+
+def temporal_band_mask(t: int, hw: int, window: int):
+    """(t*hw, t*hw) bool mask for a full-clip trunk forward: token i at
+    temporal slot i // hw attends token j iff its slot is within the
+    trailing `window` slots (inclusive of its own). `window >= t` is plain
+    temporal causality; smaller windows are the "windowed" variant. All
+    hw spatial tokens of one slot share fate (space is never masked)."""
+    slots = jnp.arange(t, dtype=jnp.int32)
+    band = banded_time_mask(slots, slots, window)           # (t, t)
+    return jnp.repeat(jnp.repeat(band, hw, axis=0), hw, axis=1)
+
+
+def incremental_band_attention(q, k, v, q_slot, k_slot, window: int, hw: int,
+                               impl: str = "fused"):
+    """Incremental banded attention: the s-new-slots' queries against a
+    cached-window + new K/V, masked by absolute temporal-slot index.
+
+    q (B, nq*hw, H, D) — queries of the nq NEW slots only;
+    k/v (B, nk*hw, H, D) — cached ring keys ++ new keys;
+    q_slot (B, nq) / k_slot (B, nk) — absolute slot indices (traced).
+    This is the exact attention op the streaming KV advance runs per
+    layer, exposed standalone so pva-tpu-kbench can time it against the
+    full-recompute attention at real model shapes."""
+    band = banded_time_mask(q_slot, k_slot, window)          # (B, nq, nk)
+    mask = jnp.repeat(jnp.repeat(band, hw, axis=1), hw, axis=2)
+    fn = dense_attention if impl == "dense" else fused_attention
+    return fn(q, k, v, mask=mask[:, None])                   # (B,1,Nq,Nk)
+
+
 def dot_product_attention(q, k, v, backend: str = "dense",
-                          axis_name: Optional[str] = None, mesh=None):
+                          axis_name: Optional[str] = None, mesh=None,
+                          mask=None):
     """Route to an attention implementation.
 
     For the context-parallel backends ("ring"/"ulysses") exactly one of two
@@ -62,12 +119,24 @@ def dot_product_attention(q, k, v, backend: str = "dense",
       everywhere else);
     - `axis_name=...` and no mesh — caller is already inside a `shard_map`
       with that axis bound; q/k/v are local sequence shards.
+
+    `mask`: optional bool broadcastable to (B, H, Nq, Nk), True = attend
+    (the causal/windowed trunk variants). Dense backend only: the pallas
+    flash kernel and the context-parallel backends have no masked
+    lowering here — they refuse loudly rather than silently dropping the
+    mask (a bidirectional answer under a causal contract is a
+    correctness bug, not a fallback).
     """
     if backend == "dense":
         # XLA's fused attention (flash-style chunking on TPU) — measured ~4x
         # faster than the materialized-einsum path at MViT token counts on
         # v5e; `dense_attention` above stays as the numerics reference.
-        return jax.nn.dot_product_attention(q, k, v)
+        return jax.nn.dot_product_attention(q, k, v, mask=mask)
+    if mask is not None:
+        raise NotImplementedError(
+            f"attention backend {backend!r} has no masked lowering; "
+            "causal/windowed trunks need backend='dense' "
+            "(model.attention) — see docs/SERVING.md § trunk-reuse")
     if backend == "pallas":
         from pytorchvideo_accelerate_tpu.ops.pallas_attention import flash_attention
 

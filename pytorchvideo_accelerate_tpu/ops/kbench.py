@@ -164,6 +164,60 @@ def build_cases(smoke: bool) -> List[KernelCase]:
         functools.partial(ref_conv_bn_act, act="relu"),
         functools.partial(fused_conv3d_bn_act, act="relu"),
         (x, w, s_, bi), (xs, ws, ss, bs))
+
+    # --- streaming KV-trunk incremental attention (trunk-reuse win) -----
+    # The per-layer attention the KV-ring advance runs (streaming/
+    # engine.py `_trunk_kv_step`): the ONE new slot's queries against the
+    # cached window K/V, vs the full-recompute baseline that re-attends
+    # every window query. Real videomae_b stream shape: dim 768, 12
+    # heads, T' = 8 token slots of hw = 196 spatial tokens, 1 new slot
+    # per advance. The "pallas" lane is the einsum-dense lowering of the
+    # SAME banded op (there is no pallas masked kernel) — genuine
+    # cross-lowering parity for the band-mask arithmetic.
+    from pytorchvideo_accelerate_tpu.ops.attention import (
+        dense_attention,
+        incremental_band_attention,
+        temporal_band_mask,
+    )
+
+    def _band(kind, nslots):
+        # band width from the (static) slot count, so one closure serves
+        # the benched and the reduced interpret-parity shapes alike:
+        # causal = every trailing slot; windowed = a quarter of them
+        return nslots if kind == "causal" else max(2, nslots // 4)
+
+    def _inc_attn(kind, q_all, k, v, q_slots, k_slots, mode="auto"):
+        nslots = q_slots.shape[1]
+        hw_ = q_all.shape[1] // nslots
+        q_new = q_all[:, -hw_:]                       # ONE new slot
+        return incremental_band_attention(
+            q_new, k, v, q_slots[:, -1:], k_slots, _band(kind, nslots),
+            hw_, impl=("dense" if mode == "pallas" else "fused"))
+
+    def _inc_attn_ref(kind, q_all, k, v, q_slots, k_slots):
+        # the full-recompute baseline: every slot's queries re-attend,
+        # then only the new slot's rows are read out
+        nslots = q_slots.shape[1]
+        hw_ = q_all.shape[1] // nslots
+        mask = temporal_band_mask(nslots, hw_,
+                                  _band(kind, nslots))[None, None]
+        return dense_attention(q_all, k, v, mask=mask)[:, -hw_:]
+
+    heads, hd, tn, hw_a = (2, 8, 4, 4) if smoke else (12, 64, 8, 196)
+    qkv_shape = (1, (tn + 1) * hw_a, heads, hd)
+    q_all, kk, vv = clips(qkv_shape), clips(qkv_shape), clips(qkv_shape)
+    slots = jnp.arange(tn + 1, dtype=jnp.int32)[None]
+    qs, ks2, vs2 = (clips((1, 10, 2, 8)) for _ in range(3))
+    sl_s = jnp.arange(5, dtype=jnp.int32)[None]
+    for kind in ("causal", "windowed"):
+        add(f"attn_{kind}_inc",
+            f"videomae_b stream advance, {kind} band W="
+            f"{_band(kind, tn + 1)} (T'={tn}, hw={hw_a}, 1 new slot)",
+            (1, (tn + 1) * hw_a, heads, hd),
+            functools.partial(_inc_attn_ref, kind),
+            functools.partial(_inc_attn, kind),
+            (q_all, kk, vv, slots, slots),
+            (qs, ks2, vs2, sl_s, sl_s), rtol=2e-4, atol=2e-4)
     return cases
 
 

@@ -1018,6 +1018,220 @@ def leg_stream_replica_kill(report: dict, seed: int, log: Log) -> None:
                 pass
 
 
+# subprocess body for leg_stream_kv_kill: a REAL causal-masked
+# videomae_t served through KV-ring streaming (trunk="causal") behind
+# the real fleet Scheduler + InferenceServer. Deterministic by
+# construction (jax.random.key(0) init, CPU) so a same-seed local
+# engine reproduces every state the fleet can reach. One JSON line
+# {{"url": ...}} once warmed + bound, then serve.
+_KV_SRV_CODE = """
+import json
+
+import numpy as np
+import jax
+
+from pytorchvideo_accelerate_tpu.config import ModelConfig
+from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+from pytorchvideo_accelerate_tpu.models import create_model
+from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
+from pytorchvideo_accelerate_tpu.serving.server import InferenceServer
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+cfg = ModelConfig(name="videomae_t", num_classes={ncls},
+                  dropout_rate=0.0, attn_mask="causal")
+model = create_model(cfg, "fp32")
+variables = model.init(jax.random.key(0),
+                       np.zeros((1, {t}, {hw}, {hw}, 3), np.float32))
+engine = InferenceEngine(model, variables["params"],
+                         variables.get("batch_stats", {{}}),
+                         num_classes={ncls}, max_batch_size=2,
+                         model_name="videomae_t")
+stream = StreamingEngine(engine, session_budget_mb=32.0,
+                         session_ttl_s=60.0, name="chaos-kv",
+                         trunk="causal")
+stream.warmup_stream({t}, {hw}, {hw}, 3, {s})
+stats = ServingStats(window=512)
+sched = Scheduler(stream, stats=stats, max_queue=128,
+                  realtime_deadline_ms=30000.0)
+srv = InferenceServer(stream, sched, stats, host="127.0.0.1", port=0,
+                      request_timeout_s=60.0)
+host, port = srv.address
+print(json.dumps({{"url": "http://%s:%d" % (host, port)}}), flush=True)
+srv.serve_forever(drain_on_sigterm=False)
+"""
+
+
+def leg_stream_kv_kill(report: dict, seed: int, log: Log) -> None:
+    """SIGKILL the replica holding KV-BACKED streaming sessions (a real
+    causal-trunk videomae_t, per-layer KV rings) mid-stream: affinity
+    re-routes every session to the survivor, which re-establishes from
+    the client's resendable window — rebuilding the KV ring state
+    deterministically. The verdict is exact, not shape-level: every
+    label through the kill must match a same-seed local engine to the
+    serving tolerance, where the expectation legitimately FORKS at the
+    re-establish (a fresh KV establish carries window-only context and
+    window-order positions — the carry-semantics twin of hot-swap), so
+    the local mirror forks exactly where the fleet did, and at least
+    one victim session must take the fork."""
+    import signal as _signal
+    import subprocess
+
+    import jax
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        HttpReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
+    from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+    leg = _leg(report, "stream_kv_kill")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    T, S, HW, NCLS = 8, 2, 16, 4
+    TOL = 2e-4
+    n_sessions, n_advances, kill_after = 4, 6, 3
+    procs: List[subprocess.Popen] = []
+    router = None
+    rng = np.random.default_rng(seed)
+    try:
+        for _ in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 _KV_SRV_CODE.format(t=T, s=S, hw=HW, ncls=NCLS)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True))
+        # the same-seed oracle, built while the replicas warm: same init
+        # key + trunk mode -> bit-for-bit the weights they serve; its
+        # sessions mirror the remote session state branch by branch
+        cfg = ModelConfig(name="videomae_t", num_classes=NCLS,
+                          dropout_rate=0.0, attn_mask="causal")
+        model = create_model(cfg, "fp32")
+        variables = model.init(
+            jax.random.key(0), np.zeros((1, T, HW, HW, 3), np.float32))
+        oracle = StreamingEngine(
+            InferenceEngine(model, variables["params"],
+                            variables.get("batch_stats", {}),
+                            num_classes=NCLS, max_batch_size=2,
+                            model_name="videomae_t"),
+            session_budget_mb=32.0, session_ttl_s=600.0,
+            name="chaos-kv-oracle", trunk="causal")
+
+        def want(item):
+            out = oracle.advance_batch([dict(item)])[0]
+            if isinstance(out, Exception):
+                raise out
+            return np.asarray(out, np.float32)
+
+        replicas = [HttpReplica(f"kvr-{i}", _read_url_line(p),
+                                pid=p.pid, timeout_s=60.0)
+                    for i, p in enumerate(procs)]
+        pool = ReplicaPool(replicas, health_interval_s=0.25)
+        router = Router(pool, retries=3)
+
+        windows = {f"st-{i}": rng.standard_normal(
+            (T, HW, HW, 3)).astype(np.float32) for i in range(n_sessions)}
+        failures, sheds, mismatches, forked = 0, 0, 0, 0
+        for sid, win in windows.items():
+            out = np.asarray(router.submit(
+                {}, session={"sid": sid, "window": win,
+                             "stride": S}).result(timeout=60), np.float32)
+            if float(np.max(np.abs(out - want(
+                    {"sid": sid, "window": win, "stride": S})))) > TOL:
+                mismatches += 1
+        holders = {sid: router._affinity.get(sid) for sid in windows}
+        victim_name = replicas[0].name
+        victim_sessions = [s for s, h in holders.items()
+                           if h == victim_name]
+        leg["victim_sessions"] = len(victim_sessions)
+        for k in range(n_advances):
+            if k == kill_after:
+                os.kill(procs[0].pid, _signal.SIGKILL)
+                log(f"[chaos] stream_kv_kill: killed {victim_name} "
+                    f"holding {len(victim_sessions)} KV session(s)")
+            futs, sent = {}, {}
+            for sid in windows:
+                frames = rng.standard_normal(
+                    (S, HW, HW, 3)).astype(np.float32)
+                sent[sid] = frames
+                windows[sid] = np.concatenate(
+                    [windows[sid][S:], frames], axis=0)
+                futs[sid] = router.submit(
+                    {"video": frames},
+                    session={"sid": sid, "window": windows[sid],
+                             "stride": S})
+            for sid, fut in futs.items():
+                try:
+                    out = np.asarray(fut.result(timeout=60), np.float32)
+                except Exception as e:  # noqa: BLE001 - verdict, not crash
+                    from pytorchvideo_accelerate_tpu.serving.batcher import (
+                        QueueFullError,
+                    )
+
+                    if isinstance(e, QueueFullError):
+                        sheds += 1
+                    else:
+                        failures += 1
+                    continue
+                adv = want({"sid": sid, "frames": sent[sid]})
+                if float(np.max(np.abs(out - adv))) <= TOL:
+                    continue
+                # the advance expectation missed: the fleet may have
+                # re-established this session from the resendable window
+                # (replica death). Fork the mirror the same way and
+                # re-judge — the fresh-establish KV rebuild is itself
+                # deterministic, so this branch is exact too.
+                oracle.end_session(sid)
+                est = want({"sid": sid, "window": windows[sid],
+                            "stride": S})
+                if float(np.max(np.abs(out - est))) <= TOL:
+                    forked += 1
+                    continue
+                mismatches += 1
+        moved = [s for s in victim_sessions
+                 if router._affinity.get(s) not in (None, victim_name)]
+        leg.update(advances=n_advances * n_sessions, failed=failures,
+                   shed=sheds, mismatches=mismatches, moved=len(moved),
+                   kv_reestablished=forked)
+        if failures:
+            _finding(report, "stream_kv_kill",
+                     f"{failures} non-shed client-visible failure(s) "
+                     "across the kill (affinity re-route + KV "
+                     "re-establish must absorb replica death)")
+        if mismatches:
+            _finding(report, "stream_kv_kill",
+                     f"{mismatches} label(s) matched NEITHER the "
+                     "continuous-KV expectation nor the deterministic "
+                     "window-rebuild (KV state did not survive or "
+                     "rebuild correctly)")
+        if victim_sessions and not forked:
+            _finding(report, "stream_kv_kill",
+                     "no session took the re-establish fork: the kill "
+                     "never exercised the KV rebuild-from-window path")
+        if victim_sessions and not moved:
+            _finding(report, "stream_kv_kill",
+                     "no victim session re-routed off the killed replica")
+        log(f"[chaos] stream_kv_kill: {n_advances * n_sessions} advances "
+            f"over {n_sessions} KV sessions through the kill "
+            f"({failures} failed, {sheds} shed, {mismatches} mismatches, "
+            f"{forked} deterministic KV re-establishes, "
+            f"{len(moved)}/{len(victim_sessions)} victims re-homed)")
+    finally:
+        if router is not None:
+            router.close()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10.0)
+            except Exception:
+                pass
+
+
 def leg_guard_nan(report: dict, tmpdir: str, seed: int, log: Log) -> None:
     """NaN spike mid-epoch (seeded ``nan`` faults at `step.dispatch`): the
     in-graph skip absorbs the first poisoned step, the second crosses the
@@ -1498,6 +1712,7 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                     (leg_serve, (report, seed, log)),
                     (leg_replica_kill, (report, seed, log)),
                     (leg_stream_replica_kill, (report, seed, log)),
+                    (leg_stream_kv_kill, (report, seed, log)),
                     (leg_collective_hang, (report, seed, log)),
                     (leg_guard_nan, (report, tmpdir, seed, log)),
                     (leg_preempt, (report, tmpdir, seed, log)),

@@ -107,6 +107,32 @@ def dequantize_tree(tree: Any, dtype) -> Any:
     return jax.tree_util.tree_map(deq, tree, is_leaf=is_quant_leaf)
 
 
+def quantize_kv(kv):
+    """Per-token-row absmax int8 quantization of streaming KV-ring
+    activations (streaming/engine.py KV rings, `serve.quantization=int8`):
+    `kv` (..., dim) -> (q8 int8 same shape, scale f32 (...,)). Unlike the
+    weight path this quantizes ACTIVATIONS — per-token scales (one per
+    (layer, k/v, slot, spatial) row) keep the round-trip error bounded by
+    each token's own magnitude, so one outlier token cannot flatten its
+    neighbours' resolution. In-graph (jit) and numpy callers both work."""
+    import jax.numpy as jnp
+
+    kv32 = f32_island(kv)
+    absmax = jnp.max(jnp.abs(kv32), axis=-1)
+    scale = absmax / 127.0
+    # an all-zero row must not divide by zero; its q entries are zero
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.rint(kv32 / safe[..., None]), -127, 127).astype(jnp.int8)
+    return q, safe
+
+
+def dequantize_kv(q, scale, dtype):
+    """In-graph inverse of `quantize_kv`: q * scale per token row in an
+    f32 island, one downcast to the compute dtype (the int8-KV / fp-query
+    contract the incremental attention step reads the ring through)."""
+    return end_island(f32_island(q) * scale[..., None], dtype)
+
+
 def quantized_leaf_count(tree: Any) -> int:
     import jax
 

@@ -579,7 +579,8 @@ def build_server(cfg) -> InferenceServer:
         front_engine = StreamingEngine(
             engine, session_budget_mb=s.stream_session_budget_mb,
             session_ttl_s=s.stream_session_ttl_s,
-            retry_after_s=s.retry_after_s)
+            retry_after_s=s.retry_after_s,
+            trunk=s.stream_trunk)
         if s.scheduler != "edf":
             raise SystemExit(
                 "--serve.streaming needs the continuous-batching "

@@ -166,6 +166,9 @@ def test_finalize_line_fits_driver_capture():
         "stream_incremental_speedup": 4.144,
         "stream_h2d_bytes_frac": 0.125, "stream_p99_ms": 62.75,
         "stream_parity": True, "stream_recompiles": 0,
+        "stream_trunk_speedup": 7.345, "stream_trunk_top1_delta": 0.0312,
+        "stream_trunk_parity": True, "stream_trunk_recompiles": 0,
+        "stream_trunk_error": "top-1 delta breached " + "q" * 200,
         "stream_error": "no trustworthy device numbers " + "s" * 200,
         "kbench_platform": "cpu", "kbench_parity_ok": True,
         "kbench_best": "dw_x3d_res3:118.167x",
@@ -388,24 +391,53 @@ def test_finalize_stream_keys_ride_the_headline():
     extras = {"stream_incremental_speedup": 4.1,
               "stream_h2d_bytes_frac": 0.125,
               "stream_p99_ms": 62.8,
-              "stream_parity": True, "stream_recompiles": 0}
+              "stream_parity": True, "stream_recompiles": 0,
+              "stream_trunk_speedup": 7.3,
+              "stream_trunk_top1_delta": 0.0,
+              "stream_trunk_parity": True, "stream_trunk_recompiles": 0}
     out = bench.finalize(_model(), extras, user_smoke=False)
     assert out["stream_incremental_speedup"] == 4.1
     assert out["stream_h2d_bytes_frac"] == 0.125
     assert out["stream_p99_ms"] == 62.8
     assert out["stream_parity"] is True
     assert out["stream_recompiles"] == 0
+    assert out["stream_trunk_speedup"] == 7.3
+    assert out["stream_trunk_top1_delta"] == 0.0
+    assert out["stream_trunk_parity"] is True
+    assert out["stream_trunk_recompiles"] == 0
 
     out = bench.finalize(
         _model(), {**extras, "stream_error": "cpu fallback"},
         user_smoke=False)
     assert out["stream_error"] == "cpu fallback"
     for key in ("stream_incremental_speedup", "stream_h2d_bytes_frac",
-                "stream_p99_ms"):
+                "stream_p99_ms", "stream_trunk_speedup",
+                "stream_trunk_top1_delta"):
         assert key not in out
     # verdicts ride the refusal, like pipeline_parity does
     assert out["stream_parity"] is True
     assert out["stream_recompiles"] == 0
+    assert out["stream_trunk_parity"] is True
+    assert out["stream_trunk_recompiles"] == 0
+
+
+def test_finalize_stream_trunk_quality_refusal():
+    """The trunk-reuse quality gate (docs/SERVING.md § trunk-reuse): a
+    round whose top-1 delta breached the gate carries the delta, the
+    verdicts, and a truncated stream_trunk_error — and the lane never
+    emitted stream_trunk_speedup, so nothing speedup-shaped headlines."""
+    extras = {"stream_incremental_speedup": 4.1,
+              "stream_parity": True, "stream_recompiles": 0,
+              "stream_trunk_top1_delta": 0.31,
+              "stream_trunk_parity": True, "stream_trunk_recompiles": 0,
+              "stream_trunk_error": "top-1 delta 0.31 breaches " + "q" * 200}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert "stream_trunk_speedup" not in out
+    assert out["stream_trunk_top1_delta"] == 0.31
+    assert out["stream_trunk_parity"] is True
+    assert len(out["stream_trunk_error"]) <= 120
+    # the main stream keys are untouched by a trunk-only refusal
+    assert out["stream_incremental_speedup"] == 4.1
 
 
 def test_finalize_stream_keys_shed_order_and_line_budget():
@@ -423,6 +455,9 @@ def test_finalize_stream_keys_shed_order_and_line_budget():
         "stream_incremental_speedup": 4.144,
         "stream_h2d_bytes_frac": 0.125, "stream_p99_ms": 62.75,
         "stream_parity": True, "stream_recompiles": 0,
+        "stream_trunk_speedup": 7.345, "stream_trunk_top1_delta": 0.0312,
+        "stream_trunk_parity": True, "stream_trunk_recompiles": 0,
+        "stream_trunk_error": "top-1 delta breached " + "q" * 200,
         "stream_error": "no trustworthy device numbers " + "s" * 200,
         "dataplane_cps": 49.71, "dataplane_workers": 2,
         "error": "watchdog fired: " + "y" * 3000,

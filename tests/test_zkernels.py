@@ -347,9 +347,14 @@ def test_kbench_cases_and_headline_keys():
     cases = build_cases(smoke=True)
     names = [c.name for c in cases]
     assert names == ["dw_x3d_res3", "pw_x3d_res3", "conv133_sf_res4",
-                     "conv311_sf_res4"]
+                     "conv311_sf_res4", "attn_causal_inc",
+                     "attn_windowed_inc"]
     for c in cases:
-        assert c.attribution and len(c.args) == 4 and len(c.small_args) == 4
+        assert c.attribution
+        # conv cases: (x, w, scale, bias); KV-trunk incremental
+        # attention: (q, k, v, q_slots, k_slots)
+        want = 5 if c.name.startswith("attn_") else 4
+        assert len(c.args) == want and len(c.small_args) == want
     record = {
         "platform": "cpu", "parity_ok": True,
         "best_kernel": "dw_x3d_res3", "best_speedup": 23.0,

@@ -1,0 +1,379 @@
+"""pva-tpu-kvcache: streaming trunk-compute reuse (streaming/engine.py
+KV rings; docs/SERVING.md § trunk-reuse).
+
+Late-alphabet on purpose: tier-1 is timeout-bound and these tests pay
+for real (tiny) masked-model compiles — they must run after the cheap
+suites.
+
+Covers the ISSUE-16 checklist: causal + windowed KV-trunk parity against
+the full-history replay oracle through two ring wraparounds with flat
+jit caches, the establish-time cross-path anchor that also regression-
+locks the banded tokens-full trunk (a model finetuned with `attn_mask`
+must keep its band under `--serve.stream_trunk full`), TTL/budget
+eviction reclaiming KV slots, hot-swap state carry REBUILDING the KV
+rings under the green weights, int8 KV ring round-trip bounds, SlowFast
+dual-rate ring parity, the MViT stem-seam replay, and trainability of
+the banded finetune recipe.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.streaming.session import (
+    SessionAdmissionError,
+)
+
+T, S, CROP, NCLS = 8, 2, 16, 8
+TOL = 2e-4  # two executables over the same values: fp32 fusion noise only
+
+
+def _build_kv(attn_mask, trunk, *, attn_window=0, quant="off",
+              name=None, params_scale=None):
+    import jax
+
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
+    from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+    cfg = ModelConfig(name="videomae_t", num_classes=NCLS,
+                      dropout_rate=0.0, attn_mask=attn_mask,
+                      attn_window=attn_window)
+    model = create_model(cfg, "fp32")
+    var = model.init(jax.random.key(0),
+                     np.zeros((1, T, CROP, CROP, 3), np.float32))
+    params = var["params"]
+    if params_scale is not None:
+        params = jax.tree.map(lambda x: x * params_scale, params)
+    eng = InferenceEngine(model, params, var.get("batch_stats", {}),
+                          num_classes=NCLS, max_batch_size=2,
+                          model_name="videomae_t", quantization=quant)
+    return StreamingEngine(eng, session_budget_mb=4.0, session_ttl_s=60.0,
+                           name=name or f"zkv-{attn_mask}-{quant}",
+                           trunk=trunk)
+
+
+@pytest.fixture(scope="module")
+def causal_kv():
+    return _build_kv("causal", "causal")
+
+
+@pytest.fixture(scope="module")
+def windowed_kv():
+    # band of 2 token-time slots out of T' = T//tt = 4
+    return _build_kv("windowed", "windowed", attn_window=2)
+
+
+def test_banded_full_trunk_matches_predict(causal_kv):
+    """The establish-time cross-path anchor: at establish the KV trunk,
+    the tokens-full trunk and the one-shot `predict` are the SAME banded
+    function (positions and context coincide before any ring rotation).
+    This also regression-locks the tokens-full path's band: a model
+    finetuned with `attn_mask` served under the default `trunk="full"`
+    must keep its mask — dropping it silently computed the bidirectional
+    trunk the weights were never finetuned for."""
+    from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+    tk = causal_kv
+    tf = StreamingEngine(tk.engine, session_budget_mb=4.0,
+                         session_ttl_s=60.0, name="zkv-anchor-full",
+                         trunk="full")
+    assert tk._ring_names == ("raw", "tok", "kv", "hid")
+    assert tf._ring_names == ("raw", "tok")
+    rng = np.random.default_rng(16)
+    win = rng.standard_normal((2, T, CROP, CROP, 3)).astype(np.float32)
+    sids = ("an-a", "an-b")
+    ek = np.asarray(tk.advance_batch(
+        [{"sid": s, "window": win[i], "stride": S}
+         for i, s in enumerate(sids)]))
+    ef = np.asarray(tf.advance_batch(
+        [{"sid": s, "window": win[i], "stride": S}
+         for i, s in enumerate(sids)]))
+    ref = tk.full_recompute(win)  # the model's own banded predict
+    np.testing.assert_allclose(ek, ref, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(ef, ref, rtol=1e-6, atol=1e-6)
+    for s in sids:
+        assert tk.end_session(s) and tf.end_session(s)
+
+
+@pytest.mark.parametrize("fix", ["causal_kv", "windowed_kv"])
+def test_kv_parity_two_wraparounds(fix, request):
+    """The stateful-trunk core contract: establish + advance through TWO
+    full KV-ring wraparounds (T' = 4 slots, one slot per stride), the
+    incremental logits equal `full_recompute_history` — the whole-history
+    replay with the band on absolute slot indices and ring-slot-stable
+    positions, i.e. the cached-state semantics exactly (the last-window
+    one-shot recompute is NOT the oracle: cached K/V legitimately
+    attended context that has since left the raw ring). Zero recompiles
+    after the first warmup advance; the replay fns compile per history
+    length, so parity is judged only AFTER the flat-cache probe."""
+    se = request.getfixturevalue(fix)
+    assert se.kind == "tokens" and se.trunk != "full"
+    rng = np.random.default_rng(11)
+    sids = (f"{fix}-a", f"{fix}-b")
+    win = rng.standard_normal((2, T, CROP, CROP, 3)).astype(np.float32)
+    out = np.asarray(se.advance_batch(
+        [{"sid": s, "window": win[i], "stride": S}
+         for i, s in enumerate(sids)]))
+    np.testing.assert_allclose(out, se.full_recompute(win),
+                               rtol=TOL, atol=TOL)
+    hist = win.copy()
+
+    def step():
+        f = rng.standard_normal((2, S, CROP, CROP, 3)).astype(np.float32)
+        o = np.asarray(se.advance_batch(
+            [{"sid": s, "frames": f[i]} for i, s in enumerate(sids)]))
+        return o, np.concatenate([hist, f], axis=1)
+
+    _, hist = step()  # warmup advance, then lock the compile caches
+    sizes0, keys0 = se.compiled_stream_cache_sizes(), \
+        se.compiled_stream_keys()
+    checkpoints = []
+    wrap = T // S  # 4 advances move one full ring of slots
+    for k in range(2 * wrap):
+        out, hist = step()
+        if k in (wrap - 1, 2 * wrap - 1):  # after each full wraparound
+            checkpoints.append((out, hist.copy()))
+    assert se.compiled_stream_keys() == keys0
+    sizes1 = se.compiled_stream_cache_sizes()
+    assert sizes1 == sizes0
+    for k, v in sizes1.items():
+        assert v in (1, None), (k, v)
+    # parity AFTER the probe: each replay compiles per history length
+    for out, h in checkpoints:
+        np.testing.assert_allclose(out, se.full_recompute_history(h, T),
+                                   rtol=TOL, atol=TOL)
+    for s in sids:
+        assert se.end_session(s)
+
+
+def test_eviction_reclaims_kv_slot(causal_kv):
+    """TTL/budget eviction on the KV family: a stale holder's slot —
+    raw, token, per-layer K/V and hidden rows — is reclaimed at
+    establish, and the reused rows serve the NEW session correctly (the
+    evictee's cached trunk state must not leak into the successor)."""
+    se = causal_kv
+    rng = np.random.default_rng(12)
+    geom = se.geom_key(T, CROP, CROP, 3, se.input_dtype)
+    win = rng.standard_normal((T, CROP, CROP, 3)).astype(np.float32)
+    se.advance_batch([{"sid": "kev-a", "window": win, "stride": S}])
+    for _ in range(3):  # rotate a's ring so its KV rows are "dirty"
+        f = rng.standard_normal((S, CROP, CROP, 3)).astype(np.float32)
+        se.advance_batch([{"sid": "kev-a", "frames": f}])
+    with se.table._lock:
+        saved = list(se.table._free[geom])
+        se.table._free[geom] = []  # budget exhausted: zero free slots
+    try:
+        win_b = rng.standard_normal((T, CROP, CROP, 3)).astype(np.float32)
+        out = se.advance_batch(
+            [{"sid": "kev-b", "window": win_b, "stride": S}])
+        assert isinstance(out[0], SessionAdmissionError)  # live holder
+        with se.table._lock:
+            se.table._sessions["kev-a"].last_active -= 1e6  # expire a
+        out = se.advance_batch(
+            [{"sid": "kev-b", "window": win_b, "stride": S}])
+        assert not isinstance(out[0], Exception)
+        assert se.table.get("kev-a") is None  # evicted
+        hist = win_b.copy()
+        f = rng.standard_normal((S, CROP, CROP, 3)).astype(np.float32)
+        adv = np.asarray(se.advance_batch(
+            [{"sid": "kev-b", "frames": f}]))[0]
+        hist = np.concatenate([hist, f], axis=0)
+        np.testing.assert_allclose(
+            adv, se.full_recompute_history(hist[None], T)[0],
+            rtol=TOL, atol=TOL)
+    finally:
+        with se.table._lock:
+            se.table._free[geom].extend(saved)
+        se.end_session("kev-b")
+
+
+def test_hotswap_carry_rebuilds_kv_under_green():
+    """Blue/green swap with a live KV session: the carry adopts the raw
+    ring (weight-independent) and REBUILDS token/KV/hidden rings under
+    the green weights — cached activations never outlive the weights
+    that produced them. The rebuild has fresh-establish semantics
+    (current window's context only), so with the carry aligned to a ring
+    boundary (frames_seen % window == 0 -> off 0, slot-stable positions
+    back in phase) the green post-carry advance equals green's own
+    establish-replay over the current window — exactly, with NO window
+    resend — and differs from blue's continuous-history answer."""
+    from pytorchvideo_accelerate_tpu.fleet.hotswap import prewarm_like
+
+    blue = _build_kv("causal", "causal", name="zkv-blue")
+    rng = np.random.default_rng(13)
+    win = rng.standard_normal((T, CROP, CROP, 3)).astype(np.float32)
+    blue.advance_batch([{"sid": "hs", "window": win, "stride": S}])
+    hist = win.copy()
+    for _ in range(T // S):  # frames_seen == window: ring-aligned carry
+        f = rng.standard_normal((S, CROP, CROP, 3)).astype(np.float32)
+        blue.advance_batch([{"sid": "hs", "frames": f}])
+        hist = np.concatenate([hist, f], axis=0)
+    green = _build_kv("causal", "causal", name="zkv-green",
+                      params_scale=1.25)
+    prewarm_like(green, blue)
+    assert green.carry_state_from(blue) == 1
+    assert green.table.get("hs") is not None
+    f = rng.standard_normal((S, CROP, CROP, 3)).astype(np.float32)
+    out = np.asarray(green.advance_batch(
+        [{"sid": "hs", "frames": f}]))[0]  # NO window attached
+    cur = np.concatenate([hist[-T:], f], axis=0)
+    ref = green.full_recompute_history(cur[None], T)[0]
+    np.testing.assert_allclose(out, ref, rtol=TOL, atol=TOL)
+    blue_ref = blue.full_recompute_history(
+        np.concatenate([hist, f], axis=0)[None], T)[0]
+    assert not np.allclose(out, blue_ref, atol=1e-3)  # weights changed
+    assert green.end_session("hs")
+
+
+def test_int8_kv_ring_bounds(causal_kv):
+    """`serve.quantization=int8` stores the K/V rings int8 with
+    per-token-row scales: the ring dtype really is int8, the round-trip
+    stays within quantization error of the fp32 KV engine (same seed ->
+    identical weights), the error is NONZERO (the int8 path actually
+    engaged), and the int8 engine stays self-consistent against its own
+    replay oracle."""
+    k8 = _build_kv("causal", "causal", quant="int8")
+    assert k8._ring_names == ("raw", "tok", "kv", "kv_scale", "hid")
+    rng = np.random.default_rng(14)
+    win = rng.standard_normal((2, T, CROP, CROP, 3)).astype(np.float32)
+    sids = ("q-a", "q-b")
+    items = [{"sid": s, "window": win[i], "stride": S}
+             for i, s in enumerate(sids)]
+    e32 = np.asarray(causal_kv.advance_batch(
+        [dict(it) for it in items]))
+    e8 = np.asarray(k8.advance_batch(items))
+    d_est = float(np.max(np.abs(e32 - e8)))
+    assert 1e-7 < d_est < 1e-2, d_est
+    pool = next(iter(k8._pools.values()))
+    assert pool["kv"].dtype == np.int8
+    assert pool["kv_scale"].dtype == np.float32
+    hist = win.copy()
+    for _ in range(T // S + 1):  # through a wraparound
+        f = rng.standard_normal((2, S, CROP, CROP, 3)).astype(np.float32)
+        a32 = np.asarray(causal_kv.advance_batch(
+            [{"sid": s, "frames": f[i]} for i, s in enumerate(sids)]))
+        a8 = np.asarray(k8.advance_batch(
+            [{"sid": s, "frames": f[i]} for i, s in enumerate(sids)]))
+        hist = np.concatenate([hist, f], axis=1)
+    assert float(np.max(np.abs(a32 - a8))) < 1e-2
+    # self-parity vs the int8 replay: quantization noise re-enters along
+    # the two paths at different points, so the bound is looser than the
+    # fp32 fusion-noise TOL (measured ~2.6e-4 at this shape)
+    rep8 = k8.full_recompute_history(hist, T)
+    assert float(np.max(np.abs(a8 - rep8))) < 2e-3
+    for s in sids:
+        assert causal_kv.end_session(s) and k8.end_session(s)
+
+
+def test_slowfast_dual_rings_advance_parity():
+    """SlowFast streams on dual-rate rings: the fast ring slides by the
+    stride, the slow ring by stride/alpha, and every advance equals the
+    one-shot dual-pathway predict over the current window with the slow
+    pathway as the phase-0 subsample (the slide-stable convention) —
+    through a full ring wraparound."""
+    import jax
+
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
+    from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+    alpha, stride = 4, 4  # stride must be alpha-aligned
+    cfg = ModelConfig(name="slowfast_t", num_classes=NCLS,
+                      dropout_rate=0.0, slowfast_alpha=alpha)
+    model = create_model(cfg, "fp32")
+    var = model.init(jax.random.key(0),
+                     (np.zeros((1, T // alpha, CROP, CROP, 3), np.float32),
+                      np.zeros((1, T, CROP, CROP, 3), np.float32)))
+    eng = InferenceEngine(model, var["params"],
+                          var.get("batch_stats", {}), num_classes=NCLS,
+                          max_batch_size=1, model_name="slowfast_t")
+    se = StreamingEngine(eng, session_budget_mb=4.0, session_ttl_s=60.0,
+                         name="zkv-dual")
+    assert se.kind == "dual" and se._ring_names == ("raw", "slow")
+    rng = np.random.default_rng(15)
+    win = rng.standard_normal((T, CROP, CROP, 3)).astype(np.float32)
+    out = np.asarray(se.advance_batch(
+        [{"sid": "sf", "window": win, "stride": stride}]))[0]
+    np.testing.assert_allclose(out, se.full_recompute(win[None])[0],
+                               rtol=TOL, atol=TOL)
+    for _ in range(2 * T // stride):  # a full fast-ring wraparound
+        f = rng.standard_normal((stride, CROP, CROP, 3)).astype(np.float32)
+        win = np.concatenate([win[stride:], f], axis=0)
+        out = np.asarray(se.advance_batch(
+            [{"sid": "sf", "frames": f}]))[0]
+        np.testing.assert_allclose(out, se.full_recompute(win[None])[0],
+                                   rtol=TOL, atol=TOL)
+    assert se.end_session("sf")
+
+
+def test_mvit_stem_seam_replay_parity():
+    """The MViT stem ring caches post-conv stem slots with a real
+    temporal halo at the seam: each advance equals the full-history
+    replay (the oracle convolves the ENTIRE history, so every cached
+    slot saw its true neighbours where one-shot predict zero-pads the
+    window edge) — through a stem-ring wraparound."""
+    import jax
+
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
+    from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+    cfg = ModelConfig(name="mvit_t", num_classes=NCLS, dropout_rate=0.0)
+    model = create_model(cfg, "fp32")
+    var = model.init(jax.random.key(0),
+                     np.zeros((1, T, CROP, CROP, 3), np.float32))
+    eng = InferenceEngine(model, var["params"],
+                          var.get("batch_stats", {}), num_classes=NCLS,
+                          max_batch_size=1, model_name="mvit_t")
+    se = StreamingEngine(eng, session_budget_mb=4.0, session_ttl_s=60.0,
+                         name="zkv-stem")
+    assert se.kind == "stem" and se._ring_names == ("raw", "stem")
+    rng = np.random.default_rng(17)
+    win = rng.standard_normal((T, CROP, CROP, 3)).astype(np.float32)
+    se.advance_batch([{"sid": "mv", "window": win, "stride": S}])
+    hist = win.copy()
+    out = None
+    for _ in range(T // S + 1):  # through a stem-ring wraparound
+        f = rng.standard_normal((S, CROP, CROP, 3)).astype(np.float32)
+        out = np.asarray(se.advance_batch(
+            [{"sid": "mv", "frames": f}]))[0]
+        hist = np.concatenate([hist, f], axis=0)
+    np.testing.assert_allclose(out, se.full_recompute_history(
+        hist[None], T)[0], rtol=TOL, atol=TOL)
+    assert se.end_session("mv")
+
+
+def test_banded_model_is_trainable():
+    """The finetune recipe behind the quality gate: a model built with
+    `--model.attn_mask causal` takes gradients through the band (the
+    mask is a lax select, not a stop-gradient), so streaming deployments
+    can finetune with the trunk they will serve."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorchvideo_accelerate_tpu.config import ModelConfig
+    from pytorchvideo_accelerate_tpu.models import create_model
+
+    cfg = ModelConfig(name="videomae_t", num_classes=NCLS,
+                      dropout_rate=0.0, attn_mask="causal")
+    model = create_model(cfg, "fp32")
+    x = np.random.default_rng(18).standard_normal(
+        (1, T, CROP, CROP, 3)).astype(np.float32)
+    var = model.init(jax.random.key(0), x)
+
+    def loss(params):
+        logits = model.apply({"params": params, **{
+            k: v for k, v in var.items() if k != "params"}}, x)
+        return -jax.nn.log_softmax(logits)[0, 0]
+
+    grads = jax.grad(loss)(var["params"])
+    leaves = jax.tree.leaves(grads)
+    assert leaves and all(bool(jnp.all(jnp.isfinite(g))) for g in leaves)
+    assert any(float(jnp.max(jnp.abs(g))) > 0 for g in leaves)

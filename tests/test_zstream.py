@@ -218,7 +218,10 @@ def test_per_item_errors_do_not_fail_neighbours(frames_stream):
     se.end_session("n-good")
 
 
-def test_slowfast_refused():
+def test_slowfast_dual_rings_and_trunk_refusals():
+    """SlowFast streams on dual-rate rings now (ISSUE-16; the old
+    refusal is gone) — and the KV-trunk modes stay loud refusals for
+    every model without a causal token seam."""
     from pytorchvideo_accelerate_tpu.config import ModelConfig
     from pytorchvideo_accelerate_tpu.models import create_model
     from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
@@ -226,12 +229,23 @@ def test_slowfast_refused():
 
     cfg = ModelConfig(name="slowfast_r50", num_classes=4)
     model = create_model(cfg, "fp32")
-    # engine double: never init slowfast weights for a refusal test
+    # engine double: never init slowfast weights for a classify test
     eng = InferenceEngine.__new__(InferenceEngine)
     eng.model = model
     eng.model_name = "slowfast_r50"
+    se = StreamingEngine(eng)
+    assert se.kind == "dual"
+    assert se._ring_names == ("raw", "slow")
+    # dual-rate validation: stride/window must be alpha-aligned
+    geom = se.geom_key(8, 16, 16, 3, "float32")
+    se._validate(geom, 4)
     with pytest.raises(SessionError):
-        StreamingEngine(eng)
+        se._validate(geom, 2)  # 2 !% alpha=4
+    # KV trunks need the videomae token seam — refused for dual/conv
+    with pytest.raises(SessionError):
+        StreamingEngine(eng, trunk="causal")
+    with pytest.raises(SessionError):
+        StreamingEngine(eng, trunk="bogus")
 
 
 # --- scheduler + router integration -----------------------------------------
