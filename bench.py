@@ -1169,6 +1169,342 @@ def bench_fleet(args) -> dict:
     return out
 
 
+# FLEET_AUTO sizing: the control-loop lane runs entirely in-process on
+# stub engines (the controllers are host-side control code; the subprocess
+# spawn actuator is chaos leg `autoscale_kill`'s job), so smoke and full
+# differ only in traffic volume and SLO tightness. Module-level so the
+# contract test can shrink it. The x3d stub serves buckets (1, 2) at
+# `forward_s` per launch, capping one replica near 2/forward_s rps — the
+# step rate is sized to genuinely overload the single starting replica.
+FLEET_AUTO_SMOKE = dict(base_rps=6.0, step_rps=60.0, base_s=1.0,
+                        step_s=3.0, forward_s=0.05, probe_s=1.5,
+                        slo_p99_ms=2500.0, converge_deadline_s=8.0,
+                        sessions=4, advances=6, budget_mb=3000.0,
+                        canary_rps=30.0, canary_burst_s=1.2)
+FLEET_AUTO_FULL = dict(base_rps=10.0, step_rps=120.0, base_s=2.0,
+                       step_s=6.0, forward_s=0.05, probe_s=3.0,
+                       slo_p99_ms=1000.0, converge_deadline_s=15.0,
+                       sessions=8, advances=8, budget_mb=3000.0,
+                       canary_rps=60.0, canary_burst_s=2.5)
+
+
+def bench_fleet_auto(args) -> dict:
+    """The FLEET_AUTO lane: the fleet-intelligence control loops
+    (fleet/control/, docs/SERVING.md § fleet intelligence) closed-loop
+    against real traffic. Headlines `autoscale_converge_s` /
+    `fleet_scaledown_shed_frac` / `canary_rollback` /
+    `fleet_models_served`; the verdict keys (`canary_promoted`,
+    `fleet_session_failures`) ride even on a refused round.
+
+    Proof obligations baked into the record (asserted by --smoke):
+    - CONVERGENCE: an open-loop traffic STEP (loadgen piecewise profile)
+      overloads the starting fleet; the damped autoscaler grows it, the
+      last scaling action lands within `converge_deadline_s` of the step,
+      and a steady-state probe at the FULL stepped rate then holds the
+      p99 SLO with zero non-shed failures at the size the controller
+      chose — the step run's own p99 includes the pre-scale backlog by
+      construction and is recorded, never asserted;
+    - SCALE-DOWN SAFETY: draining a victim re-homes every live streaming
+      session (affinity dropped -> deterministic re-establish from the
+      client's resendable window on a survivor); every advance across
+      the drain verifies `stub_stream_logits` equality against the
+      client's own window, zero non-shed failures, and the controller
+      never drains the last routable replica;
+    - MULTI-MODEL: >=2 model families (x3d_s + videomae_t) serve off ONE
+      pool under a shared `ModelBudget`; pushing a third family past the
+      budget sheds THAT family at the fleet door while the in-budget
+      families keep serving untouched;
+    - CANARY: a seeded-regression artifact (12x slower by construction)
+      is auto-rolled-back by the escalation ladder with direction-aware
+      perfdiff evidence, the blue engines restored; an equal-cost clean
+      artifact under the SAME controller knobs evaluates clean and is
+      promoted fleet-wide.
+    """
+    import jax
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.fleet.control import (
+        Autoscaler,
+        CanaryController,
+        ModelBudget,
+        MultiModelFleet,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.loadgen import (
+        LoadGen,
+        step_profile,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        LocalReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+    from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+    from pytorchvideo_accelerate_tpu.serving.stub import (
+        StubEngine,
+        StubStreamEngine,
+        stub_stream_logits,
+    )
+
+    shape = FLEET_AUTO_SMOKE if args.smoke else FLEET_AUTO_FULL
+    platform = jax.devices()[0].platform
+    fwd = shape["forward_s"]
+
+    def mk_replica(name, model, engine):
+        stats = ServingStats(window=1024)
+        # deadline effectively off: convergence must be driven by the
+        # controller's queue/p99 signals, not masked by deadline sheds
+        sched = Scheduler(engine, stats=stats, max_queue=512,
+                          realtime_deadline_ms=30000.0,
+                          name=f"auto-{name}")
+        return LocalReplica(name, sched, model=model)
+
+    def mk_x3d(name, tag=0.0, forward_s=None):
+        return mk_replica(name, "x3d_s",
+                          StubEngine(tag=tag, buckets=(1, 2),
+                                     forward_s=(fwd if forward_s is None
+                                                else forward_s)))
+
+    # one pool, two families: a single x3d_s request replica (the one the
+    # traffic step overloads) + two videomae_t stream replicas
+    replicas = [mk_x3d("x3d-0"),
+                mk_replica("vm-0", "videomae_t",
+                           StubStreamEngine(forward_s=0.002)),
+                mk_replica("vm-1", "videomae_t",
+                           StubStreamEngine(forward_s=0.002))]
+    pool = ReplicaPool(replicas, health_interval_s=0.1, name="auto")
+    router = Router(pool)
+    budget = ModelBudget(shape["budget_mb"])
+    mmf = MultiModelFleet(router, budget)
+    mmf.register_model("x3d_s", 1200.0,
+                       latency_buckets_ms=(50, 100, 250, 1000, 2500))
+    mmf.register_model("videomae_t", 1400.0,
+                       latency_buckets_ms=(100, 500, 2000))
+    base = {"video": np.zeros((2, 4, 4, 3), np.float32)}
+
+    def x3d_submit(clip, **kw):
+        return mmf.submit(clip, model="x3d_s", **kw)
+
+    spawn_n = [0]
+
+    def spawn():
+        spawn_n[0] += 1
+        return mk_x3d(f"x3d-auto-{spawn_n[0]}")
+
+    out: dict = {}
+    try:
+        # --- phase A: convergence under an open-loop traffic step -------
+        asc = Autoscaler(router, spawn_fn=spawn,
+                         min_replicas=len(replicas),
+                         max_replicas=len(replicas) + 4,
+                         slo_p99_ms=shape["slo_p99_ms"],
+                         queue_high=3.0, queue_low=0.3,
+                         downscale_frac=0.1, cooldown_s=0.4,
+                         interval_s=0.08, ewma_alpha=0.6,
+                         drain_grace_s=2.0)
+        replicas_start = len(pool.routable())
+        asc.start()
+        t0 = time.monotonic()
+        step_report = LoadGen(
+            x3d_submit,
+            profile=step_profile((shape["base_s"], shape["base_rps"]),
+                                 (shape["step_s"], shape["step_rps"])),
+            clip_factory=lambda rng: dict(base), seed=0).run()
+        t_step = t0 + shape["base_s"]
+        post = [e for e in asc.actions_since(t_step)
+                if e["action"] in ("up", "down", "replace")]
+        converge_s = (round(max(e["t"] for e in post) - t_step, 3)
+                      if post else 0.0)
+        asc.close()
+        scaled_to = len(pool.routable())
+        probe = LoadGen(x3d_submit, rate_rps=shape["step_rps"],
+                        duration_s=shape["probe_s"],
+                        clip_factory=lambda rng: dict(base), seed=1).run()
+        converged = bool(post) and scaled_to > replicas_start \
+            and probe["p99_ms"] <= shape["slo_p99_ms"] \
+            and probe["failed"] == 0 \
+            and converge_s <= shape["converge_deadline_s"]
+        log(f"[fleet_auto] converge: {replicas_start}->{scaled_to} "
+            f"replicas in {converge_s}s, steady p99 {probe['p99_ms']} ms "
+            f"(SLO {shape['slo_p99_ms']})")
+
+        # --- multi-model budget: the third family sheds, the pool serves
+        models_served = len(mmf.models())
+        mmf.register_model("mvit_b", shape["budget_mb"])  # guaranteed over
+        budget_shed = False
+        try:
+            mmf.submit(dict(base), model="mvit_b")
+        except QueueFullError:
+            budget_shed = True
+        in_budget_ok = True
+        try:
+            mmf.submit(dict(base), model="x3d_s").result(timeout=30)
+        except Exception:  # noqa: BLE001 - any failure breaks the claim
+            in_budget_ok = False
+
+        # --- phase B: scale-down re-homes every live streaming session -
+        window, stride, fshape = 8, 2, (4, 4, 3)
+        rng = np.random.default_rng(7)
+        windows: dict = {}
+        counts = {"advances": 0, "shed": 0, "failed": 0}
+
+        def advance(sid, k, end):
+            frames = rng.standard_normal(
+                (stride,) + fshape).astype(np.float32)
+            if k == 0:
+                windows[sid] = rng.standard_normal(
+                    (window,) + fshape).astype(np.float32)
+            windows[sid] = np.concatenate(
+                [windows[sid][stride:], frames], 0)
+            counts["advances"] += 1
+            try:
+                # window attached on every advance (the resendable-window
+                # client contract): a re-homed session re-establishes on
+                # the survivor transparently, and the logits stay a pure
+                # function of the client's own window — checkable
+                res = mmf.submit(
+                    {"video": frames}, model="videomae_t",
+                    session={"sid": sid, "stride": stride, "end": end,
+                             "window": windows[sid]}).result(timeout=30)
+            except QueueFullError:
+                counts["shed"] += 1
+                return
+            except Exception:  # noqa: BLE001 - any other failure is a bug
+                counts["failed"] += 1
+                return
+            want = stub_stream_logits(windows[sid], 4)
+            if not np.allclose(np.asarray(res).ravel(), want.ravel(),
+                               atol=1e-5):
+                counts["failed"] += 1
+
+        n_sessions = int(shape["sessions"])
+        for i in range(n_sessions):
+            advance(f"fa-{i}", 0, False)
+        # both stream replicas must hold >=1 pinned session before the
+        # drain (the re-home target must outlive the victim); affinity
+        # ties round-robin, so a few extra establishes always balance it
+        for _ in range(8):
+            if all(router.sessions_on(r.name) for r in pool.routable()
+                   if getattr(r, "model", None) == "videomae_t"):
+                break
+            advance(f"fa-{n_sessions}", 0, False)
+            n_sessions += 1
+        for i in range(n_sessions):
+            advance(f"fa-{i}", 1, False)
+        # a second controller parameterized for the drain leg: idle is
+        # queue-driven (the SLO term effectively off), so with traffic
+        # gone it steps the target down once per cooldown; victims are
+        # fewest-sessions-first, so the spawned x3d replicas reap first
+        # and the first session-carrying victim proves the re-home
+        asc2 = Autoscaler(router, spawn_fn=spawn, min_replicas=1,
+                          max_replicas=len(pool.replicas) + 1,
+                          slo_p99_ms=1e9, queue_high=3.0, queue_low=0.3,
+                          downscale_frac=0.5, cooldown_s=0.05,
+                          interval_s=0.05, ewma_alpha=1.0,
+                          drain_grace_s=2.0)
+        rehomed = 0
+        for _ in range(64):
+            before = {r.name: router.sessions_on(r.name)
+                      for r in pool.routable()}
+            if asc2.step() == "down":
+                names = {r.name for r in pool.replicas}
+                rehomed += sum(len(sids) for n, sids in before.items()
+                               if n not in names)
+            if rehomed or len(pool.routable()) <= 1:
+                break
+            time.sleep(0.06)
+        asc2.close()
+        for k in range(2, int(shape["advances"])):
+            for i in range(n_sessions):
+                advance(f"fa-{i}", k, k == int(shape["advances"]) - 1)
+        shed_frac = (round(counts["shed"] / counts["advances"], 4)
+                     if counts["advances"] else 0.0)
+        log(f"[fleet_auto] scale-down: {rehomed} session(s) re-homed, "
+            f"{counts['failed']} failure(s), shed_frac {shed_frac} over "
+            f"{counts['advances']} advances")
+    finally:
+        router.close()
+
+    # --- phase C: canary rollout — seeded regression, then a clean one -
+    creps = [mk_x3d(f"cn-{i}", forward_s=0.004) for i in range(4)]
+    pool2 = ReplicaPool(creps, health_interval_s=0.2, name="canary")
+    router2 = Router(pool2)
+    try:
+        def burst(seed):
+            return LoadGen(router2.submit, rate_rps=shape["canary_rps"],
+                           duration_s=shape["canary_burst_s"],
+                           clip_factory=lambda rng: dict(base),
+                           seed=seed).run()
+
+        cc = CanaryController(router2, fraction=0.25, threshold=0.5,
+                              rollback_after=2)
+        cc.start_rollout(lambda r: StubEngine(tag=7.0, forward_s=0.05,
+                                              buckets=(1, 2)),
+                         label="seeded-regression")
+        verdict: dict = {}
+        rollbacks = 0
+        for i in range(cc.rollback_after):
+            burst(10 + i)
+            verdict = cc.evaluate()
+            if verdict.get("rolled_back"):
+                rollbacks += 1
+                break
+        restored = all(r.scheduler.current_engine().tag == 0.0
+                       for r in creps)
+        cc2 = CanaryController(router2, fraction=0.25, threshold=0.5,
+                               rollback_after=2)
+        cc2.start_rollout(lambda r: StubEngine(tag=5.0, forward_s=0.004,
+                                               buckets=(1, 2)),
+                          label="clean")
+        burst(20)
+        clean = cc2.evaluate()
+        promoted = False
+        if clean["action"] == "observe" and clean["strikes"] == 0:
+            cc2.promote()
+            promoted = all(r.scheduler.current_engine().tag == 5.0
+                           for r in creps)
+        log(f"[fleet_auto] canary: seeded regressions "
+            f"{verdict.get('regressions')} -> {rollbacks} rollback(s); "
+            f"clean -> promoted={promoted}")
+    finally:
+        router2.close()
+
+    out = {
+        "autoscale_converge_s": converge_s,
+        "fleet_scaledown_shed_frac": shed_frac,
+        "canary_rollback": rollbacks,
+        "fleet_models_served": models_served,
+        "canary_promoted": bool(promoted),
+        "fleet_session_failures": int(counts["failed"]),
+        "fleet_sessions_rehomed": int(rehomed),
+        "autoscale_converged": bool(converged),
+        "converge_deadline_s": shape["converge_deadline_s"],
+        "replicas_start": replicas_start,
+        "scaled_up_to": scaled_to,
+        "steady_p99_ms": probe["p99_ms"],
+        "steady_failed": int(probe["failed"]),
+        "step_p99_ms": step_report["p99_ms"],
+        "step_shed_frac": step_report["shed_frac"],
+        "open_loop_ok": bool(step_report["open_loop_ok"]
+                             and probe["open_loop_ok"]),
+        "slo_p99_ms": shape["slo_p99_ms"],
+        "budget_shed_ok": bool(budget_shed and in_budget_ok),
+        "canary_regressions": sorted(verdict.get("regressions", [])),
+        "canary_strikes": verdict.get("strikes"),
+        "canary_blue_restored": bool(restored),
+        "sessions": n_sessions,
+        "advances": counts["advances"],
+        "platform": platform,
+        "smoke": bool(args.smoke),
+        # the standing bench rule: a non-smoke control lane on CPU is a
+        # lying tunnel, not a fleet measurement — refuse to headline
+        "suspect": platform == "cpu" and not args.smoke,
+    }
+    log(f"[fleet_auto] {json.dumps(out)}")
+    return out
+
+
 # forced-host slice for the smoke-mode PIPELINE lane (same 8 fake CPU
 # devices as the multichip lane); module-level so tests can shrink it
 PIPELINE_FORCED_DEVICES = 8
@@ -1982,6 +2318,8 @@ def child_main(args) -> None:
         res = bench_pipeline(args)
     elif args.child == "__fleet__":
         res = bench_fleet(args)
+    elif args.child == "__fleet_auto__":
+        res = bench_fleet_auto(args)
     elif args.child == "__kbench__":
         res = bench_kbench(args)
     elif args.child == "__stream__":
@@ -2059,6 +2397,15 @@ def main():
                          "serve_rps / serve_p99_ms_under_load / "
                          "swap_blackout_ms / fleet_shed_frac "
                          "(--no-fleet skips)")
+    ap.add_argument("--fleet-auto", dest="fleet_auto",
+                    action=argparse.BooleanOptionalAction, default=True,
+                    help="FLEET_AUTO lane: the fleet-intelligence control "
+                         "loops — SLO-driven autoscaling under a traffic "
+                         "step, session-safe scale-down, multi-model "
+                         "budget shed, canary auto-rollback; headlines "
+                         "autoscale_converge_s / fleet_scaledown_shed_frac "
+                         "/ canary_rollback / fleet_models_served "
+                         "(--no-fleet-auto skips)")
     ap.add_argument("--stream", action=argparse.BooleanOptionalAction,
                     default=True,
                     help="STREAM lane: incremental streaming inference "
@@ -2518,6 +2865,32 @@ def main():
                     extras[key] = fl[key]
         flush_partial()
 
+    if args.fleet_auto:
+        # FLEET_AUTO lane: child-isolated like the fleet lane; the same
+        # refusal rule — a failed or cpu-fallback lane headlines
+        # fleet_auto_error INSTEAD of the control-loop perf keys, and the
+        # verdict keys (canary_promoted / fleet_session_failures) ride
+        # regardless: a refused round must still say whether the rollback
+        # machinery and the re-home path held
+        fa = run_child("__fleet_auto__", args, user_smoke or not device_ok,
+                       _model_timeout(args))
+        extras["fleet_auto"] = fa  # full record -> bench_partial.json
+        if "error" in fa:
+            extras["fleet_auto_error"] = str(fa["error"])[:120]
+        elif fa.get("suspect"):
+            extras["fleet_auto_error"] = (
+                "no trustworthy device numbers for the fleet-auto lane "
+                "(cpu fallback); see bench_partial.json")
+        else:
+            for key in ("autoscale_converge_s", "fleet_scaledown_shed_frac",
+                        "canary_rollback", "fleet_models_served"):
+                if fa.get(key) is not None:
+                    extras[key] = fa[key]
+        for key in ("canary_promoted", "fleet_session_failures"):
+            if fa.get(key) is not None:
+                extras[key] = fa[key]
+        flush_partial()
+
     if args.stream:
         # STREAM lane: child-isolated like the fleet lane (a wedged
         # compile loses the lane, not the round). The refusal rule
@@ -2772,6 +3145,47 @@ def main():
         assert overhead is not None and overhead < 0.02, (
             f"tracing overhead {overhead} is not under 2% of run wall "
             f"time: {fl}")
+    if user_smoke and args.fleet_auto:
+        # FLEET_AUTO acceptance (docs/SERVING.md § fleet intelligence):
+        # the autoscaler CONVERGED on the traffic step — it grew the
+        # fleet, the last scaling action landed within the deadline, and
+        # a steady probe at the full stepped rate held the p99 SLO; the
+        # scale-down drained a victim without losing a single live
+        # streaming session; the seeded-regression canary auto-rolled-
+        # back (blues restored) while the clean artifact promoted; and
+        # >=2 model families served off one pool with the over-budget
+        # family shed at the door
+        fa = extras.get("fleet_auto", {})
+        assert "fleet_auto_error" not in extras, (
+            f"FLEET_AUTO lane failed: {extras['fleet_auto_error']}: {fa}")
+        for key in ("autoscale_converge_s", "fleet_scaledown_shed_frac",
+                    "canary_rollback", "fleet_models_served"):
+            assert extras.get(key) is not None, (
+                f"fleet-auto smoke ran but produced no {key!r}: {fa}")
+        assert fa.get("autoscale_converged") is True, (
+            f"autoscaler did not converge on the traffic step: {fa}")
+        assert extras["autoscale_converge_s"] <= fa.get(
+            "converge_deadline_s", float("inf")), (
+            f"autoscaler converged too slowly: {fa}")
+        assert fa.get("scaled_up_to", 0) > fa.get("replicas_start", 99), (
+            f"traffic step did not grow the fleet: {fa}")
+        assert fa.get("open_loop_ok") is True, (
+            f"fleet-auto loadgen degraded toward closed-loop: {fa}")
+        assert extras.get("fleet_session_failures") == 0, (
+            f"scale-down lost live streaming session work: {fa}")
+        assert fa.get("fleet_sessions_rehomed", 0) >= 1, (
+            f"scale-down drained no session-carrying replica: {fa}")
+        assert extras.get("canary_rollback") == 1, (
+            f"seeded-regression canary did not auto-rollback: {fa}")
+        assert fa.get("canary_blue_restored") is True, (
+            f"rollback did not restore the blue engines: {fa}")
+        assert extras.get("canary_promoted") is True, (
+            f"clean canary was not promoted fleet-wide: {fa}")
+        assert extras.get("fleet_models_served", 0) >= 2, (
+            f"fewer than 2 model families served off the pool: {fa}")
+        assert fa.get("budget_shed_ok") is True, (
+            "over-budget family did not shed (or the in-budget family "
+            f"stopped serving): {fa}")
     if user_smoke and args.stream:
         # STREAM acceptance (docs/SERVING.md § streaming): incremental
         # advance logits matched the full-clip recompute every measured
@@ -2992,6 +3406,11 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
     fleet_perf = ("serve_rps", "serve_p99_ms_under_load",
                   "swap_blackout_ms", "fleet_shed_frac",
                   "trace_sampled", "trace_overhead_frac")
+    # FLEET_AUTO control-loop perf keys under the same refusal rule: a
+    # fleet_auto_error headlines INSTEAD of the numbers; the verdicts
+    # (canary_promoted / fleet_session_failures) ride regardless
+    fleet_auto_perf = ("autoscale_converge_s", "fleet_scaledown_shed_frac",
+                       "canary_rollback", "fleet_models_served")
     # DATA_PLANE lane perf keys under the same refusal rule: a
     # dataplane_error (failed lane or broken byte parity) headlines
     # INSTEAD of the numbers
@@ -3021,11 +3440,14 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                 "pipeline_train_recompiles",
                 "stream_parity", "stream_recompiles",
                 "stream_trunk_parity", "stream_trunk_recompiles",
-                *mc_perf, *fleet_perf, *dataplane_perf, *pipeline_perf,
-                *stream_perf):
+                "canary_promoted", "fleet_session_failures",
+                *mc_perf, *fleet_perf, *fleet_auto_perf, *dataplane_perf,
+                *pipeline_perf, *stream_perf):
         if key in extras and not (
                 (key in mc_perf and "multichip_error" in extras)
                 or (key in fleet_perf and "fleet_error" in extras)
+                or (key in fleet_auto_perf
+                    and "fleet_auto_error" in extras)
                 or (key in dataplane_perf and "dataplane_error" in extras)
                 or (key in pipeline_perf and "pipeline_error" in extras)
                 or (key in stream_perf and "stream_error" in extras)):
@@ -3040,6 +3462,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
         out["multichip_error"] = str(extras["multichip_error"])[:120]
     if "fleet_error" in extras:
         out["fleet_error"] = str(extras["fleet_error"])[:120]
+    if "fleet_auto_error" in extras:
+        out["fleet_auto_error"] = str(extras["fleet_auto_error"])[:120]
     if "dataplane_error" in extras:
         out["dataplane_error"] = str(extras["dataplane_error"])[:120]
     # kernel-microbench keys (pva-tpu-kbench): dimensionless same-backend
@@ -3113,6 +3537,13 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               "pipeline_bubble_frac", "pipeline_cps_per_chip",
               "fleet_error", "fleet_shed_frac", "swap_blackout_ms",
               "serve_p99_ms_under_load", "serve_rps",
+              # the FLEET_AUTO control lane sheds after the fleet group
+              # (convergence is this arc's acceptance metric, so it goes
+              # last of the group); verdicts shed before perf keys
+              "fleet_auto_error", "canary_promoted",
+              "fleet_session_failures", "fleet_models_served",
+              "fleet_scaledown_shed_frac", "canary_rollback",
+              "autoscale_converge_s",
               # the STREAM lane sheds after the fleet group but before
               # dataplane/kbench (its speedup is this arc's headline);
               # the trunk SPEEDUP sheds before its top-1 delta on purpose

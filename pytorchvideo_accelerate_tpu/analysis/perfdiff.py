@@ -65,6 +65,10 @@ HIGHER_BETTER = (
     # videomae_b stream shape (ops/attention.incremental_band_attention)
     "kbench_attn_causal_inc_speedup",
     "kbench_attn_windowed_inc_speedup",
+    # FLEET_AUTO lane: model families served off ONE pool under the
+    # shared budget (fleet/control/multimodel.py) — a drop means a
+    # family fell off the fleet
+    "fleet_models_served",
 )
 LOWER_BETTER = (
     "step_ms_blocked",
@@ -86,6 +90,14 @@ LOWER_BETTER = (
     # fixed-seed synthetic eval — the gate that decides whether
     # stream_trunk_speedup may headline at all
     "stream_trunk_top1_delta",
+    # FLEET_AUTO lane (fleet/control/): seconds from the traffic step to
+    # the autoscaler's last scaling action, advances shed across the
+    # scale-down drain, and rollbacks the seeded-regression canary took
+    # (a rise past 1 means the ladder needed extra strikes — the canary
+    # verdict got less decisive)
+    "autoscale_converge_s",
+    "fleet_scaledown_shed_frac",
+    "canary_rollback",
 )
 
 

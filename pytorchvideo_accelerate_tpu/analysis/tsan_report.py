@@ -469,6 +469,116 @@ def _stress_stream(log: Callable[[str], None]) -> None:
         "hot-swap + membership flap + table churn")
 
 
+def _stress_autoscale(log: Callable[[str], None]) -> None:
+    """Fleet-control churn (fleet/control/): an `Autoscaler` ticking in
+    its own thread — spawn/drain/re-home racing live session dispatch,
+    the health poller, and a `CanaryController` rollout/evaluate/rollback
+    on the same pool — plus history/snapshot readers racing the control
+    loops. The registered Autoscaler/CanaryController @shared_state
+    fields (target, history, EWMAs, strikes, blues) under real
+    interleavings."""
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.fleet.control import (
+        Autoscaler,
+        CanaryController,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        LocalReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+    from pytorchvideo_accelerate_tpu.obs.registry import Registry
+    from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+    from pytorchvideo_accelerate_tpu.serving.stub import StubStreamEngine
+
+    def mk_replica(name: str) -> LocalReplica:
+        stats = ServingStats(window=64, registry=Registry())
+        sched = Scheduler(StubStreamEngine(), stats=stats, max_queue=64,
+                          batch_max_wait_ms=1.0, name=name)
+        return LocalReplica(name, sched, stats=stats)
+
+    replicas = [mk_replica(f"tsan-auto-{i}") for i in range(3)]
+    pool = ReplicaPool(replicas, health_interval_s=0.02,
+                       registry=Registry())
+    router = Router(pool, registry=Registry())
+    spawn_n = {"n": 0}
+
+    def spawn():
+        spawn_n["n"] += 1
+        return mk_replica(f"tsan-auto-sp-{spawn_n['n']}")
+
+    # watermarks close together so BOTH decisions fire under the bursty
+    # clients below: what matters here is the interleaving coverage of
+    # spawn/drain/re-home against live traffic, not where the fleet lands
+    asc = Autoscaler(router, spawn_fn=spawn, min_replicas=1,
+                     max_replicas=4, slo_p99_ms=1e9, queue_high=1.0,
+                     queue_low=0.5, cooldown_s=0.01, interval_s=0.005,
+                     ewma_alpha=1.0, drain_grace_s=0.05,
+                     dead_after_ticks=2)
+    T, S, HW = 4, 2, 4
+    served: List[str] = []
+
+    def client(k: int):
+        rng = np.random.default_rng(k)
+        win = rng.standard_normal((T, HW, HW, 3)).astype(np.float32)
+        sid = f"tsan-auto-sess-{k}"
+        for i in range(8):
+            frames = rng.standard_normal((S, HW, HW, 3)).astype(np.float32)
+            win = np.concatenate([win[S:], frames], axis=0)
+            try:
+                # window attached: a drain's re-home lands mid-burst and
+                # the session must re-establish on whatever survives
+                fut = router.submit(
+                    {"video": frames},
+                    session={"sid": sid, "window": win, "stride": S,
+                             "end": i == 7})
+                if i % 2 == 0:
+                    fut.result(timeout=5.0)
+                    served.append("ok")
+            except Exception:  # noqa: BLE001 - close() races late submits
+                return
+
+    def canary():
+        cc = CanaryController(router, fraction=0.34, threshold=0.2,
+                              rollback_after=2, prewarm=False)
+        try:  # rollout/evaluate race the autoscaler draining its victims
+            cc.start_rollout(lambda r: StubStreamEngine(tag=1.0),
+                             label="tsan-green")
+            for _ in range(2):
+                cc.evaluate()
+                time.sleep(0.005)
+            if cc.state == "canary":
+                cc.rollback()
+        except Exception:  # noqa: BLE001 - a drained canary set is legal
+            pass
+
+    def snapshotter():
+        for _ in range(6):
+            router.fleet_snapshot()
+            asc.actions_since(0.0)
+            time.sleep(0.003)
+
+    asc.start()  # the control loop ticks in ITS thread for the whole leg
+    ts = [make_thread(target=client, args=(k,), name=f"auto-client-{k}",
+                      daemon=True) for k in range(3)]
+    ts.append(make_thread(target=canary, name="auto-canary", daemon=True))
+    ts.append(make_thread(target=snapshotter, name="auto-snapshotter",
+                          daemon=True))
+    for t in ts:
+        t.start()
+    time.sleep(0.02)
+    pool.mark_down(replicas[1])  # flap membership under the control loop;
+    for t in ts:                 # the poller restores it (health is fine)
+        t.join(timeout=10.0)
+    asc.close()
+    router.close()
+    log(f"[tsan] autoscale churn: {len(served)} awaited labels through "
+        f"{len(asc.history)} control action(s) + a canary cycle "
+        f"({spawn_n['n']} spawned)")
+
+
 def _stress_trackers(log: Callable[[str], None]) -> None:
     """TrackerHub fan-out from two threads with a tracker that raises: the
     disable-on-failure path mutates the tracker list under traffic."""
@@ -592,6 +702,7 @@ def run_stress(smoke: bool = True,
                     _stress_batcher(wd, log)
                     _stress_fleet(log)
                     _stress_stream(log)
+                    _stress_autoscale(log)
                     _stress_trackers(log)
                     _stress_prefetcher(wd, log)
                     _stress_dataplane(log)

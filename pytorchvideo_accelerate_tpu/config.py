@@ -376,6 +376,45 @@ class FleetConfig:
 
 
 @dataclass
+class ControlConfig:
+    """Fleet-intelligence loops (fleet/control/): SLO-driven autoscaling,
+    multi-model budget, canary rollout (docs/SERVING.md § fleet
+    intelligence). These dials shape the DAMPING — an undamped controller
+    against an open-loop load generator is an oscillator."""
+
+    # autoscaler pool bounds; min >= 1 (the last routable replica is
+    # never drained, no matter what the signals say)
+    min_replicas: int = 1
+    max_replicas: int = 8
+    # the p99 the controller defends; scale-up fires when the smoothed
+    # pooled p99 crosses it (the FLEET_AUTO lane asserts convergence
+    # back under it after a traffic step)
+    slo_p99_ms: float = 500.0
+    # hysteresis band on smoothed backlog per routable replica: above
+    # `queue_high` grow, below `queue_low` (AND p99 under
+    # downscale_frac * SLO) shrink; the gap between them is damping
+    queue_high: float = 4.0
+    queue_low: float = 0.5
+    downscale_frac: float = 0.5
+    # dead time after every action + control cadence + signal smoothing
+    cooldown_s: float = 2.0
+    interval_s: float = 0.25
+    ewma_alpha: float = 0.5
+    # scale-down grace for in-flight requests after the victim drains
+    # and its sessions re-home
+    drain_grace_s: float = 5.0
+    # shared compiled-cache/HBM budget across model families (MB);
+    # the lowest-priority over-budget family sheds, the pool never does
+    budget_mb: float = 4096.0
+    # canary: fraction of the fleet that takes the new artifact, the
+    # direction-aware regression threshold (perfdiff semantics), and the
+    # escalation-ladder strike count before auto-rollback
+    canary_fraction: float = 0.25
+    canary_threshold: float = 0.2
+    canary_rollback_after: int = 2
+
+
+@dataclass
 class ObsConfig:
     """Telemetry spine (obs/): spans, flight recorder, watchdog, registry.
 
@@ -506,6 +545,7 @@ class TrainConfig:
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)
     obs: ObsConfig = field(default_factory=ObsConfig)
     reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)

@@ -21,13 +21,25 @@ queues shape of the Podracer architecture, PAPERS.md):
   with a heavy-tailed clip-size mix and SLO verdicts;
 - `loadgen.StreamLoadGen` — open-loop arrivals of STREAMS (heavy-tail
   durations, per-session label-latency honesty) driving the stateful
-  streaming mode (streaming/; router affinity, /stream).
+  streaming mode (streaming/; router affinity, /stream);
+- `control/` — the fleet-intelligence loops over all of the above
+  (ROADMAP item 1): SLO-driven `Autoscaler`, multi-model serving under
+  a shared budget (`ModelBudget`/`MultiModelFleet`), and canary rollout
+  with escalation-ladder auto-rollback (`CanaryController`).
 
 The router speaks the `MicroBatcher` interface, so `InferenceServer` (and
 the whole admission/drain/Retry-After vocabulary) fronts a fleet
 unchanged. See docs/SERVING.md § fleet.
 """
 
+from pytorchvideo_accelerate_tpu.fleet.control import (  # noqa: F401
+    Autoscaler,
+    CanaryController,
+    ControlSignals,
+    ModelBudget,
+    MultiModelFleet,
+    SignalReader,
+)
 from pytorchvideo_accelerate_tpu.fleet.hotswap import (  # noqa: F401
     hot_swap,
     swap_replica,

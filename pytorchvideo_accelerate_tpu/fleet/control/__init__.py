@@ -1,0 +1,35 @@
+"""Fleet intelligence: the control loops over the serving tier.
+
+fleet/ gave the serving tier sensors (per-replica registry labels,
+pooled percentiles, traces) and actuators (`spawn_serving_process`,
+blue/green hot-swap, admission drain, session re-home); this package
+closes the loops (ROADMAP item 1 — docs/SERVING.md § fleet
+intelligence):
+
+- `signals.SignalReader` — one registry-fed `ControlSignals` snapshot
+  per control tick (the same numbers `/metrics` serves);
+- `autoscaler.Autoscaler` — damped SLO-driven pool resizing: spawn on
+  backlog/p99 pressure, drain -> re-home -> reap on idle, dead-member
+  replacement without double-counting;
+- `multimodel.ModelBudget` / `multimodel.MultiModelFleet` — several
+  model families on one pool under a shared compiled-cache/HBM budget;
+  the over-budget family sheds, the pool never degrades;
+- `canary.CanaryController` — fractional blue/green rollout with
+  pooled-window direction-aware comparison (perfdiff vocabulary),
+  exemplar-linked evidence, and escalation-ladder auto-rollback.
+"""
+
+from pytorchvideo_accelerate_tpu.fleet.control.autoscaler import (  # noqa: F401,E501
+    Autoscaler,
+)
+from pytorchvideo_accelerate_tpu.fleet.control.canary import (  # noqa: F401
+    CanaryController,
+)
+from pytorchvideo_accelerate_tpu.fleet.control.multimodel import (  # noqa: F401,E501
+    ModelBudget,
+    MultiModelFleet,
+)
+from pytorchvideo_accelerate_tpu.fleet.control.signals import (  # noqa: F401
+    ControlSignals,
+    SignalReader,
+)

@@ -1,0 +1,94 @@
+"""Registry-fed control signals: what the fleet controller acts on.
+
+The autoscaler/canary loops must argue from the SAME numbers a human
+watching `/metrics` sees — not from a private side-channel that can
+drift. `SignalReader.read()` therefore sources every scalar it can from
+the router's obs registry (`Registry.scrape()` — the exact series the
+Prometheus endpoint renders: `pva_fleet_healthy_replicas{pool=}`,
+`pva_fleet_outstanding{pool=,replica=}`, `pva_fleet_shed_total{pool=}`)
+and takes only the pooled latency percentiles from
+`Router.fleet_snapshot()` — raw latency windows never cross the metrics
+wire (cumulative histograms lose the rolling-window property), and
+percentiles-of-percentiles would lie (stats.py `merge`).
+
+`ControlSignals` is deliberately a plain frozen snapshot: one read per
+control tick, decisions on the snapshot, never live reads mid-decision —
+a controller that reads twice can see two different fleets and flap.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Dict, Optional
+
+from pytorchvideo_accelerate_tpu.utils.logging import get_logger
+
+logger = get_logger("pva_tpu")
+
+
+@dataclass(frozen=True)
+class ControlSignals:
+    """One control tick's view of the fleet (all floats; -1 = unknown)."""
+
+    t: float                 # monotonic read time
+    routable: float          # replicas in the routable set (registry gauge)
+    members: float           # total pool membership (routable + down)
+    outstanding: float       # in-flight requests, summed over replicas
+    queue_depth: float       # router-tracked dispatched-not-settled depth
+    p99_ms: float            # pooled-window p99 (0.0 = no windowed samples)
+    throughput_rps: float    # pooled-window completion rate
+    shed_total: float        # cumulative router sheds (counter, monotonic)
+    per_replica_outstanding: Dict[str, float] = field(default_factory=dict)
+
+    def queue_per_replica(self) -> float:
+        """Backlog pressure normalized by serving capacity."""
+        return self.queue_depth / max(self.routable, 1.0)
+
+
+class SignalReader:
+    """Reads `ControlSignals` off a router's registry + pooled windows."""
+
+    # prefix of every router/pool series in the registry scrape
+    _FLEET_PREFIX = "pva_fleet_"
+
+    def __init__(self, router, *, model: Optional[str] = None):
+        self.router = router
+        self.model = model
+        self._pool_label = router.pool.name
+
+    def _series(self, scrape: Dict[str, float], name: str,
+                **labels: str) -> float:
+        """One sample from a scrape dict, keyed the way render() keys it."""
+        if not labels:
+            return float(scrape.get(name, 0.0))
+        inner = ",".join(f'{k}="{v}"' for k, v in labels.items())
+        return float(scrape.get(f"{name}{{{inner}}}", 0.0))
+
+    def read(self, model: Optional[str] = None) -> ControlSignals:
+        model = model if model is not None else self.model
+        scrape = self.router.registry.scrape(self._FLEET_PREFIX)
+        routable = self._series(scrape, "pva_fleet_healthy_replicas",
+                                pool=self._pool_label)
+        shed = self._series(scrape, "pva_fleet_shed_total",
+                            pool=self._pool_label)
+        per_replica = {}
+        prefix = 'pva_fleet_outstanding{pool="%s",replica="' % self._pool_label
+        for key, v in scrape.items():
+            if key.startswith(prefix):
+                per_replica[key[len(prefix):-2]] = float(v)
+        # pooled-window percentiles: the one signal the registry cannot
+        # carry (see module docstring); same snapshot call /stats serves
+        snap = self.router.fleet_snapshot(model=model)
+        return ControlSignals(
+            t=time.monotonic(),
+            routable=routable,
+            members=float(snap.get("replicas_total",
+                                   snap.get("replicas", 0.0))),
+            outstanding=sum(per_replica.values()),
+            queue_depth=float(self.router.queue_depth()),
+            p99_ms=float(snap.get("p99_ms", 0.0)),
+            throughput_rps=float(snap.get("throughput_rps", 0.0)),
+            shed_total=shed,
+            per_replica_outstanding=per_replica,
+        )

@@ -55,6 +55,96 @@ DEFAULT_VIEW_MIX = ((1, 0.85), (2, 0.12), (4, 0.03))
 OPEN_LOOP_LAG_TOLERANCE_MS = 250.0
 
 
+# --- piecewise traffic profiles (step / ramp / spike) -----------------------
+#
+# A profile is a sequence of segments, each (duration_s, rate) for a
+# constant-rate step or (duration_s, rate_start, rate_end) for a linear
+# ramp. Arrivals inside every segment are still a seeded Poisson process
+# (ramps via thinning against the segment's max rate), so the open-loop
+# honesty fields — max_arrival_lag_ms / open_loop_ok, measured against the
+# composite schedule — mean exactly what they mean for a constant rate.
+# This is what lets the autoscaler convergence proof drive a real traffic
+# STEP instead of a constant offered rate.
+
+def step_profile(*segments) -> List[tuple]:
+    """Validate/normalize a piecewise profile: each segment is
+    (duration_s, rate) or (duration_s, rate_start, rate_end)."""
+    out = []
+    for seg in segments:
+        seg = tuple(float(x) for x in seg)
+        if len(seg) == 2:
+            seg = (seg[0], seg[1], seg[1])
+        if len(seg) != 3:
+            raise ValueError(f"profile segment {seg!r}: want "
+                             "(duration_s, rate) or (duration_s, r0, r1)")
+        if seg[0] <= 0 or seg[1] < 0 or seg[2] < 0:
+            raise ValueError(f"profile segment {seg!r}: duration must be "
+                             "positive and rates non-negative")
+        out.append(seg)
+    if not out:
+        raise ValueError("profile needs at least one segment")
+    return out
+
+
+def ramp_profile(duration_s: float, start_rps: float,
+                 end_rps: float) -> List[tuple]:
+    """One linear ramp from start_rps to end_rps over duration_s."""
+    return step_profile((duration_s, start_rps, end_rps))
+
+
+def spike_profile(base_rps: float, spike_rps: float, *, duration_s: float,
+                  spike_at_s: float, spike_s: float) -> List[tuple]:
+    """Constant base rate with one rectangular spike riding on top."""
+    if not 0.0 < spike_at_s < spike_at_s + spike_s < duration_s:
+        raise ValueError("spike must fit strictly inside the run window")
+    return step_profile(
+        (spike_at_s, base_rps), (spike_s, spike_rps),
+        (duration_s - spike_at_s - spike_s, base_rps))
+
+
+def _segment_arrivals(rng: np.random.Generator, duration_s: float,
+                      r0: float, r1: float) -> np.ndarray:
+    """Seeded Poisson arrivals on [0, duration_s) at a rate moving
+    linearly r0 -> r1 (constant when equal); ramps by thinning a
+    homogeneous process at the segment's max rate."""
+    rmax = max(r0, r1)
+    if rmax <= 0 or duration_s <= 0:
+        return np.empty(0, np.float64)
+    draw = max(int(rmax * duration_s * 2), 16)
+    t = np.cumsum(rng.exponential(1.0 / rmax, size=draw))
+    while t.size and t[-1] < duration_s:  # extend until past the window
+        t = np.concatenate(
+            [t, t[-1] + np.cumsum(rng.exponential(1.0 / rmax, size=draw))])
+    t = t[t < duration_s]
+    if r0 != r1 and t.size:
+        keep = rng.random(t.size) < (r0 + (r1 - r0) * t / duration_s) / rmax
+        t = t[keep]
+    return t
+
+
+def piecewise_arrivals(rng: np.random.Generator,
+                       profile: Sequence[tuple]) -> np.ndarray:
+    """Concatenated arrival times for a normalized profile, sorted,
+    offset per segment start."""
+    chunks, t_off = [], 0.0
+    for dur, r0, r1 in profile:
+        chunks.append(_segment_arrivals(rng, dur, r0, r1) + t_off)
+        t_off += dur
+    return np.concatenate(chunks) if chunks else np.empty(0, np.float64)
+
+
+def profile_duration_s(profile: Sequence[tuple]) -> float:
+    return float(sum(seg[0] for seg in profile))
+
+
+def profile_mean_rps(profile: Sequence[tuple]) -> float:
+    total = profile_duration_s(profile)
+    if total <= 0:
+        return 0.0
+    return float(sum(dur * (r0 + r1) / 2.0
+                     for dur, r0, r1 in profile) / total)
+
+
 def _classify_outcome(err) -> str:
     """One rule for both generators: completed / shed (the 503 family) /
     failed — every request classified exactly once."""
@@ -104,11 +194,19 @@ class LoadGen:
     """One open-loop run against any `submit(clip, **kw) -> Future` front
     (a `Scheduler`, a `Router`, an `HttpReplica`)."""
 
-    def __init__(self, submit, *, rate_rps: float, duration_s: float,
+    def __init__(self, submit, *, rate_rps: float = 0.0,
+                 duration_s: float = 0.0,
                  clip_factory: Callable, seed: int = 0,
                  priority: Optional[str] = None,
                  deadline_ms: Optional[float] = None,
+                 profile: Optional[Sequence[tuple]] = None,
                  grace_s: float = 15.0):
+        # a piecewise profile REPLACES the constant (rate_rps, duration_s)
+        # pair; rate/duration then derive from the profile for reporting
+        self.profile = step_profile(*profile) if profile is not None else None
+        if self.profile is not None:
+            rate_rps = profile_mean_rps(self.profile)
+            duration_s = profile_duration_s(self.profile)
         if rate_rps <= 0 or duration_s <= 0:
             raise ValueError("rate_rps and duration_s must be positive")
         self.submit = submit
@@ -145,11 +243,14 @@ class LoadGen:
         """Blocking: generate the arrival schedule, fire it, wait out the
         stragglers (bounded by `grace_s`), return the report dict."""
         rng = np.random.default_rng(self.seed)
-        gaps = rng.exponential(1.0 / self.rate_rps,
-                               size=max(int(self.rate_rps
-                                            * self.duration_s * 2), 16))
-        arrivals = np.cumsum(gaps)
-        arrivals = arrivals[arrivals < self.duration_s]
+        if self.profile is not None:
+            arrivals = piecewise_arrivals(rng, self.profile)
+        else:
+            gaps = rng.exponential(1.0 / self.rate_rps,
+                                   size=max(int(self.rate_rps
+                                                * self.duration_s * 2), 16))
+            arrivals = np.cumsum(gaps)
+            arrivals = arrivals[arrivals < self.duration_s]
         kwargs: dict = {}
         if self.priority is not None:
             kwargs["priority"] = self.priority
@@ -267,12 +368,21 @@ class StreamLoadGen:
     (the re-establish-anywhere contract replica death recovery needs);
     the last advance carries ``end=True``."""
 
-    def __init__(self, submit, *, stream_rate_sps: float, duration_s: float,
+    def __init__(self, submit, *, stream_rate_sps: float = 0.0,
+                 duration_s: float = 0.0,
                  window: int, stride: int, frame_shape: tuple,
                  advance_interval_s: float, seed: int = 0,
                  mean_advances: float = 8.0, max_advances: int = 64,
                  attach_window: bool = True, dtype: str = "float32",
-                 priority: Optional[str] = None, grace_s: float = 15.0):
+                 priority: Optional[str] = None,
+                 profile: Optional[Sequence[tuple]] = None,
+                 grace_s: float = 15.0):
+        # piecewise profile over STREAM arrivals (advances still pace at
+        # advance_interval_s per stream) — same semantics as LoadGen
+        self.profile = step_profile(*profile) if profile is not None else None
+        if self.profile is not None:
+            stream_rate_sps = profile_mean_rps(self.profile)
+            duration_s = profile_duration_s(self.profile)
         if stream_rate_sps <= 0 or duration_s <= 0:
             raise ValueError("stream_rate_sps and duration_s must be "
                              "positive")
@@ -298,11 +408,14 @@ class StreamLoadGen:
     def _schedule(self, rng) -> List[tuple]:
         """-> [(t, stream_idx, k, n_stream)] sorted by time: Poisson
         stream arrivals x heavy-tail per-stream advance counts."""
-        gaps = rng.exponential(
-            1.0 / self.stream_rate_sps,
-            size=max(int(self.stream_rate_sps * self.duration_s * 2), 8))
-        arrivals = np.cumsum(gaps)
-        arrivals = arrivals[arrivals < self.duration_s]
+        if self.profile is not None:
+            arrivals = piecewise_arrivals(rng, self.profile)
+        else:
+            gaps = rng.exponential(
+                1.0 / self.stream_rate_sps,
+                size=max(int(self.stream_rate_sps * self.duration_s * 2), 8))
+            arrivals = np.cumsum(gaps)
+            arrivals = arrivals[arrivals < self.duration_s]
         events = []
         for i, t_arr in enumerate(arrivals):
             # bounded Pareto (alpha 1.5): mostly short streams, a heavy

@@ -59,10 +59,21 @@ class ReplicaDeadError(RuntimeError):
 class LocalReplica:
     """In-process replica: one engine behind one `Scheduler`."""
 
-    def __init__(self, name: str, scheduler, stats=None):
+    def __init__(self, name: str, scheduler, stats=None,
+                 model: Optional[str] = None):
         self.name = name
         self.scheduler = scheduler
         self.stats = stats if stats is not None else scheduler.stats
+        # model family served here (multi-model routing key); defaults to
+        # the engine's own identity when it declares one
+        if model is None:
+            try:
+                model = getattr(scheduler.current_engine(), "model_name",
+                                None)
+            except Exception:
+                model = None
+        self.model = model
+        self._draining = False
 
     def submit(self, clip, **kwargs) -> Future:
         try:
@@ -98,7 +109,17 @@ class LocalReplica:
         return outer
 
     def health(self) -> str:
-        return "dead" if self.scheduler._closed.is_set() else "healthy"
+        if self.scheduler._closed.is_set():
+            return "dead"
+        return "draining" if self._draining else "healthy"
+
+    def drain(self) -> bool:
+        """Scale-down actuator: report `draining` from here on, so the
+        pool's poller removes this replica from the rotation within one
+        health interval (the admission state machine's terminal state,
+        mirrored for the in-process shape). Idempotent."""
+        self._draining = True
+        return True
 
     def queue_depth(self) -> int:
         try:
@@ -123,12 +144,13 @@ class HttpReplica:
 
     def __init__(self, name: str, url: str, *, pid: Optional[int] = None,
                  timeout_s: float = 30.0, health_timeout_s: float = 2.0,
-                 workers: int = 8):
+                 workers: int = 8, model: Optional[str] = None):
         self.name = name
         self.url = url.rstrip("/")
         self.pid = pid
         self.timeout_s = float(timeout_s)
         self.health_timeout_s = float(health_timeout_s)
+        self.model = model  # multi-model routing key (None = unlabeled)
         self._pool = ThreadPoolExecutor(
             max_workers=workers, thread_name_prefix=f"pva-http-{name}")
 
@@ -233,6 +255,22 @@ class HttpReplica:
         except Exception:
             return {}
 
+    def drain(self) -> bool:
+        """Scale-down actuator: flip the remote admission state machine to
+        DRAINING via the server's POST /drain controller endpoint; the
+        replica then 503s /healthz and the poller pulls it from the
+        rotation. Returns False (never raises) on an unreachable replica —
+        a dead victim needs no drain."""
+        req = urllib.request.Request(
+            self.url + "/drain", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(
+                    req, timeout=self.health_timeout_s) as r:
+                return bool(json.loads(r.read()).get("draining", True))
+        except Exception:
+            return False
+
     def close(self) -> None:
         self._pool.shutdown(wait=False)
 
@@ -277,6 +315,39 @@ class ReplicaPool:
             down = self._down
         return [r for r in self.replicas if r.name not in down]
 
+    def add_replica(self, replica) -> None:
+        """Controller actuator (autoscaler scale-up): join the rotation.
+        The new member is routable immediately — a fresh spawn already
+        passed its bind-line handshake; the poller takes over from here."""
+        with self._lock:
+            if any(r.name == replica.name for r in self.replicas):
+                raise ValueError(f"replica {replica.name!r} already pooled")
+            # rebind a copy: the poller and routable() iterate snapshots,
+            # so membership flips atomically under the lock
+            self.replicas = self.replicas + [replica]
+            self._down = frozenset(self._down - {replica.name})
+        logger.info("fleet: replica %s joined the pool", replica.name)
+        obs.get_recorder().record("fleet", "membership",
+                                  replica=replica.name, joined=True)
+
+    def remove_replica(self, replica, *, close: bool = True) -> None:
+        """Controller actuator (autoscaler reap): leave the pool for good.
+        Unlike `mark_down` this is not a health verdict the poller can
+        revert — the replica is gone from membership entirely."""
+        with self._lock:
+            self.replicas = [r for r in self.replicas
+                             if r.name != replica.name]
+            self._down = frozenset(self._down - {replica.name})
+        logger.info("fleet: replica %s removed from the pool", replica.name)
+        obs.get_recorder().record("fleet", "membership",
+                                  replica=replica.name, removed=True)
+        if close:
+            try:
+                replica.close()
+            except Exception:
+                logger.exception("fleet: closing replica %s failed",
+                                 replica.name)
+
     def mark_down(self, replica) -> None:
         """Router-observed death: leave the rotation NOW (the poller would
         take up to one interval to notice); the poller restores membership
@@ -304,7 +375,7 @@ class ReplicaPool:
 
     def _poll_loop(self) -> None:
         while not self._closed:
-            for replica in self.replicas:
+            for replica in list(self.replicas):
                 if self._closed:
                     return
                 try:

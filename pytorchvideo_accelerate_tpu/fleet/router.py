@@ -104,18 +104,33 @@ class Router:
             "pva_fleet_outstanding",
             "requests in flight, by pool and replica",
             labelnames=("pool", "replica"))
+        # multi-model serving: per-model-family traffic under the shared
+        # pool (docs/SERVING.md § fleet intelligence). Separate series,
+        # not extra labels on the unlabeled ones above — metric label
+        # schemas are get-or-create by name and must never be widened
+        self._c_model_routed = self.registry.counter(
+            "pva_fleet_model_routed_total",
+            "requests dispatched, by pool and model family",
+            labelnames=("pool", "model"))
+        self._c_model_shed = self.registry.counter(
+            "pva_fleet_model_shed_total",
+            "requests shed at the router, by pool and model family",
+            labelnames=("pool", "model"))
 
     # --- the batcher interface -------------------------------------------
 
     def submit(self, clip, *, priority: Optional[str] = None,
                deadline_ms: Optional[float] = None,
-               session: Optional[dict] = None) -> Future:
+               session: Optional[dict] = None,
+               model: Optional[str] = None) -> Future:
         """Route ONE request; returns a Future that survives replica death
         (re-dispatched) and resolves with logits, `QueueFullError` (shed),
         or the terminal error once retries are exhausted. A `session`
         envelope routes with affinity (see module docstring) and carries
         the resendable window a survivor re-establishes from when the
-        affinity replica dies mid-flight."""
+        affinity replica dies mid-flight. A `model` narrows the candidate
+        set to replicas serving that model family (multi-model pools);
+        the request sheds — per-model labeled — when none is routable."""
         kwargs: dict = {}
         if priority is not None:
             kwargs["priority"] = priority
@@ -129,7 +144,7 @@ class Router:
         # a death/shed runs on a done-callback thread with no context —
         # the captured value re-attaches it there (trace.attach)
         self._dispatch(outer, clip, kwargs, self.retries,
-                       ctx=trace.capture())
+                       ctx=trace.capture(), model=model)
         return outer
 
     def queue_depth(self) -> int:
@@ -161,15 +176,19 @@ class Router:
         with self._lock:
             return any(v > 0 for v in self._outstanding.values())
 
-    def _pick(self, exclude: frozenset, sid: Optional[str] = None) -> List:
+    def _pick(self, exclude: frozenset, sid: Optional[str] = None,
+              model: Optional[str] = None) -> List:
         """Routable replicas, least-outstanding first; ties rotate
         round-robin (an idle fleet must spread load, not pile onto the
         alphabetically-first replica). A session id promotes its affinity
         replica to the FRONT of the order — the rest stay as the shed/
         death fallback chain, so losing the affinity replica degrades to
-        ordinary routing instead of failing."""
+        ordinary routing instead of failing. A model narrows candidates
+        to the replicas serving that family."""
         candidates = [r for r in self.pool.routable()
-                      if r.name not in exclude]
+                      if r.name not in exclude
+                      and (model is None
+                           or getattr(r, "model", None) == model)]
         if not candidates:
             return []
         with self._lock:
@@ -198,6 +217,15 @@ class Router:
         with self._lock:
             self._affinity.pop(sid, None)
 
+    def sessions_on(self, replica_name: str) -> List[str]:
+        """Session ids whose affinity pins `replica_name` — the controller
+        reads this to re-home a scale-down victim's live streams before
+        reaping it (each forgotten session re-establishes elsewhere from
+        its resendable window on its next advance)."""
+        with self._lock:
+            return [sid for sid, holder in self._affinity.items()
+                    if holder == replica_name]
+
     def _track(self, name: str, delta: int) -> None:
         with self._lock:
             n = max(self._outstanding.get(name, 0) + delta, 0)
@@ -208,13 +236,14 @@ class Router:
                                     replica=name)
 
     def _dispatch(self, outer: Future, clip, kwargs, attempts_left: int,
-                  exclude: frozenset = frozenset(), ctx=None) -> None:
+                  exclude: frozenset = frozenset(), ctx=None,
+                  model: Optional[str] = None) -> None:
         if outer.cancelled():  # the client gave up (504) before dispatch
             return
         last_shed: Optional[QueueFullError] = None
         session = kwargs.get("session")
         sid = str(session["sid"]) if session and session.get("sid") else None
-        for replica in self._pick(exclude, sid=sid):
+        for replica in self._pick(exclude, sid=sid, model=model):
             try:
                 with trace.attach(ctx):
                     inner = replica.submit(clip, **kwargs)
@@ -243,25 +272,34 @@ class Router:
                 # complete and be dropped at settle; nothing to deliver
                 self._c_routed.inc(pool=self._pool_label,
                                replica=replica.name)
+                if model is not None:
+                    self._c_model_routed.inc(pool=self._pool_label,
+                                             model=model)
                 self._track(replica.name, +1)
                 inner.add_done_callback(
                     lambda f, r=replica: self._track(r.name, -1))
                 return
             self._c_routed.inc(pool=self._pool_label,
                                replica=replica.name)
+            if model is not None:
+                self._c_model_routed.inc(pool=self._pool_label, model=model)
             self._track(replica.name, +1)
             inner.add_done_callback(
                 lambda f, r=replica: self._settle(
-                    outer, clip, kwargs, attempts_left, r, f, ctx=ctx))
+                    outer, clip, kwargs, attempts_left, r, f, ctx=ctx,
+                    model=model))
             return
         # nothing took it: the ROUTER sheds (every candidate shed or died)
         self._c_shed.inc(pool=self._pool_label)
+        if model is not None:
+            self._c_model_shed.inc(pool=self._pool_label, model=model)
         err = last_shed if last_shed is not None else QueueFullError(
             "no routable replicas", retry_after_s=self.retry_after_s)
         self._fail(outer, err)
 
     def _settle(self, outer: Future, clip, kwargs, attempts_left: int,
-                replica, inner: Future, ctx=None) -> None:
+                replica, inner: Future, ctx=None,
+                model: Optional[str] = None) -> None:
         self._track(replica.name, -1)
         if outer.cancelled():
             return
@@ -280,7 +318,8 @@ class Router:
             logger.warning("fleet: %s died mid-request; re-dispatching",
                            replica.name)
             self._dispatch(outer, clip, kwargs, attempts_left - 1,
-                           exclude=frozenset({replica.name}), ctx=ctx)
+                           exclude=frozenset({replica.name}), ctx=ctx,
+                           model=model)
             return
         if isinstance(err, ReplicaDeadError):
             self.pool.mark_down(replica)
@@ -292,7 +331,8 @@ class Router:
             # replica is NOT marked down: shedding is it working.
             self._c_retried.inc(pool=self._pool_label)
             self._dispatch(outer, clip, kwargs, attempts_left - 1,
-                           exclude=frozenset({replica.name}), ctx=ctx)
+                           exclude=frozenset({replica.name}), ctx=ctx,
+                           model=model)
             return
         self._fail(outer, err)
 
@@ -311,36 +351,49 @@ class Router:
                           "rejected_400", "rejected_503", "rejected_504",
                           "shed", "compiled_buckets")
 
-    def fleet_snapshot(self) -> Dict[str, float]:
+    def fleet_snapshot(self, model: Optional[str] = None) -> Dict[str, float]:
         """Cross-replica aggregate: pooled latency percentiles + summed
         counters (`ServingStats.merge`), plus the router's own counters.
         Router sheds ride as `router_shed` — NEVER folded into the replica
         `shed` sum, so a shed is counted exactly once wherever it
-        happened.
+        happened. `model` restricts the replica set (and the labeled
+        router counters) to one model family — the per-model view the
+        multi-model controller compares against its budget.
 
         HttpReplica counters are summed from their `/stats` snapshots;
         their raw latency WINDOWS are not available over the wire, so the
         percentiles cover window-bearing (in-process) replicas only —
         `replicas_windowed` says how many that is, so an all-HTTP fleet's
         0.0 percentiles read as "no windows", never as "no latency"."""
-        local = [r for r in self.pool.replicas
+        members = [r for r in list(self.pool.replicas)
+                   if model is None or getattr(r, "model", None) == model]
+        local = [r for r in members
                  if getattr(r, "stats", None) is not None]
-        remote = [r for r in self.pool.replicas if r not in local]
+        remote = [r for r in members if r not in local]
         with self._lock:
             outstanding = dict(self._outstanding)
             affine = len(self._affinity)
+        if model is None:
+            shed = self._c_shed.value(pool=self._pool_label)
+        else:
+            shed = self._c_model_shed.value(pool=self._pool_label,
+                                            model=model)
         merged = ServingStats.merge([r.stats for r in local], extra={
             "sessions_affine": float(affine),
-            "router_shed": self._c_shed.value(pool=self._pool_label),
+            "router_shed": shed,
             "router_retries": self._c_retried.value(pool=self._pool_label),
-            "replicas_routable": float(len(self.pool.routable())),
-            "replicas_total": float(len(self.pool.replicas)),
+            "replicas_routable": float(len(
+                [r for r in self.pool.routable() if r in members])),
+            "replicas_total": float(len(members)),
             "outstanding": float(sum(outstanding.values())),
         })
         for replica in remote:
             snap = replica.snapshot()  # {} when the replica is unreachable
             for key in self._SNAPSHOT_COUNTERS:
                 merged[key] = merged.get(key, 0.0) + float(snap.get(key, 0.0))
-        merged["replicas"] = float(len(self.pool.replicas))
+        merged["replicas"] = float(len(members))
         merged["replicas_windowed"] = float(len(local))
+        if model is not None:
+            merged["model_routed"] = self._c_model_routed.value(
+                pool=self._pool_label, model=model)
         return merged

@@ -293,6 +293,34 @@ class Histogram(_Metric):
         labels = [_fmt(b) for b in self.buckets] + ["+Inf"]
         return {labels[i]: ex for i, ex in enumerate(exs) if ex is not None}
 
+    def quantile(self, q: float) -> float:
+        """Bucket-interpolated quantile estimate (Prometheus
+        `histogram_quantile` semantics): find the bucket the q-th
+        observation falls in, linearly interpolate inside it.  This is the
+        registry-fed read the fleet controller uses for its p99-vs-SLO
+        signal — same cumulative counts `/metrics` exposes, so the
+        autoscaler and a human watching the scrape argue from one number.
+        NaN when empty; the top bucket clamps to its lower bound (the +Inf
+        bucket has no upper edge to interpolate toward)."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            counts = list(self._counts)
+        total = sum(counts)
+        if total == 0:
+            return float("nan")
+        rank = q * total
+        cum = 0
+        for i, b in enumerate(self.buckets):
+            prev = cum
+            cum += counts[i]
+            if cum >= rank:
+                lo = self.buckets[i - 1] if i > 0 else 0.0
+                if counts[i] == 0:
+                    return b
+                return lo + (b - lo) * (rank - prev) / counts[i]
+        return self.buckets[-1]
+
     def render(self, exemplars: bool = False) -> str:
         with self._lock:
             counts = list(self._counts)
@@ -349,6 +377,29 @@ class Registry:
     def get(self, name: str) -> Optional[_Metric]:
         with self._lock:
             return self._metrics.get(name)
+
+    def scrape(self, prefix: str = "") -> Dict[str, float]:
+        """Flat numeric snapshot of every metric whose name starts with
+        `prefix` — the controller-facing read of the SAME numbers
+        `/metrics` renders.  Counters/gauges emit one entry per label
+        combination, keyed Prometheus-style
+        (``name{label="v"}`` — unlabeled series key on the bare name);
+        histograms emit ``name_sum`` and ``name_count``.  Callback gauges
+        are evaluated live, outside the registry lock."""
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)
+                       if n.startswith(prefix)]
+        out: Dict[str, float] = {}
+        for m in metrics:
+            if isinstance(m, Histogram):
+                out[f"{m.name}_sum"] = m.sum
+                out[f"{m.name}_count"] = float(m.count)
+            else:
+                for labels, v in m.samples():
+                    key = m.name + _label_str(
+                        m.labelnames, tuple(labels[n] for n in m.labelnames))
+                    out[key] = float(v)
+        return out
 
     def render(self, exemplars: bool = False) -> str:
         """Prometheus text exposition v0.0.4 of every registered metric;

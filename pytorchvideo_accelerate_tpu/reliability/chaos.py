@@ -1232,6 +1232,207 @@ def leg_stream_kv_kill(report: dict, seed: int, log: Log) -> None:
                 pass
 
 
+def leg_autoscale_kill(report: dict, seed: int, log: Log) -> None:
+    """SIGKILL a pooled replica UNDER AUTOSCALER CONTROL
+    (fleet/control/autoscaler.py): the controller must confirm the corpse
+    (`dead_after_ticks` consecutive unroutable ticks + a dead health
+    verdict), replace it with EXACTLY ONE spawn (a dead name is never
+    double-counted against the target), re-home its sessions onto
+    survivors with zero non-shed failures and position-correct labels —
+    and still refuse to drain the last routable replica no matter what
+    the signals say (the never-scale-to-zero floor is structural)."""
+    import signal as _signal
+    import subprocess
+
+    import numpy as np
+
+    from pytorchvideo_accelerate_tpu.fleet.control import Autoscaler
+    from pytorchvideo_accelerate_tpu.fleet.pool import (
+        HttpReplica,
+        ReplicaPool,
+    )
+    from pytorchvideo_accelerate_tpu.fleet.router import Router
+    from pytorchvideo_accelerate_tpu.serving.stub import stub_stream_logits
+
+    leg = _leg(report, "autoscale_kill")
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    T, S, HW, NCLS = 8, 2, 4, 4
+    n_sessions, n_advances, kill_after = 4, 6, 2
+    procs: List[subprocess.Popen] = []
+    router = None
+    asc = None
+    spawn_n = {"n": 0}
+    rng = np.random.default_rng(seed)
+    try:
+        for _ in range(2):
+            procs.append(subprocess.Popen(
+                [sys.executable, "-c",
+                 _STREAM_SRV_CODE.format(forward_s=0.002)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True))
+        replicas = [HttpReplica(f"akill-{i}", _read_url_line(p),
+                                pid=p.pid, timeout_s=20.0)
+                    for i, p in enumerate(procs)]
+        pool = ReplicaPool(replicas, health_interval_s=0.25)
+        router = Router(pool, retries=3)
+
+        def spawn():
+            p = subprocess.Popen(
+                [sys.executable, "-c",
+                 _STREAM_SRV_CODE.format(forward_s=0.002)],
+                env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.DEVNULL, text=True)
+            procs.append(p)  # cleanup owns every child, spawned or seed
+            spawn_n["n"] += 1
+            r = HttpReplica(f"akill-sp-{spawn_n['n']}", _read_url_line(p),
+                            pid=p.pid, timeout_s=20.0)
+            r._proc = p
+            return r
+
+        def reap(replica):
+            p = getattr(replica, "_proc", None)
+            if p is not None:
+                try:
+                    p.kill()
+                    p.wait(timeout=10.0)
+                except Exception:
+                    pass
+
+        # replacement is the only control loop under test: the watermarks
+        # park at +/-inf so no pressure/idle decision can fire, and the
+        # controller is stepped MANUALLY (never start()ed) so the tick
+        # count the corpse confirmation needs is deterministic
+        asc = Autoscaler(router, spawn_fn=spawn, reap_fn=reap,
+                         min_replicas=2, max_replicas=4,
+                         slo_p99_ms=1e9, queue_high=1e9, queue_low=0.0,
+                         cooldown_s=0.05, interval_s=0.05, ewma_alpha=1.0,
+                         drain_grace_s=1.0, dead_after_ticks=2)
+
+        windows = {f"as-{i}": rng.standard_normal(
+            (T, HW, HW, 3)).astype(np.float32) for i in range(n_sessions)}
+        failures, mismatches, sheds = 0, 0, 0
+
+        def advance_all():
+            nonlocal failures, mismatches, sheds
+            futs = {}
+            for sid in windows:
+                frames = rng.standard_normal(
+                    (S, HW, HW, 3)).astype(np.float32)
+                windows[sid] = np.concatenate(
+                    [windows[sid][S:], frames], axis=0)
+                # the resendable window rides every advance — the
+                # re-establish-anywhere contract replacement needs
+                futs[sid] = router.submit(
+                    {"video": frames},
+                    session={"sid": sid, "window": windows[sid],
+                             "stride": S})
+            for sid, fut in futs.items():
+                try:
+                    out = np.asarray(fut.result(timeout=30))
+                except Exception as e:  # noqa: BLE001 - verdict, not crash
+                    from pytorchvideo_accelerate_tpu.serving.batcher import (
+                        QueueFullError,
+                    )
+
+                    if isinstance(e, QueueFullError):
+                        sheds += 1
+                    else:
+                        failures += 1
+                    continue
+                want = stub_stream_logits(windows[sid], NCLS)
+                if abs(out[0] - want[0]) > 1e-4:
+                    mismatches += 1
+
+        for sid, win in windows.items():
+            out = np.asarray(router.submit(
+                {}, session={"sid": sid, "window": win,
+                             "stride": S}).result(timeout=30))
+            if abs(out[0] - stub_stream_logits(win, NCLS)[0]) > 1e-4:
+                mismatches += 1
+        holders = {sid: router._affinity.get(sid) for sid in windows}
+        victim_name = replicas[0].name
+        victim_sessions = [s for s, h in holders.items()
+                           if h == victim_name]
+        leg["victim_sessions"] = len(victim_sessions)
+        for _ in range(kill_after):
+            advance_all()
+        os.kill(procs[0].pid, _signal.SIGKILL)
+        log(f"[chaos] autoscale_kill: killed {victim_name} holding "
+            f"{len(victim_sessions)} live session(s)")
+        time.sleep(0.6)  # > one poller interval: the corpse leaves routable
+        replaced = False
+        deadline = time.monotonic() + 60.0
+        while time.monotonic() < deadline:
+            if asc.step() == "replace":
+                replaced = True
+                break
+            time.sleep(0.15)
+        names = sorted(r.name for r in pool.replicas)
+        leg.update(replaced=replaced, spawns=spawn_n["n"], members=names)
+        if not replaced:
+            _finding(report, "autoscale_kill",
+                     "controller never replaced the killed replica "
+                     f"(membership {names})")
+        if victim_name in names:
+            _finding(report, "autoscale_kill",
+                     f"corpse {victim_name} still in membership after the "
+                     "replace (a dead name the target keeps paying for)")
+        if spawn_n["n"] != 1:
+            _finding(report, "autoscale_kill",
+                     f"{spawn_n['n']} spawn(s) for ONE dead replica (the "
+                     "corpse was double-counted against the target)")
+        for _ in range(kill_after, n_advances):
+            advance_all()
+        moved = [s for s in victim_sessions
+                 if router._affinity.get(s) not in (None, victim_name)]
+        leg.update(advances=n_advances * n_sessions, failed=failures,
+                   shed=sheds, mismatches=mismatches, moved=len(moved))
+        if failures:
+            _finding(report, "autoscale_kill",
+                     f"{failures} non-shed client-visible failure(s) "
+                     "across kill + replace (re-route, re-establish and "
+                     "the replacement must absorb replica death)")
+        if mismatches:
+            _finding(report, "autoscale_kill",
+                     f"{mismatches} label(s) diverged from the client-"
+                     "window expectation through the replacement")
+        if victim_sessions and not moved:
+            _finding(report, "autoscale_kill",
+                     "no victim session re-routed off the killed replica")
+        # last-healthy probe (white-box): drain down to ONE routable
+        # replica, then demand the controller refuses to drain IT
+        first = asc._drain_one(pool.routable())
+        last = asc._drain_one(pool.routable())
+        n_routable = len(pool.routable())
+        leg.update(drain_first=bool(first), drain_last_refused=not last,
+                   routable_after=n_routable)
+        if not first:
+            _finding(report, "autoscale_kill",
+                     "drain of a redundant replica refused with 2 routable")
+        if last or n_routable != 1:
+            _finding(report, "autoscale_kill",
+                     "controller drained (or lost) the LAST routable "
+                     f"replica ({n_routable} left) — the fleet can scale "
+                     "to zero")
+        log(f"[chaos] autoscale_kill: replace={replaced} "
+            f"(spawns {spawn_n['n']}), {n_advances * n_sessions} advances "
+            f"({failures} failed, {sheds} shed, {mismatches} mismatches, "
+            f"{len(moved)}/{len(victim_sessions)} victims re-homed), "
+            f"last-healthy drain refused={not last}")
+    finally:
+        if asc is not None:
+            asc.close()
+        if router is not None:
+            router.close()
+        for p in procs:
+            try:
+                p.kill()
+                p.wait(timeout=10.0)
+            except Exception:
+                pass
+
+
 def leg_guard_nan(report: dict, tmpdir: str, seed: int, log: Log) -> None:
     """NaN spike mid-epoch (seeded ``nan`` faults at `step.dispatch`): the
     in-graph skip absorbs the first poisoned step, the second crosses the
@@ -1713,6 +1914,7 @@ def run_scenario(seed: int = 42, smoke: bool = True,
                     (leg_replica_kill, (report, seed, log)),
                     (leg_stream_replica_kill, (report, seed, log)),
                     (leg_stream_kv_kill, (report, seed, log)),
+                    (leg_autoscale_kill, (report, seed, log)),
                     (leg_collective_hang, (report, seed, log)),
                     (leg_guard_nan, (report, tmpdir, seed, log)),
                     (leg_preempt, (report, tmpdir, seed, log)),

@@ -12,6 +12,10 @@ Endpoints:
                    /stats counters read from (obs/registry.py): request/
                    batch/rejection counters, latency histogram, queue
                    depth + uptime gauges. Point a scraper here.
+  POST /drain    — controller endpoint (fleet/control/): flip admission
+                   to DRAINING (healthz 503, new work sheds, in-flight
+                   flushes) WITHOUT tearing the process down — the fleet
+                   autoscaler re-homes sessions then reaps separately.
 
 Deliberately stdlib (`http.server.ThreadingHTTPServer`): zero new
 dependencies, and the concurrency story is honest — handler threads only
@@ -140,6 +144,23 @@ class _Handler(BaseHTTPRequestHandler):
         srv: "InferenceServer" = self.server.owner
         if self.path == "/stream":
             self._do_stream(srv)
+            return
+        if self.path == "/drain":
+            # controller-initiated drain (fleet/control/autoscaler.py):
+            # flip admission to DRAINING — /healthz goes 503 so pollers
+            # route around, new work sheds, in-flight futures keep
+            # flushing — but do NOT tear the server down: the controller
+            # re-homes the replica's live sessions first and reaps the
+            # process itself once outstanding work has flushed. Reading
+            # the (empty) body keeps the keep-alive connection clean.
+            length = int(self.headers.get("Content-Length", 0))
+            if length:
+                self.rfile.read(length)
+            srv.admission.start_draining()
+            obs.get_recorder().record("serving", "drain-requested")
+            self._reply(200, {"draining": True,
+                              "status": srv.admission.state(),
+                              "queue_depth": srv.batcher.queue_depth()})
             return
         if self.path != "/predict":
             self._reply(404, {"error": f"no route {self.path}"})

@@ -21,10 +21,12 @@ decorator is import-time metadata only — nothing happens until `arm()`).
 Registered today: DevicePrefetcher, MicroBatcher, ServingStats,
 AdmissionController, Watchdog, SpanCollector, FlightRecorder, TrackerHub,
 the distributed tracer (obs/trace.Tracer), the fleet tier's
-Scheduler / ReplicaPool / Router / LoadGen (fleet/*.py), and the data
-plane's RemoteClipFeed / DecodeWorker (dataplane/*.py — the credit/ack
-machinery) — new threaded classes MUST declare here so the pva-tpu-tsan
-stress scenario gates their concurrency like everything else's.
+Scheduler / ReplicaPool / Router / LoadGen (fleet/*.py), the fleet
+control loops' Autoscaler / CanaryController / ModelBudget
+(fleet/control/*.py), and the data plane's RemoteClipFeed / DecodeWorker
+(dataplane/*.py — the credit/ack machinery) — new threaded classes MUST
+declare here so the pva-tpu-tsan stress scenario gates their concurrency
+like everything else's.
 
 Stdlib-only on purpose: obs/ and serving worker paths import this module,
 and they must stay importable without jax (this file must never grow a

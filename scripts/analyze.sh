@@ -48,6 +48,45 @@ env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python -m pytorchvideo_accelerate_tpu.dataplane.bench --smoke
 
+# fleet-control gate (docs/SERVING.md § fleet intelligence): one
+# FLEET_AUTO lane pass in smoke shape; the control-loop VERDICTS are
+# fatal here — autoscaler converged, zero session failures across the
+# scale-down re-home, exactly one seeded-regression rollback with the
+# blues restored, the clean green promoted, both model families served
+# under the shared budget. The lane's perf numbers stay non-fatal (they
+# inform via the perfdiff report below, like every other lane's).
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python - "${ROOT}/bench.py" <<'PY'
+import json
+import subprocess
+import sys
+
+from pytorchvideo_accelerate_tpu.utils.forcehost import last_json_line
+
+proc = subprocess.run(
+    [sys.executable, sys.argv[1], "--child", "__fleet_auto__", "--smoke"],
+    capture_output=True, text=True, timeout=600)
+out = last_json_line(proc.stdout) or {}
+checks = {
+    "autoscale_converged": out.get("autoscale_converged") is True,
+    "fleet_session_failures": out.get("fleet_session_failures") == 0,
+    "canary_rollback": out.get("canary_rollback") == 1,
+    "canary_blue_restored": out.get("canary_blue_restored") is True,
+    "canary_promoted": out.get("canary_promoted") is True,
+    "budget_shed_ok": out.get("budget_shed_ok") is True,
+    "fleet_models_served": out.get("fleet_models_served", 0) >= 2,
+}
+bad = sorted(k for k, ok in checks.items() if not ok)
+if proc.returncode or bad:
+    print(f"[fleet-auto] FAILED verdict(s): {bad or 'child crashed'} "
+          f"(rc {proc.returncode})", file=sys.stderr)
+    sys.stderr.write(proc.stdout[-800:] + proc.stderr[-800:])
+    sys.exit(1)
+print("[fleet-auto] control-loop verdicts clean: "
+      + json.dumps({k: out.get(k) for k in checks}))
+PY
+
 rc=0
 env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \

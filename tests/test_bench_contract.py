@@ -170,6 +170,10 @@ def test_finalize_line_fits_driver_capture():
         "stream_trunk_parity": True, "stream_trunk_recompiles": 0,
         "stream_trunk_error": "top-1 delta breached " + "q" * 200,
         "stream_error": "no trustworthy device numbers " + "s" * 200,
+        "autoscale_converge_s": 0.373, "fleet_scaledown_shed_frac": 0.0,
+        "canary_rollback": 1, "fleet_models_served": 2,
+        "canary_promoted": True, "fleet_session_failures": 0,
+        "fleet_auto_error": "no trustworthy device numbers " + "a" * 200,
         "kbench_platform": "cpu", "kbench_parity_ok": True,
         "kbench_best": "dw_x3d_res3:118.167x",
         "kbench_dw_x3d_res3_speedup": 118.167,
@@ -419,6 +423,38 @@ def test_finalize_stream_keys_ride_the_headline():
     assert out["stream_recompiles"] == 0
     assert out["stream_trunk_parity"] is True
     assert out["stream_trunk_recompiles"] == 0
+
+
+def test_finalize_fleet_auto_keys_ride_the_headline():
+    """The FLEET_AUTO lane's headline keys (autoscaler convergence
+    seconds, scale-down drain shed fraction, canary ladder rollbacks,
+    model families served under the shared budget — the numbers
+    `--smoke` asserts) plumb through finalize with the promoted/
+    session-failure verdicts; a failed or cpu-fallback lane headlines
+    fleet_auto_error INSTEAD of the numbers while the verdicts ride
+    regardless (the fleet/stream refusal rule)."""
+    extras = {"autoscale_converge_s": 0.373,
+              "fleet_scaledown_shed_frac": 0.0,
+              "canary_rollback": 1, "fleet_models_served": 2,
+              "canary_promoted": True, "fleet_session_failures": 0}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["autoscale_converge_s"] == 0.373
+    assert out["fleet_scaledown_shed_frac"] == 0.0
+    assert out["canary_rollback"] == 1
+    assert out["fleet_models_served"] == 2
+    assert out["canary_promoted"] is True
+    assert out["fleet_session_failures"] == 0
+
+    out = bench.finalize(
+        _model(), {**extras, "fleet_auto_error": "cpu fallback"},
+        user_smoke=False)
+    assert out["fleet_auto_error"] == "cpu fallback"
+    for key in ("autoscale_converge_s", "fleet_scaledown_shed_frac",
+                "canary_rollback", "fleet_models_served"):
+        assert key not in out
+    # verdicts ride the refusal, like stream_parity does
+    assert out["canary_promoted"] is True
+    assert out["fleet_session_failures"] == 0
 
 
 def test_finalize_stream_trunk_quality_refusal():

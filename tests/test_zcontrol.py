@@ -1,0 +1,468 @@
+"""Fleet-control tests (fleet/control/: signals, autoscaler, multi-model
+budget, canary) plus the loadgen piecewise profiles and the registry
+reads the controller argues from.
+
+Named `test_zcontrol` ON PURPOSE: tier-1 runs alphabetically under a
+hard timeout, so the control additions sort LAST. Everything runs
+against host-side stub engines (no XLA compile), with the control loops
+stepped MANUALLY — no background ticking, no sleeps beyond a short
+drain grace.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from pytorchvideo_accelerate_tpu.fleet.control import (
+    Autoscaler,
+    CanaryController,
+    ControlSignals,
+    ModelBudget,
+    MultiModelFleet,
+    SignalReader,
+)
+from pytorchvideo_accelerate_tpu.fleet.loadgen import (
+    LoadGen,
+    piecewise_arrivals,
+    profile_duration_s,
+    profile_mean_rps,
+    ramp_profile,
+    spike_profile,
+    step_profile,
+)
+from pytorchvideo_accelerate_tpu.fleet.pool import LocalReplica, ReplicaPool
+from pytorchvideo_accelerate_tpu.fleet.router import Router
+from pytorchvideo_accelerate_tpu.fleet.scheduler import Scheduler
+from pytorchvideo_accelerate_tpu.obs.registry import Registry
+from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
+from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
+from pytorchvideo_accelerate_tpu.serving.stub import (
+    StubEngine,
+    StubStreamEngine,
+    stub_stream_logits,
+)
+
+
+def _mk_replica(name, engine=None, model=None):
+    stats = ServingStats(window=128, registry=Registry())
+    sched = Scheduler(engine if engine is not None else StubEngine(),
+                      stats=stats, max_queue=64, batch_max_wait_ms=1.0,
+                      name=name)
+    return LocalReplica(name, sched, stats=stats, model=model)
+
+
+def _mk_fleet(replicas):
+    # one shared registry: SignalReader scrapes the ROUTER's registry,
+    # and the pool's healthy-replicas gauge must land in the same scrape
+    reg = Registry()
+    pool = ReplicaPool(replicas, health_interval_s=0.05, registry=reg)
+    return pool, Router(pool, registry=reg)
+
+
+def _clip(tag=0.0):
+    v = np.zeros((2, 4, 4, 3), np.float32)
+    v[0, 0, 0, 0] = tag
+    return {"video": v}
+
+
+class FakeReader:
+    """Deterministic `ControlSignals` source: the decision logic is under
+    test here, not the scrape plumbing (test_signal_reader covers that)."""
+
+    def __init__(self, pool):
+        self.pool = pool
+        self.queue_depth = 0.0
+        self.p99_ms = 0.0
+
+    def read(self, model=None):
+        return ControlSignals(
+            t=time.monotonic(),
+            routable=float(len(self.pool.routable())),
+            members=float(len(self.pool.replicas)),
+            outstanding=0.0, queue_depth=self.queue_depth,
+            p99_ms=self.p99_ms, throughput_rps=0.0, shed_total=0.0)
+
+
+# --- piecewise traffic profiles ---------------------------------------------
+
+def test_step_profile_normalizes_segments():
+    prof = step_profile((1, 5), (2.0, 10, 20))
+    assert prof == [(1.0, 5.0, 5.0), (2.0, 10.0, 20.0)]
+    assert profile_duration_s(prof) == 3.0
+    # the ramp segment contributes its trapezoid mean rate
+    assert profile_mean_rps(prof) == pytest.approx((1 * 5 + 2 * 15) / 3)
+
+
+def test_step_profile_rejects_bad_segments():
+    with pytest.raises(ValueError):
+        step_profile()
+    with pytest.raises(ValueError):
+        step_profile((0.0, 5.0))  # zero-duration segment
+    with pytest.raises(ValueError):
+        step_profile((1.0,))      # want (dur, rate) or (dur, r0, r1)
+
+
+def test_ramp_and_spike_profiles_compose_from_step():
+    assert ramp_profile(2.0, 0.0, 10.0) == [(2.0, 0.0, 10.0)]
+    prof = spike_profile(2.0, 20.0, duration_s=5.0, spike_at_s=1.0,
+                         spike_s=2.0)
+    assert prof == [(1.0, 2.0, 2.0), (2.0, 20.0, 20.0), (2.0, 2.0, 2.0)]
+    with pytest.raises(ValueError):  # spike must fit inside the window
+        spike_profile(2.0, 20.0, duration_s=2.0, spike_at_s=1.0,
+                      spike_s=2.0)
+
+
+def test_piecewise_arrivals_sorted_and_segment_bounded():
+    rng = np.random.default_rng(0)
+    arr = piecewise_arrivals(rng, step_profile((1.0, 200.0), (1.0, 0.0)))
+    assert np.all(np.diff(arr) >= 0)
+    # the rate-0 tail contributes nothing: every arrival lands in [0, 1)
+    assert len(arr) > 0 and arr.min() >= 0.0 and arr.max() <= 1.0
+    assert 140 <= len(arr) <= 260  # Poisson(200), 4-sigma band
+
+
+def test_loadgen_profile_replaces_rate_and_duration():
+    pool, router = _mk_fleet([_mk_replica("lg-0")])
+    try:
+        gen = LoadGen(router.submit, clip_factory=lambda rng: _clip(),
+                      profile=[(0.3, 30.0), (0.1, 0.0)], seed=0)
+        report = gen.run()
+    finally:
+        router.close()
+    # duration_s is measured wall-clock: the run ends when the last
+    # arrival completes, so the rate-0 tail is not waited out
+    assert 0.0 < report["duration_s"] <= 0.45
+    assert 1 <= report["offered"] <= 25  # Poisson(30*0.3), wide band
+    assert report["failed"] == 0 and report["shed"] == 0
+    assert report["open_loop_ok"] is True
+    assert profile_mean_rps(step_profile((0.3, 30.0), (0.1, 0.0))) \
+        == pytest.approx(22.5)
+
+
+# --- signals ----------------------------------------------------------------
+
+def test_signal_reader_reads_the_registry_scrape():
+    pool, router = _mk_fleet([_mk_replica("sig-0")])
+    try:
+        for fut in [router.submit(_clip()) for _ in range(4)]:
+            fut.result(timeout=10)
+        sig = SignalReader(router).read()
+    finally:
+        router.close()
+    assert sig.routable == 1.0 and sig.members == 1.0
+    assert sig.queue_per_replica() == sig.queue_depth
+    assert sig.shed_total == 0.0
+    assert sig.p99_ms >= 0.0
+
+
+def test_registry_scrape_and_histogram_quantile():
+    reg = Registry()
+    c = reg.counter("pva_t_total", "t", labelnames=("pool",))
+    c.inc(pool="a")
+    c.inc(pool="a")
+    reg.gauge("pva_t_up", "t").set(3.0)
+    scrape = reg.scrape("pva_t")
+    assert scrape['pva_t_total{pool="a"}'] == 2.0
+    assert scrape["pva_t_up"] == 3.0
+    assert "pva_other" not in "".join(scrape)  # prefix-filtered view
+    h = reg.histogram("pva_t_lat", "t", buckets=[0.1, 1.0, 10.0])
+    assert np.isnan(h.quantile(0.5))  # empty: unknown, not zero
+    for v in (0.05, 0.5, 0.7, 5.0):
+        h.observe(v)
+    assert 0.1 <= h.quantile(0.5) <= 1.0
+    assert h.quantile(1.0) >= 1.0
+    with pytest.raises(ValueError):
+        h.quantile(1.5)
+
+
+# --- autoscaler -------------------------------------------------------------
+
+def test_autoscaler_scales_up_under_pressure_and_cooldown_damps():
+    pool, router = _mk_fleet([_mk_replica("up-0")])
+    spawned = []
+
+    def spawn():
+        r = _mk_replica(f"up-sp-{len(spawned)}")
+        spawned.append(r)
+        return r
+
+    try:
+        reader = FakeReader(pool)
+        reader.queue_depth = 50.0  # way past queue_high
+        asc = Autoscaler(router, spawn_fn=spawn, min_replicas=1,
+                         max_replicas=3, slo_p99_ms=1000.0, queue_high=2.0,
+                         queue_low=0.5, cooldown_s=60.0, ewma_alpha=1.0,
+                         reader=reader)
+        assert asc.step() == "up"  # first action pays no cooldown
+        assert len(pool.replicas) == 2 and len(spawned) == 1
+        # same pressure, inside the dead time: damped, not re-acted
+        assert asc.step() == "hold"
+        assert len(pool.replicas) == 2
+        assert [e["action"] for e in asc.actions_since(0.0)] == ["up"]
+    finally:
+        router.close()
+
+
+def test_autoscaler_scales_down_to_the_floor_never_the_last():
+    pool, router = _mk_fleet([_mk_replica("dn-0"), _mk_replica("dn-1")])
+    try:
+        reader = FakeReader(pool)  # queue 0, p99 0: idle by construction
+        asc = Autoscaler(router, spawn_fn=lambda: None, min_replicas=1,
+                         max_replicas=2, slo_p99_ms=1000.0, queue_high=2.0,
+                         queue_low=0.5, cooldown_s=0.0, ewma_alpha=1.0,
+                         drain_grace_s=0.2, reader=reader)
+        assert asc.step() == "down"
+        assert len(pool.replicas) == 1
+        # min_replicas floors the target: still idle, nothing to drain
+        assert asc.step() == "hold"
+        assert len(pool.replicas) == 1
+        # and the structural floor under the tunable one: the last
+        # routable replica is never drained, whatever the signals say
+        assert asc._drain_one(pool.routable()) is False
+        assert len(pool.routable()) == 1
+    finally:
+        router.close()
+
+
+def test_autoscaler_drain_rehomes_pinned_sessions():
+    T, S, HW, NCLS = 4, 2, 4, 4
+    pool, router = _mk_fleet([_mk_replica(f"rh-{i}", StubStreamEngine())
+                              for i in range(2)])
+    try:
+        rng = np.random.default_rng(0)
+        wins = {}
+        for i in range(2):
+            sid = f"rh-sess-{i}"
+            wins[sid] = rng.standard_normal(
+                (T, HW, HW, 3)).astype(np.float32)
+            out = np.asarray(router.submit(
+                {}, session={"sid": sid, "window": wins[sid],
+                             "stride": S}).result(timeout=10))
+            assert abs(out[0] - stub_stream_logits(wins[sid], NCLS)[0]) \
+                <= 1e-4
+        holders = {sid: router._affinity[sid] for sid in wins}
+        assert len(set(holders.values())) == 2  # round-robin spread
+        reader = FakeReader(pool)  # idle: the drain path fires
+        asc = Autoscaler(router, spawn_fn=lambda: None, min_replicas=1,
+                         max_replicas=2, slo_p99_ms=1000.0, queue_high=2.0,
+                         queue_low=0.5, cooldown_s=0.0, ewma_alpha=1.0,
+                         drain_grace_s=0.2, reader=reader)
+        assert asc.step() == "down"
+        survivor = pool.replicas[0].name
+        victim = (set(holders.values()) - {survivor}).pop()
+        sid = next(s for s, h in holders.items() if h == victim)
+        # the victim's session lost its pin and re-establishes on the
+        # survivor from the resendable window, at the right position
+        frames = rng.standard_normal((S, HW, HW, 3)).astype(np.float32)
+        wins[sid] = np.concatenate([wins[sid][S:], frames], axis=0)
+        out = np.asarray(router.submit(
+            {"video": frames},
+            session={"sid": sid, "window": wins[sid],
+                     "stride": S}).result(timeout=10))
+        assert abs(out[0] - stub_stream_logits(wins[sid], NCLS)[0]) <= 1e-4
+        assert router._affinity[sid] == survivor
+    finally:
+        router.close()
+
+
+def test_autoscaler_replaces_a_confirmed_dead_member_once():
+    replicas = [_mk_replica("rp-0"), _mk_replica("rp-1")]
+    pool, router = _mk_fleet(replicas)
+    spawned, reaped = [], []
+
+    def spawn():
+        r = _mk_replica(f"rp-sp-{len(spawned)}")
+        spawned.append(r)
+        return r
+
+    try:
+        reader = FakeReader(pool)
+        # watermarks parked so replacement is the only live decision
+        asc = Autoscaler(router, spawn_fn=spawn, reap_fn=reaped.append,
+                         min_replicas=2, max_replicas=3, slo_p99_ms=1e9,
+                         queue_high=1e9, queue_low=0.0, cooldown_s=0.0,
+                         ewma_alpha=1.0, dead_after_ticks=2, reader=reader)
+        replicas[0].scheduler.close()  # health() -> "dead"
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline and len(pool.routable()) > 1:
+            time.sleep(0.01)  # the poller pulls the corpse
+        assert len(pool.routable()) == 1
+        assert asc.step() == "hold"     # streak 1: not yet confirmed
+        assert asc.step() == "replace"  # streak 2 + dead verdict
+        names = {r.name for r in pool.replicas}
+        assert "rp-0" not in names and "rp-sp-0" in names
+        assert len(pool.replicas) == 2
+        assert len(spawned) == 1  # exactly one successor, no double-count
+        assert reaped and reaped[0] is replicas[0]
+    finally:
+        router.close()
+
+
+# --- multi-model budget -----------------------------------------------------
+
+def test_model_budget_priority_is_registration_order():
+    b = ModelBudget(1000.0)
+    b.register("a", 600.0)
+    b.register("b", 300.0)
+    b.register("c", 300.0)
+    assert b.over_budget() == ["c"]  # latest past the line sheds first
+    b.release("b")
+    assert b.over_budget() == []
+    assert b.usage_mb() == 900.0
+
+
+def test_model_budget_earliest_family_always_fits():
+    b = ModelBudget(100.0)
+    b.register("a", 500.0)
+    assert b.over_budget() == []  # never shed the whole pool
+    b.register("b", 1.0)
+    assert b.over_budget() == ["b"]
+
+
+def test_multimodel_fleet_routes_families_and_sheds_over_budget():
+    pool, router = _mk_fleet([
+        _mk_replica("mm-a0", StubEngine(tag=1.0), model="x3d_s"),
+        _mk_replica("mm-b0", StubEngine(tag=2.0), model="videomae_t"),
+    ])
+    try:
+        mmf = MultiModelFleet(router, ModelBudget(1000.0),
+                              retry_after_s=0.5)
+        mmf.register_model("x3d_s", 400.0)
+        mmf.register_model("videomae_t", 400.0,
+                           latency_buckets_ms=(50.0, 500.0, 5000.0))
+        assert mmf.models() == ["x3d_s", "videomae_t"]
+        out = np.asarray(mmf.submit(
+            _clip(), model="x3d_s").result(timeout=10))
+        assert out[1] == pytest.approx(1.0)  # the x3d replica answered
+        out = np.asarray(mmf.submit(
+            _clip(), model="videomae_t").result(timeout=10))
+        assert out[1] == pytest.approx(2.0)
+        mmf.register_model("mvit_b", 400.0)  # 1200 > 1000: newest sheds
+        with pytest.raises(QueueFullError) as ei:
+            mmf.submit(_clip(), model="mvit_b")
+        assert ei.value.retry_after_s == 0.5
+        # the POOL never degrades: in-budget families keep serving
+        out = np.asarray(mmf.submit(
+            _clip(), model="x3d_s").result(timeout=10))
+        assert out[1] == pytest.approx(1.0)
+        assert mmf.model_snapshot("mvit_b")["budget_shed"] == 1.0
+        labels = mmf.snapshot_labels()
+        assert labels["models_served"] == 2.0
+        assert labels["budget_used_mb"] == 1200.0
+    finally:
+        router.close()
+
+
+# --- canary -----------------------------------------------------------------
+
+def _burst(router, n=48):
+    for fut in [router.submit(_clip()) for _ in range(n)]:
+        fut.result(timeout=30)
+
+
+def test_canary_ladder_rolls_back_a_regression_and_restores_blues():
+    replicas = [_mk_replica(f"cn-{i}", StubEngine(tag=0.0,
+                                                  forward_s=0.002))
+                for i in range(4)]
+    pool, router = _mk_fleet(replicas)
+    try:
+        cc = CanaryController(router, fraction=0.25, threshold=0.5,
+                              rollback_after=2, prewarm=False)
+        entry = cc.start_rollout(
+            lambda r: StubEngine(tag=7.0, forward_s=0.05), label="bad")
+        assert len(entry["canaries"]) == 1  # fraction kept the blues
+        verdict = None
+        for _ in range(2):
+            _burst(router)
+            verdict = cc.evaluate()
+        assert verdict["action"] == "rollback"
+        assert verdict["rolled_back"] is True
+        assert verdict["strikes"] == 2
+        assert any(k.startswith("serve_p") for k in verdict["regressions"])
+        assert cc.state == "rolled_back"
+        # every canary swapped back to its kept blue engine
+        assert all(r.scheduler.current_engine().tag == 0.0
+                   for r in replicas)
+    finally:
+        router.close()
+
+
+def test_canary_clean_green_promotes_fleet_wide():
+    replicas = [_mk_replica(f"cp-{i}", StubEngine(tag=0.0, forward_s=0.01))
+                for i in range(4)]
+    pool, router = _mk_fleet(replicas)
+    try:
+        cc = CanaryController(router, fraction=0.25, threshold=0.5,
+                              rollback_after=2, prewarm=False)
+        cc.start_rollout(
+            lambda r: StubEngine(tag=5.0, forward_s=0.01), label="good")
+        _burst(router, n=32)
+        verdict = cc.evaluate()
+        assert verdict["action"] == "observe" and verdict["strikes"] == 0
+        cc.promote()
+        assert cc.state == "promoted"
+        assert all(r.scheduler.current_engine().tag == 5.0
+                   for r in replicas)
+    finally:
+        router.close()
+
+
+def test_canary_promote_refused_on_the_ladder():
+    replicas = [_mk_replica(f"cr-{i}", StubEngine(tag=0.0,
+                                                  forward_s=0.002))
+                for i in range(4)]
+    pool, router = _mk_fleet(replicas)
+    try:
+        cc = CanaryController(router, fraction=0.25, threshold=0.5,
+                              rollback_after=3, prewarm=False)
+        cc.start_rollout(
+            lambda r: StubEngine(tag=7.0, forward_s=0.05), label="bad")
+        _burst(router)
+        verdict = cc.evaluate()
+        assert verdict["action"] == "observe" and verdict["strikes"] == 1
+        with pytest.raises(RuntimeError, match="strike"):
+            cc.promote()  # a strike on the ladder blocks promotion
+        cc.rollback()
+        assert all(r.scheduler.current_engine().tag == 0.0
+                   for r in replicas)
+    finally:
+        router.close()
+
+
+# --- the controller's HTTP actuator -----------------------------------------
+
+@pytest.mark.slow  # real socket (the test_zserving_http convention)
+def test_drain_endpoint_flips_admission_for_the_poller():
+    from pytorchvideo_accelerate_tpu.fleet.pool import HttpReplica
+    from pytorchvideo_accelerate_tpu.serving.server import InferenceServer
+
+    engine = StubEngine()
+    stats = ServingStats(window=64, registry=Registry())
+    sched = Scheduler(engine, stats=stats, max_queue=32, name="drain-t")
+    srv = InferenceServer(engine, sched, stats, host="127.0.0.1",
+                          port=0).start()
+    try:
+        host, port = srv.address
+        req = urllib.request.Request(
+            f"http://{host}:{port}/drain", data=b"{}", method="POST",
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            body = json.loads(r.read())
+        assert body["draining"] is True
+        assert body["status"] == "draining"
+        # /healthz now 503s: the poller's route-around signal
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"http://{host}:{port}/healthz",
+                                   timeout=10)
+        assert ei.value.code == 503
+        # the autoscaler's actuator sees the same state, idempotently
+        hr = HttpReplica("drain-t", f"http://{host}:{port}")
+        assert hr.health() == "draining"
+        assert hr.drain() is True
+        hr.close()
+    finally:
+        srv.close()
