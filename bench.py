@@ -270,6 +270,23 @@ def bench_model(name: str, wl: dict, args, n_chips: int) -> dict:
 SMOKE_TRAINER_SHAPE = (8, 64, 2)
 
 
+def hbm_headline() -> dict:
+    """The memory-ledger triple an obs-armed lane carries (pva-tpu-hbm,
+    obs/memory.py): device high-water mark, the fraction of live bytes
+    the ledger can attribute to a component, and the provenance label.
+    Hosts whose backend exposes no `memory_stats()` (the CPU smoke box)
+    report `hbm_source="estimate"` with the peak ATTRIBUTED sum — the
+    bench never fakes device bytes. Empty when no ledger is armed."""
+    from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
+
+    led = obs_memory.get_ledger()
+    if led is None:
+        return {}
+    return {"hbm_peak_bytes": int(led.peak_bytes()),
+            "hbm_attributed_frac": round(led.attributed_frac(), 4),
+            "hbm_source": led.source()}
+
+
 def bench_trainer(args) -> dict:
     """Trainer.fit() on synthetic data — its steady-state clips/s/chip is
     compared (in the parent) against the raw-step number to prove the hot
@@ -340,6 +357,9 @@ def bench_trainer(args) -> dict:
             "mfu_analytic": res.get("mfu_analytic"),
             "mfu_source": res.get("mfu_source"),
             "mfu_peak_source": res.get("mfu_peak_source"),
+            # memory-ledger triple (obs/memory.py; the Trainer armed the
+            # ledger, so train_state/prefetch-ring bytes are attributed)
+            **hbm_headline(),
             "smoke": bool(args.smoke)}
 
 
@@ -1247,9 +1267,15 @@ def bench_fleet_auto(args) -> dict:
         stub_stream_logits,
     )
 
+    from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
+
     shape = FLEET_AUTO_SMOKE if args.smoke else FLEET_AUTO_FULL
     platform = jax.devices()[0].platform
     fwd = shape["forward_s"]
+    # arm the memory ledger for the lane's hbm_* triple (stub engines pin
+    # no device arrays, so the attribution is trivially honest here —
+    # backend peak where measured, zero-attributed estimate elsewhere)
+    obs_memory.configure()
 
     def mk_replica(name, model, engine):
         stats = ServingStats(window=1024)
@@ -1470,6 +1496,68 @@ def bench_fleet_auto(args) -> dict:
     finally:
         router2.close()
 
+    # the lane's own hbm triple, read BEFORE phase D swaps the process
+    # ledger for its fake-stats probe (a probe's injected backend must
+    # never color the lane's provenance label)
+    hbm = hbm_headline()
+
+    # --- phase D: burn-rate alert discipline + the budget-lies probe ---
+    # D1: a seeded SLO breach must fire its multi-window burn-rate rule
+    # EXACTLY once and clear on recovery (obs/alerts.py hysteresis) —
+    # zero fires during the calm phases is the false-positive gate
+    # scripts/analyze.sh reads off this record. Synthetic clock: the
+    # windows are seconds-denominated, the probe must not be wall-paced.
+    from pytorchvideo_accelerate_tpu.obs.alerts import AlertEngine, AlertRule
+    from pytorchvideo_accelerate_tpu.obs.history import MetricsHistory
+    from pytorchvideo_accelerate_tpu.obs.registry import Registry
+
+    areg = Registry()
+    g_p99 = areg.gauge("pva_probe_p99_ms",
+                       "seeded SLO-breach driver (bench fleet_auto)")
+    eng = AlertEngine(
+        MetricsHistory(registry=areg, capacity=128),
+        [AlertRule(name="p99_burn", kind="gauge", key="pva_probe_p99_ms",
+                   objective=float(shape["slo_p99_ms"]),
+                   fast_s=2.0, slow_s=8.0, hold_clear=2)],
+        registry=areg)
+    slo = float(shape["slo_p99_ms"])
+    t_sim, fires = 1000.0, []
+    for factor, ticks in ((0.25, 20), (4.0, 12), (0.25, 20)):
+        g_p99.set(factor * slo)
+        for _ in range(ticks):
+            eng.tick(now=t_sim)
+            t_sim += 1.0
+        fires.append(eng.fires("p99_burn"))
+    alert_fired_once = fires[0] == 0 and fires[1] == 1
+    alert_cleared = not eng.active()
+    # fires outside the seeded excursion: calm-phase fires + flap re-fires
+    alert_false_positives = fires[0] + (fires[2] - fires[1])
+
+    # D2: the budget-lies probe — a family that under-declares its
+    # footprint must be refused where the ledger can measure it. Injected
+    # backend stats flip ModelBudget onto its measured path; the liar
+    # declares 10 MB (fits), the ledger sees the 90 MB weight pin it
+    # actually made (sheds). Declared-vs-measured admission flipping on
+    # the same state IS the acceptance criterion (ISSUE 18).
+    obs_memory.configure(stats_fn=lambda: {
+        "bytes_in_use": 200 * 10**6, "peak_bytes_in_use": 220 * 10**6,
+        "bytes_limit": 10**9})
+    lies = ModelBudget(100.0)
+    lies.register("honest", 60.0)
+    lies.register("liar", 10.0)
+    admitted_declared = "liar" not in lies.over_budget()
+    obs_memory.register("model_weights:liar", 90 * 10**6,
+                        declared=10 * 10**6)
+    refused_measured = "liar" in lies.over_budget()
+    led = obs_memory.get_ledger()
+    liar_drift = round(led.drift().get("model_weights:liar", 0.0), 2)
+    # disarm: the fake stats_fn must not outlive the probe
+    obs_memory.configure(enabled=False)
+    budget_lies_refused = bool(admitted_declared and refused_measured)
+    log(f"[fleet_auto] alerts: fires per phase {fires} "
+        f"(cleared={alert_cleared}); budget-lies refused="
+        f"{budget_lies_refused} (liar drift {liar_drift})")
+
     out = {
         "autoscale_converge_s": converge_s,
         "fleet_scaledown_shed_frac": shed_frac,
@@ -1490,6 +1578,15 @@ def bench_fleet_auto(args) -> dict:
                              and probe["open_loop_ok"]),
         "slo_p99_ms": shape["slo_p99_ms"],
         "budget_shed_ok": bool(budget_shed and in_budget_ok),
+        # phase D verdicts (pva-tpu-hbm): burn-rate alert discipline —
+        # the seeded breach fired once and cleared, zero calm-phase or
+        # flap fires — and the measured-byte admission flip
+        "alert_false_positives": int(alert_false_positives),
+        "alert_fired_once": bool(alert_fired_once),
+        "alert_cleared": bool(alert_cleared),
+        "budget_lies_refused": budget_lies_refused,
+        "budget_liar_drift": liar_drift,
+        **hbm,
         "canary_regressions": sorted(verdict.get("regressions", [])),
         "canary_strikes": verdict.get("strikes"),
         "canary_blue_restored": bool(restored),
@@ -1823,9 +1920,17 @@ def bench_stream(args) -> dict:
     from pytorchvideo_accelerate_tpu.data.decode import decode_span
     from pytorchvideo_accelerate_tpu.fleet import Scheduler, StreamLoadGen
     from pytorchvideo_accelerate_tpu.models import create_model
+    from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
     from pytorchvideo_accelerate_tpu.serving.engine import InferenceEngine
     from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
     from pytorchvideo_accelerate_tpu.streaming import StreamingEngine
+
+    # arm the memory ledger BEFORE the engines are built: weight pins,
+    # compiled-bucket caches, and session ring pools register as they
+    # allocate, so the lane's hbm_* keys attribute real lane bytes (and
+    # SessionTable admission consumes measured bytes where the backend
+    # exposes memory_stats — the declared estimate elsewhere)
+    obs_memory.configure()
 
     shape = STREAM_SMOKE if args.smoke else STREAM_FULL
     T, S = shape["window"], shape["stride"]
@@ -2174,6 +2279,10 @@ def bench_stream(args) -> dict:
             "trunk_eval_clips": int(n_eval),
             "label_ms_trunk_full": round(med_tf, 3),
             "label_ms_trunk_kv": round(med_tk, 3),
+            # memory-ledger triple: the streaming ring pools + engine
+            # weight pins registered above make this lane's attribution
+            # meaningful on any host (estimate-labeled off device)
+            **hbm_headline(),
             "stream_sessions": n_sess,
             "window": T,
             "stride": S,
@@ -2662,6 +2771,15 @@ def main():
                 # back or quarantines is a guard false positive
                 if tr.get(key) is not None:
                     extras[key] = int(tr[key])
+            # memory-ledger triple (pva-tpu-hbm): the trainer lane is the
+            # flagship device process, so ITS ledger read headlines; the
+            # provenance label always rides with the bytes — an
+            # "estimate" peak is a CPU-host attribution sum, never a
+            # device claim (perfdiff refuses suspect rounds wholesale)
+            for key in ("hbm_peak_bytes", "hbm_attributed_frac",
+                        "hbm_source"):
+                if tr.get(key) is not None:
+                    extras[key] = tr[key]
             raw = (results.get("slowfast_r50") or {}).get(
                 "clips_per_sec_per_chip")
             # only a same-mode comparison is meaningful
@@ -2886,7 +3004,11 @@ def main():
                         "canary_rollback", "fleet_models_served"):
                 if fa.get(key) is not None:
                     extras[key] = fa[key]
-        for key in ("canary_promoted", "fleet_session_failures"):
+        for key in ("canary_promoted", "fleet_session_failures",
+                    # pva-tpu-hbm verdicts ride regardless too: a refused
+                    # round must still say whether the burn-rate rule
+                    # flapped and whether measured admission held
+                    "alert_false_positives", "budget_lies_refused"):
             if fa.get(key) is not None:
                 extras[key] = fa[key]
         flush_partial()
@@ -3020,6 +3142,20 @@ def main():
             assert extras[key] == 0, (
                 f"guard reported {key}={extras[key]} on a clean smoke "
                 "run (false positive; see docs/RELIABILITY.md)")
+        # memory-ledger contract (pva-tpu-hbm, docs/OBSERVABILITY.md §
+        # memory ledger): the hbm triple must come out of the trainer
+        # lane, and on the forced-host smoke child (CPU pinned, no
+        # backend memory_stats) the source MUST read "estimate" — a
+        # "measured" label here would mean the ledger fabricated device
+        # bytes, the exact lie the ledger exists to prevent
+        for key in ("hbm_peak_bytes", "hbm_attributed_frac", "hbm_source"):
+            assert extras.get(key) is not None, (
+                f"trainer smoke ran but produced no {key!r}: "
+                f"{extras.get('trainer_error') or sorted(extras)}")
+        assert extras["hbm_source"] == "estimate", (
+            f"CPU smoke host reported hbm_source="
+            f"{extras['hbm_source']!r} — estimate-only hosts must never "
+            "claim measured device bytes")
     if user_smoke:
         # dynamic-sanitizer contract, the third leg alongside lint-clean
         # and train_recompiles == 0: the bundled pva-tpu-tsan stress pass
@@ -3186,6 +3322,21 @@ def main():
         assert fa.get("budget_shed_ok") is True, (
             "over-budget family did not shed (or the in-budget family "
             f"stopped serving): {fa}")
+        # pva-tpu-hbm acceptance (docs/OBSERVABILITY.md § burn-rate
+        # alerts): the seeded SLO breach fired its multi-window rule
+        # EXACTLY once and cleared on recovery — zero calm-phase fires,
+        # zero flap re-fires — and the budget-lies probe proved the
+        # admission flip: the under-declaring family the declared
+        # estimate admitted is refused where the ledger measures it
+        assert extras.get("alert_false_positives") == 0, (
+            f"burn-rate rule fired outside the seeded breach: {fa}")
+        assert fa.get("alert_fired_once") is True, (
+            f"seeded SLO breach did not fire exactly one alert: {fa}")
+        assert fa.get("alert_cleared") is True, (
+            f"burn-rate alert did not clear on recovery: {fa}")
+        assert extras.get("budget_lies_refused") is True, (
+            "measured-byte admission did not refuse the under-declaring "
+            f"family the declared estimate admitted: {fa}")
     if user_smoke and args.stream:
         # STREAM acceptance (docs/SERVING.md § streaming): incremental
         # advance logits matched the full-clip recompute every measured
@@ -3242,6 +3393,19 @@ def main():
         assert extras.get("stream_trunk_speedup", 0.0) >= 2.0, (
             "KV-ring trunk advance is not >=2x cheaper per label "
             f"(decode-inclusive): {st}")
+        # memory-ledger contract (pva-tpu-hbm): the streaming ring pools
+        # + weight pins registered with the armed ledger, so the lane's
+        # record must carry a non-trivial attribution with the honest
+        # provenance label (estimate on the CPU-pinned smoke child)
+        assert st.get("hbm_attributed_frac") is not None, (
+            f"stream smoke ran but produced no hbm_attributed_frac: {st}")
+        assert st.get("hbm_source") == "estimate", (
+            f"CPU smoke stream lane reported hbm_source="
+            f"{st.get('hbm_source')!r} — estimate-only hosts must never "
+            "claim measured device bytes")
+        assert st.get("hbm_peak_bytes", 0) > 0, (
+            "stream lane attributed zero peak bytes with ring pools and "
+            f"weight pins armed — ledger registration fell out: {st}")
     if user_smoke and args.dataplane:
         # DATA_PLANE acceptance (docs/INPUT_PIPELINE.md § disaggregated
         # data plane): N>=2 remote decode workers produced a byte-
@@ -3441,6 +3605,12 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                 "stream_parity", "stream_recompiles",
                 "stream_trunk_parity", "stream_trunk_recompiles",
                 "canary_promoted", "fleet_session_failures",
+                # pva-tpu-hbm: the ledger triple (trainer lane) + the
+                # burn-rate/admission verdicts (fleet_auto lane) —
+                # hbm_source is the provenance label that keeps an
+                # "estimate" peak from ever reading as a device claim
+                "hbm_peak_bytes", "hbm_attributed_frac", "hbm_source",
+                "alert_false_positives", "budget_lies_refused",
                 *mc_perf, *fleet_perf, *fleet_auto_perf, *dataplane_perf,
                 *pipeline_perf, *stream_perf):
         if key in extras and not (
@@ -3541,7 +3711,8 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               # (convergence is this arc's acceptance metric, so it goes
               # last of the group); verdicts shed before perf keys
               "fleet_auto_error", "canary_promoted",
-              "fleet_session_failures", "fleet_models_served",
+              "fleet_session_failures", "budget_lies_refused",
+              "alert_false_positives", "fleet_models_served",
               "fleet_scaledown_shed_frac", "canary_rollback",
               "autoscale_converge_s",
               # the STREAM lane sheds after the fleet group but before
@@ -3568,6 +3739,10 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               "obs_input_wait_frac",
               "obs_step_s", "trainer_error", "trainer_input_wait_frac",
               "trainer_mfu", "trainer_cps_chip",
+              # the hbm triple sheds late (this arc's headline) and as a
+              # unit-in-reverse: the source label must outlive the bytes
+              # it qualifies, so the bytes drop first
+              "hbm_attributed_frac", "hbm_peak_bytes", "hbm_source",
               "trainer_vs_rawstep", "detail", "step_ms_blocked",
               "tflops_per_sec"):  # drop one by one until it fits
         if len(json.dumps(out)) <= MAX_LINE_BYTES:

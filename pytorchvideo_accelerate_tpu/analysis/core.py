@@ -171,6 +171,9 @@ def default_rules() -> List[Rule]:
     bench smoke gate, and the doctor all lint with identical rules)."""
     from pytorchvideo_accelerate_tpu.analysis.rules_dtype import DtypeLiteralRule
     from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HostSyncRule
+    from pytorchvideo_accelerate_tpu.analysis.rules_ledger import (
+        LedgerDisciplineRule,
+    )
     from pytorchvideo_accelerate_tpu.analysis.rules_lock import LockDisciplineRule
     from pytorchvideo_accelerate_tpu.analysis.rules_mesh import MeshDisciplineRule
     from pytorchvideo_accelerate_tpu.analysis.rules_recompile import RecompileHazardRule
@@ -187,7 +190,7 @@ def default_rules() -> List[Rule]:
     return [HostSyncRule(), RecompileHazardRule(), LockDisciplineRule(),
             TracerLeakRule(), SpanDisciplineRule(), ThreadFactoryRule(),
             ThreadJoinRule(), MeshDisciplineRule(), TracePropagationRule(),
-            DtypeLiteralRule()]
+            DtypeLiteralRule(), LedgerDisciplineRule()]
 
 
 def parse_module(source: str, path: str) -> ModuleInfo:

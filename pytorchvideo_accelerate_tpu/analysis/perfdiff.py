@@ -98,6 +98,10 @@ LOWER_BETTER = (
     "autoscale_converge_s",
     "fleet_scaledown_shed_frac",
     "canary_rollback",
+    # pva-tpu-hbm: device high-water mark from the memory ledger (backend
+    # peak_bytes_in_use where measured, peak attributed bytes elsewhere);
+    # null -> number is the metric APPEARING on the first measured round
+    "hbm_peak_bytes",
 )
 
 

@@ -1,0 +1,160 @@
+"""Rule `ledger-discipline`: device-resident allocations must be on the
+memory ledger.
+
+The MemoryLedger (obs/memory.py) is only as truthful as its coverage:
+one pool allocated off-ledger and `unattributed_bytes` silently absorbs
+it, which is exactly the accounting rot the residual exists to expose.
+This rule patrols the registered HOT modules — the streaming ring pools,
+the serving weight pins / compiled-bucket caches, the trainer's sharded
+state — and flags any function scope that performs a device-resident
+allocation (`jnp.zeros`/`empty`/`full`, `jax.device_put`,
+`shard_params`/`shard_state`, however aliased) without a
+`memory.register(...)` call in the same scope.
+
+Scope-granular on purpose: the ledger call does not have to wrap the
+allocation (pools are often assembled across several statements), it has
+to live in the same function so the accounting cannot drift to another
+file. Transient allocations (warmup dummies, restore paths) carry the
+house suppression with a reason:
+`# pva: disable=ledger-discipline -- reason`.
+
+Alias-proof like `thread-factory`: `import jax.numpy as anything`,
+`from jax import device_put as dp`, `from ...obs import memory as m`,
+and `from ...obs.memory import register as r` all resolve. A dotted
+`<x>.register(...)` where `<x>`'s last segment is a memory-module alias
+or mentions "ledger" (an injected `self._ledger`) also satisfies the
+rule.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, Iterable, List, Set, Tuple
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+    walk_with_qualname,
+)
+
+_PKG_MARKER = "pytorchvideo_accelerate_tpu/"
+
+# the modules holding the documented ledger components (ISSUE 18 /
+# docs/OBSERVABILITY.md § memory ledger); new device-pool owners join
+# this list when they grow pools
+_HOT_MODULES = (
+    "pytorchvideo_accelerate_tpu/streaming/engine.py",
+    "pytorchvideo_accelerate_tpu/serving/engine.py",
+    "pytorchvideo_accelerate_tpu/trainer/loop.py",
+)
+
+# call tails that materialize device-resident bytes
+_ALLOC_TAILS = ("zeros", "empty", "full", "device_put",
+                "shard_params", "shard_state")
+# tails that need a jax/jnp head to count (a stray numpy.zeros or a
+# local `zeros` helper is host memory, not HBM)
+_NUMERIC_TAILS = ("zeros", "empty", "full")
+
+
+def _jax_module_aliases(tree: ast.AST) -> Set[str]:
+    """Every local name bound to jax or jax.numpy ("jax", "jnp", ...)."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name in ("jax", "jax.numpy"):
+                    out.add(alias.asname or alias.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for alias in node.names:
+                    if alias.name == "numpy":
+                        out.add(alias.asname or "numpy")
+    return out
+
+
+def _alloc_fn_aliases(tree: ast.AST) -> Set[str]:
+    """Bare names that ARE allocators: `from jax import device_put [as d]`
+    and the sharding helpers from-imported from trainer.sharding."""
+    out: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ImportFrom) or not node.module:
+            continue
+        for alias in node.names:
+            if node.module == "jax" and alias.name == "device_put":
+                out.add(alias.asname or alias.name)
+            if alias.name in ("shard_params", "shard_state"):
+                out.add(alias.asname or alias.name)
+    return out
+
+
+def _memory_aliases(tree: ast.AST) -> Tuple[Set[str], Set[str]]:
+    """(module aliases of obs.memory, bare aliases of its register())."""
+    mods: Set[str] = set()
+    fns: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                if alias.name.endswith("obs.memory"):
+                    mods.add(alias.asname or "memory")
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            if node.module.endswith("obs.memory") or node.module == "memory":
+                for alias in node.names:
+                    if alias.name == "register":
+                        fns.add(alias.asname or alias.name)
+            if node.module.endswith("obs") or node.module == "obs":
+                for alias in node.names:
+                    if alias.name == "memory":
+                        mods.add(alias.asname or alias.name)
+    return mods, fns
+
+
+class LedgerDisciplineRule(Rule):
+    name = "ledger-discipline"
+    description = ("device-resident allocation in a ledger hot module "
+                   "(streaming/serving/trainer) with no MemoryLedger "
+                   "register() in the same scope — the residual would "
+                   "silently absorb the bytes")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if _PKG_MARKER not in module.posix_path \
+                or not module.matches(_HOT_MODULES):
+            return
+        jax_mods = _jax_module_aliases(module.tree)
+        alloc_fns = _alloc_fn_aliases(module.tree)
+        mem_mods, mem_fns = _memory_aliases(module.tree)
+        allocs: Dict[str, List[Tuple[ast.Call, str]]] = {}
+        registered: Set[str] = set()
+        for node, scope in walk_with_qualname(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            dn = call_name(node)
+            tail = dn.rsplit(".", 1)[-1]
+            head = dn.rsplit(".", 1)[0] if "." in dn else ""
+            head_last = head.rsplit(".", 1)[-1]
+            if tail == "register" and (
+                    dn in mem_fns
+                    or head_last in mem_mods
+                    or "ledger" in head.lower()):
+                registered.add(scope)
+                continue
+            is_alloc = (
+                dn in alloc_fns
+                or ("." in dn and tail in _ALLOC_TAILS
+                    and head_last in jax_mods
+                    and (tail not in _NUMERIC_TAILS or head_last != "jax"))
+                or ("." not in dn and dn in ("shard_params", "shard_state")))
+            if is_alloc:
+                allocs.setdefault(scope, []).append((node, dn))
+        for scope, calls in allocs.items():
+            if scope in registered:
+                continue
+            for node, dn in calls:
+                yield self.finding(
+                    module, node,
+                    f"`{dn}(...)` allocates device-resident bytes in "
+                    f"scope `{scope or '<module>'}` with no "
+                    "`obs.memory.register(...)` in the same scope — "
+                    "register the bytes (or suppress with a reason if "
+                    "the allocation is transient)")

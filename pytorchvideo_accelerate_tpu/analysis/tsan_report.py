@@ -579,6 +579,56 @@ def _stress_autoscale(log: Callable[[str], None]) -> None:
         f"({spawn_n['n']} spawned)")
 
 
+def _stress_hbmobs(log: Callable[[str], None]) -> None:
+    """pva-tpu-hbm churn (obs/memory.py, obs/history.py, obs/alerts.py):
+    MemoryLedger register/release from two threads — the ring lease/evict
+    shape — racing an AlertEngine ticking scrape ticks into the shared
+    MetricsHistory ring plus a forced alert flap (burn past the objective,
+    then the hysteresis clear), with snapshot readers interleaved. The
+    registered MemoryLedger/MetricsHistory/AlertEngine @shared_state
+    fields under real interleavings."""
+    from pytorchvideo_accelerate_tpu.obs.alerts import AlertEngine, AlertRule
+    from pytorchvideo_accelerate_tpu.obs.history import MetricsHistory
+    from pytorchvideo_accelerate_tpu.obs.memory import MemoryLedger
+    from pytorchvideo_accelerate_tpu.obs.registry import Registry
+
+    reg = Registry()
+    led = MemoryLedger(registry=reg, stats_fn=lambda: {
+        "bytes_in_use": 1 << 20, "peak_bytes_in_use": 1 << 20,
+        "bytes_limit": 1 << 30})
+    load = reg.gauge("pva_stress_load", "synthetic burn driver")
+    hist = MetricsHistory(registry=reg, capacity=32)
+    eng = AlertEngine(hist, [AlertRule(
+        name="flap", kind="gauge", key="pva_stress_load", objective=1.0,
+        fast_s=0.5, slow_s=1.0, hold_clear=1)], registry=reg)
+
+    def churn(k: int):
+        for i in range(60):
+            led.register(f"ring:{k}", 4096, declared=4000)
+            if i % 3 == 0:
+                led.snapshot()
+            led.release(f"ring:{k}", 4096, declared=4000)
+
+    def ticker():
+        for i in range(30):
+            # burn for the middle third, calm either side: one full
+            # fire -> hold -> clear excursion under churn
+            load.set(5.0 if 10 <= i < 20 else 0.0)
+            eng.tick()
+            eng.snapshot()
+
+    ts = [make_thread(target=churn, args=(k,), name=f"hbm-churn-{k}",
+                      daemon=True) for k in range(2)]
+    ts.append(make_thread(target=ticker, name="hbm-ticker", daemon=True))
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    log(f"[tsan] hbm obs churn: ledger at {led.attributed_bytes()} B "
+        f"attributed, {hist.total_ticks()} history ticks, "
+        f"{eng.fires('flap')} flap fire(s)")
+
+
 def _stress_trackers(log: Callable[[str], None]) -> None:
     """TrackerHub fan-out from two threads with a tracker that raises: the
     disable-on-failure path mutates the tracker list under traffic."""
@@ -703,6 +753,7 @@ def run_stress(smoke: bool = True,
                     _stress_fleet(log)
                     _stress_stream(log)
                     _stress_autoscale(log)
+                    _stress_hbmobs(log)
                     _stress_trackers(log)
                     _stress_prefetcher(wd, log)
                     _stress_dataplane(log)

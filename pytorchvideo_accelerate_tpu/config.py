@@ -442,6 +442,20 @@ class ObsConfig:
     # JSON (<output_dir>/trace_ring.json; merge N of them with
     # `pva-tpu-trace`)
     trace_ring_events: int = 4096
+    # pva-tpu-hbm (obs/memory.py): arm the device-memory ledger — real
+    # allocation sites register bytes, cross-checked against the
+    # backend's memory_stats() where available (docs/OBSERVABILITY.md
+    # § memory ledger). Off = one global read at each site.
+    memory_ledger: bool = True
+    # on-demand profiler window, run-relative: "A..B" captures
+    # jax.profiler from this run's step A until step B, written
+    # atomically under <output_dir>/profile_<tag>/ (obs/profiler.py).
+    # "" = disarmed.
+    profile_steps: str = ""
+    # metrics-history ring over Registry.scrape() ticks (obs/history.py):
+    # the substrate for burn-rate alerting, /history, and the
+    # autoscaler's shared EWMAs. 0 disables.
+    history_ticks: int = 512
 
 
 @dataclass

@@ -50,6 +50,7 @@ import time
 from typing import Any, Iterator, Optional
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
 from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.data.pipeline import ClipLoader
 from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch
@@ -107,6 +108,11 @@ class DevicePrefetcher:
         self._lock = make_lock("DevicePrefetcher._lock")
         self._resident = 0  # placed-but-unconsumed device batches
         self.max_resident = 0  # high-water mark (tests; monotonic per run)
+        # pva-tpu-hbm: ledger component for the ring's HBM residency —
+        # MEASURED placed-batch bytes (register on enqueue, release on
+        # consumption/drain), never a depth×estimate. wait_name keys the
+        # component so train/val prefetchers account separately.
+        self._mem_component = f"prefetch_ring:{self.wait_name}"
 
     # --- observability ----------------------------------------------------
 
@@ -162,6 +168,10 @@ class DevicePrefetcher:
                     with self._lock:
                         self._resident -= 1
                     slots.release()
+                    # ownership transfers to the step loop: the ring's
+                    # residency accounting drops the batch here
+                    obs_memory.release(self._mem_component,
+                                       obs_memory.tree_nbytes(payload))
                     self.loader.state = state
                     yield payload
                 elif kind == "state":  # epoch rollover marker
@@ -181,6 +191,9 @@ class DevicePrefetcher:
                     break
             with self._lock:
                 self._resident = 0
+            # drained batches free on the floor above; zero the component so
+            # a worker that out-raced the drain can't leave phantom bytes
+            obs_memory.release(self._mem_component)
 
     def _epoch_sync(self, epoch: Optional[int],
                     from_start: bool) -> Iterator[Any]:
@@ -241,7 +254,12 @@ class DevicePrefetcher:
                         self._resident += 1
                         self.max_resident = max(self.max_resident,
                                                 self._resident)
-                    q.put(("batch", self._place(batch), state))
+                    placed = self._place(batch)
+                    # ledger: measured bytes of the batch actually resident
+                    # in the ring (released when the consumer takes it)
+                    obs_memory.register(self._mem_component,
+                                        obs_memory.tree_nbytes(placed))
+                    q.put(("batch", placed, state))
         except BaseException as e:  # noqa: BLE001 - must cross the thread
             q.put(("error", e, None))
         else:

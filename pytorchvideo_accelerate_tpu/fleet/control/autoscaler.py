@@ -39,6 +39,7 @@ FLEET_AUTO bench lane asserts on — and in the obs flight recorder.
 
 from __future__ import annotations
 
+import math
 import time
 from typing import Callable, List, Optional
 
@@ -180,6 +181,20 @@ class Autoscaler:
             self._q_ewma = a * sig.queue_per_replica() + (1 - a) * self._q_ewma
             self._p99_ewma = a * sig.p99_ms + (1 - a) * self._p99_ewma
             q, p99 = self._q_ewma, self._p99_ewma
+        if getattr(self.reader, "history", None) is not None:
+            # pva-tpu-hbm: smooth off the SHARED history ring when the
+            # reader carries one — same time base the alert rules and
+            # /history serve, instead of this controller's private
+            # accumulators (which stay warm as the fallback). halflife is
+            # ewma_alpha expressed per control interval.
+            hl = (-self.interval_s * math.log(2.0) / math.log(1.0 - a)
+                  if a < 1.0 else 0.0)
+            q_h = self.reader.ewma("pva_fleet_queue_per_replica", hl)
+            p99_h = self.reader.ewma("pva_fleet_p99_ms", hl)
+            if q_h is not None:
+                q = q_h
+            if p99_h is not None:
+                p99 = p99_h
         action = self._reap_confirmed_dead(sig)
         if action is None:
             action = self._decide(sig, q, p99)

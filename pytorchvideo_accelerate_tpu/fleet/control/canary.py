@@ -57,12 +57,17 @@ class CanaryController:
 
     def __init__(self, router, *, fraction: float = 0.25,
                  threshold: float = 0.2, rollback_after: int = 2,
-                 prewarm: bool = True):
+                 prewarm: bool = True, fleet=None):
         if not 0.0 < fraction <= 1.0:
             raise ValueError(f"canary fraction must be in (0, 1], "
                              f"got {fraction}")
         self.router = router
         self.pool = router.pool
+        # optional MultiModelFleet: families then come from fleet.models()
+        # and each family's model_snapshot rides the verdict (replicas of
+        # a family carry stats minted by fleet.stats_for, so each side's
+        # windows pool on the family's own latency ladder)
+        self.fleet = fleet
         self.fraction = float(fraction)
         self.threshold = float(threshold)
         self.rollback_after = max(int(rollback_after), 1)
@@ -136,6 +141,20 @@ class CanaryController:
         return {k: float(snap.get(k, 0.0))
                 for k in CanaryController._DELTA_KEYS}
 
+    def _families(self, baseline_side) -> List[str]:
+        """Model families present across both sides: the attached fleet's
+        registration-stable enumeration when one was given, else the
+        replicas' own `model` labels (unlabeled replicas -> no families ->
+        pool-level comparison)."""
+        if self.fleet is not None:
+            return list(self.fleet.models())
+        seen: List[str] = []
+        for r in list(self._canaries) + list(baseline_side):
+            m = getattr(r, "model", None)
+            if m is not None and m not in seen:
+                seen.append(m)
+        return seen
+
     def _side_stats(self, replicas) -> Dict[str, float]:
         """Pooled post-rollout window + counter deltas for one side."""
         lat: List[float] = []
@@ -169,7 +188,16 @@ class CanaryController:
         """One observation window -> a ladder verdict. Returns the verdict
         dict; `action` is "observe" (clean or a first strike), "rollback"
         (the ladder fired and the fleet was restored), and
-        `rolled_back`/`strikes` carry the ladder state."""
+        `rolled_back`/`strikes` carry the ladder state.
+
+        Multi-model pools (pva-tpu-hbm, ROADMAP item 1): the comparison
+        runs PER FAMILY — each side's windows pool only within one
+        `replica.model` — because a pool-wide pooled window dilutes a
+        regression that lives in one family (and a traffic-mix shift
+        between a fast and a slow family reads as a phantom one). A
+        regression in ANY family strikes the ladder, tagged
+        ``<family>:<key>``. Single-family (or unlabeled) pools keep the
+        original pool-level comparison and verdict shape exactly."""
         with self._lock:
             if self.state != "canary":
                 raise RuntimeError(f"no canary in flight (state "
@@ -182,10 +210,41 @@ class CanaryController:
         # the cross-round perf gate's own direction-aware comparison:
         # baseline plays the "old" round, the canary the "new" one
         diff = diff_rounds(baseline, canary, threshold=self.threshold)
-        regressions = list(diff["regressions"])
-        if (canary["error_frac"] > baseline["error_frac"]
-                and canary["errors"] > 0):
-            regressions.append("canary_error_frac")
+        families = self._families(baseline_side)
+        per_family: Dict[str, dict] = {}
+        if len(families) > 1:
+            regressions = []
+            for family in families:
+                c_side = [r for r in self._canaries
+                          if getattr(r, "model", None) == family]
+                b_side = [r for r in baseline_side
+                          if getattr(r, "model", None) == family]
+                entry: dict = {"canaries": len(c_side),
+                               "baselines": len(b_side)}
+                if self.fleet is not None:
+                    entry["snapshot"] = self.fleet.model_snapshot(family)
+                if not c_side or not b_side:
+                    # an uncompared family is a recorded fact, never a
+                    # silent pass OR a phantom strike
+                    entry["skipped"] = ("no canary replicas" if not c_side
+                                        else "no baseline replicas")
+                    per_family[family] = entry
+                    continue
+                fc = self._side_stats(c_side)
+                fb = self._side_stats(b_side)
+                fdiff = diff_rounds(fb, fc, threshold=self.threshold)
+                fregs = list(fdiff["regressions"])
+                if fc["error_frac"] > fb["error_frac"] and fc["errors"] > 0:
+                    fregs.append("canary_error_frac")
+                entry.update(canary=fc, baseline=fb,
+                             regressions=sorted(fregs))
+                per_family[family] = entry
+                regressions.extend(f"{family}:{k}" for k in fregs)
+        else:
+            regressions = list(diff["regressions"])
+            if (canary["error_frac"] > baseline["error_frac"]
+                    and canary["errors"] > 0):
+                regressions.append("canary_error_frac")
         slowest: List[dict] = []
         for r in self._canaries:
             if getattr(r, "stats", None) is not None:
@@ -202,6 +261,8 @@ class CanaryController:
             # acquitted) the artifact, worst first
             "slowest_traces": slowest[:5],
         }
+        if per_family:
+            verdict["families"] = per_family
         if regressions:
             with self._lock:
                 self._strikes += 1

@@ -33,6 +33,7 @@ from __future__ import annotations
 from typing import Dict, List, Optional, Sequence
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
 from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
 from pytorchvideo_accelerate_tpu.serving.stats import ServingStats
 from pytorchvideo_accelerate_tpu.utils.logging import get_logger
@@ -43,7 +44,18 @@ logger = get_logger("pva_tpu")
 
 @shared_state("_footprints")
 class ModelBudget:
-    """Shared compiled-cache/HBM budget across model families (MB)."""
+    """Shared compiled-cache/HBM budget across model families (MB).
+
+    pva-tpu-hbm: on a device whose backend exposes `memory_stats()`, each
+    family's footprint is the MEASURED MemoryLedger bytes of its
+    ``model_weights:<model>`` + ``engine_compiled:<model>`` components —
+    the declared `footprint_mb` then only sets the priority slot and is
+    the documented CPU/test fallback. A family that under-declares
+    cannot lie its way under the budget where the ledger can see it.
+    """
+
+    # ledger components that make up one family's device footprint
+    _COMPONENTS = ("model_weights:{m}", "engine_compiled:{m}")
 
     def __init__(self, budget_mb: float):
         self.budget_mb = float(budget_mb)
@@ -60,9 +72,36 @@ class ModelBudget:
         with self._lock:
             self._footprints.pop(str(model), None)
 
+    def footprint_mb(self, model: str) -> float:
+        """One family's effective footprint: measured ledger bytes where
+        the device exposes them, the declared estimate elsewhere."""
+        with self._lock:
+            declared = self._footprints.get(str(model), 0.0)
+        led = obs_memory.get_ledger()
+        if led is not None:
+            measured = [led.measured_bytes(c.format(m=model))
+                        for c in self._COMPONENTS]
+            # nonzero: a zero-byte "measurement" means the family never
+            # registered an engine here — that's the declared fallback,
+            # not a free admission
+            if any(measured):
+                return sum(b or 0 for b in measured) / 1e6
+        return declared
+
+    def footprint_source(self, model: str) -> str:
+        """"measured" when `footprint_mb` reads the ledger, "declared"
+        otherwise (CPU hosts / disarmed ledger / unregistered family)."""
+        led = obs_memory.get_ledger()
+        if led is not None and any(
+                led.measured_bytes(c.format(m=model))
+                for c in self._COMPONENTS):
+            return "measured"
+        return "declared"
+
     def usage_mb(self) -> float:
         with self._lock:
-            return sum(self._footprints.values())
+            models = list(self._footprints)
+        return sum(self.footprint_mb(m) for m in models)
 
     def over_budget(self) -> List[str]:
         """Families whose admission must shed, lowest priority first.
@@ -72,11 +111,11 @@ class ModelBudget:
         every family would otherwise shed the whole pool — the exact
         failure mode this module exists to prevent)."""
         with self._lock:
-            items = list(self._footprints.items())
+            models = list(self._footprints)
         used = 0.0
         shed: List[str] = []
-        for i, (model, mb) in enumerate(items):
-            used += mb
+        for i, model in enumerate(models):
+            used += self.footprint_mb(model)
             if i > 0 and used > self.budget_mb:
                 shed.append(model)
         return shed
@@ -150,9 +189,7 @@ class MultiModelFleet:
         snap = self.router.fleet_snapshot(model=model)
         snap["budget_shed"] = self._c_budget_shed.value(
             pool=self.router.pool.name, model=str(model))
-        with self.budget._lock:
-            snap["footprint_mb"] = self.budget._footprints.get(
-                str(model), 0.0)
+        snap["footprint_mb"] = self.budget.footprint_mb(str(model))
         return snap
 
     def snapshot_labels(self) -> Dict[str, float]:

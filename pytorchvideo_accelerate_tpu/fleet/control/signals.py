@@ -47,15 +47,53 @@ class ControlSignals:
 
 
 class SignalReader:
-    """Reads `ControlSignals` off a router's registry + pooled windows."""
+    """Reads `ControlSignals` off a router's registry + pooled windows.
+
+    With a shared `MetricsHistory` attached (pva-tpu-hbm), each `read()`
+    also appends a scrape tick to the ring, and `ewma()` serves smoothed
+    series straight from that shared history — so the autoscaler, the
+    alert rules, and `/history` all argue from ONE retained time base
+    instead of per-consumer private smoothing state.
+    """
 
     # prefix of every router/pool series in the registry scrape
     _FLEET_PREFIX = "pva_fleet_"
 
-    def __init__(self, router, *, model: Optional[str] = None):
+    def __init__(self, router, *, model: Optional[str] = None,
+                 history=None):
         self.router = router
         self.model = model
         self._pool_label = router.pool.name
+        self.history = history  # obs.history.MetricsHistory or None
+        self._last: Optional[ControlSignals] = None
+        if history is not None:
+            # the two control-loop series exist nowhere else in the
+            # registry (p99 comes from pooled windows, queue-per-replica
+            # is a derived ratio): publish them as live gauges off the
+            # last read snapshot so the history ring retains them
+            pool = self._pool_label
+            router.registry.gauge(
+                "pva_fleet_queue_per_replica",
+                "router backlog normalized by routable capacity",
+                labelnames=("pool",),
+            ).set_function(
+                lambda: (self._last.queue_per_replica()
+                         if self._last is not None else 0.0), pool=pool)
+            router.registry.gauge(
+                "pva_fleet_p99_ms",
+                "pooled-window p99 latency as read by the control loop",
+                labelnames=("pool",),
+            ).set_function(
+                lambda: (self._last.p99_ms
+                         if self._last is not None else 0.0), pool=pool)
+
+    def ewma(self, name: str, halflife_s: float) -> Optional[float]:
+        """EWMA of a pool-labeled fleet series from the shared history
+        ring; None when no history is attached or the series is empty."""
+        if self.history is None:
+            return None
+        return self.history.ewma(
+            f'{name}{{pool="{self._pool_label}"}}', halflife_s)
 
     def _series(self, scrape: Dict[str, float], name: str,
                 **labels: str) -> float:
@@ -80,7 +118,7 @@ class SignalReader:
         # pooled-window percentiles: the one signal the registry cannot
         # carry (see module docstring); same snapshot call /stats serves
         snap = self.router.fleet_snapshot(model=model)
-        return ControlSignals(
+        sig = ControlSignals(
             t=time.monotonic(),
             routable=routable,
             members=float(snap.get("replicas_total",
@@ -92,3 +130,9 @@ class SignalReader:
             shed_total=shed,
             per_replica_outstanding=per_replica,
         )
+        self._last = sig
+        if self.history is not None:
+            # one scrape tick per control read: the shared ring's cadence
+            # IS the control cadence (the gauges above read self._last)
+            self.history.tick()
+        return sig

@@ -38,6 +38,19 @@ from pytorchvideo_accelerate_tpu.obs.watchdog import Watchdog  # noqa: F401
 # distributed tracing (obs/trace.py): `obs.trace.configure_tracing(...)`,
 # capture/attach handoff helpers, the per-process trace ring
 from pytorchvideo_accelerate_tpu.obs import trace  # noqa: F401
+# pva-tpu-hbm (PR 18): the device-memory ledger, the scrape-tick history
+# ring + burn-rate alert engine, and on-demand profiler capture — all
+# follow the sync.py arming discipline (disarmed = one global read)
+from pytorchvideo_accelerate_tpu.obs import alerts  # noqa: F401
+from pytorchvideo_accelerate_tpu.obs import history  # noqa: F401
+from pytorchvideo_accelerate_tpu.obs import memory  # noqa: F401
+from pytorchvideo_accelerate_tpu.obs import profiler  # noqa: F401
+from pytorchvideo_accelerate_tpu.obs.alerts import (  # noqa: F401
+    AlertEngine,
+    AlertRule,
+)
+from pytorchvideo_accelerate_tpu.obs.history import MetricsHistory  # noqa: F401,E501
+from pytorchvideo_accelerate_tpu.obs.memory import MemoryLedger  # noqa: F401
 
 # default wiring: completed spans feed the flight-recorder ring
 get_collector().recorder = get_recorder()

@@ -28,6 +28,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
 from pytorchvideo_accelerate_tpu.parallel.mesh import data_shard_count, make_mesh
 from pytorchvideo_accelerate_tpu.parallel.sharding import shard_batch, shard_params
 from pytorchvideo_accelerate_tpu.trainer.steps import (
@@ -49,6 +50,17 @@ CLIP_KEYS = ("video", "slow", "fast")
 # synchronous compile and permanent executable memory, so arbitrary client
 # shapes must hit a ceiling instead of growing the cache without limit
 MAX_COMPILED_KEYS = 64
+
+
+def _executable_bytes(compiled) -> int:
+    """Best-effort device footprint of an AOT-compiled executable (code
+    size via `memory_analysis()`; 0 when the backend reports none — the
+    CPU path, where the ledger stays estimate-only anyway)."""
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return 0
+    return int(getattr(ma, "generated_code_size_in_bytes", 0) or 0)
 
 
 def clip_key(clips: Dict[str, Any]) -> tuple:
@@ -145,6 +157,16 @@ class InferenceEngine:
         # dequantization happens per-forward inside the compiled graph
         self.params = shard_params(self.mesh, params)
         self.batch_stats = shard_params(self.mesh, batch_stats or {})
+        # MemoryLedger components: the pinned weight tree (keyed by model
+        # family so ModelBudget can read *measured* footprints) and the
+        # compiled-bucket executable cache. `release_memory()` is the
+        # retire hook — an engine dropped without it shows up as ledger
+        # drift, which is the point.
+        self._mem_component = f"model_weights:{model_name or 'engine'}"
+        self._compiled_component = f"engine_compiled:{model_name or 'engine'}"
+        self._weight_bytes = (obs_memory.tree_nbytes(self.params)
+                              + obs_memory.tree_nbytes(self.batch_stats))
+        obs_memory.register(self._mem_component, self._weight_bytes)
         self._fns: Dict[tuple, Callable] = {}
         self._lock = make_lock("InferenceEngine._lock")
         # set by from_artifact: the training run's resolved TrainConfig
@@ -266,6 +288,7 @@ class InferenceEngine:
                 f"batch size {n} is not a compiled bucket {self.buckets}; "
                 "pad to bucket_for(n) first")
         key = clip_key(clips)
+        placed = shard_batch(self.mesh, clips)
         fn = self._fns.get(key)
         if fn is None:
             with self._lock:
@@ -277,16 +300,28 @@ class InferenceEngine:
                             "distinct request geometries; refusing a new "
                             "one (clients should send the serving "
                             "geometry, see /healthz)")
-                    # one jit object per key: the cache maps every
-                    # (bucket, views, geometry) the service has seen to its
-                    # own compiled executable, and membership is the
-                    # "already compiled" signal for stats/warmup
+                    # one executable per key: the cache maps every
+                    # (bucket, views, geometry) the service has seen to
+                    # its own compiled executable, and membership is the
+                    # "already compiled" signal for stats/warmup. AOT
+                    # (lower -> compile) so the executable's device
+                    # footprint is ledgered at the allocation site; the
+                    # lazy jit object is the fallback when the backend
+                    # refuses AOT (its bytes then stay unattributed —
+                    # visible in the residual, never fabricated).
                     fn = jax.jit(self._make_forward())
+                    try:
+                        compiled = fn.lower(self.params, self.batch_stats,
+                                            placed).compile()
+                        obs_memory.register(self._compiled_component,
+                                            _executable_bytes(compiled))
+                        fn = compiled
+                    except Exception:
+                        pass
                     self._fns[key] = fn
                     if self.stats is not None:
                         self.stats.observe_compile()
                     logger.info("engine: compiling forward for %s", key)
-        placed = shard_batch(self.mesh, clips)
         return np.asarray(fn(self.params, self.batch_stats, placed))
 
     def warmup(self, sample_clip: Dict[str, np.ndarray]) -> None:
@@ -301,3 +336,11 @@ class InferenceEngine:
     @property
     def compiled_keys(self) -> tuple:
         return tuple(self._fns)
+
+    def release_memory(self) -> None:
+        """Return this engine's ledger components (pinned weights +
+        compiled-bucket executables) — the retire hook for hot-swap /
+        fleet teardown. An engine dropped without it leaves its bytes
+        attributed, which the drift/residual gauges surface."""
+        obs_memory.release(self._mem_component)
+        obs_memory.release(self._compiled_component)

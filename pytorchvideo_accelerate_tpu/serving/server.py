@@ -39,14 +39,20 @@ from __future__ import annotations
 
 import json
 import signal
+import threading
 import time
 from concurrent.futures import TimeoutError as FutureTimeout
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, Optional, Sequence
+from urllib.parse import parse_qs, urlparse
 
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import alerts as obs_alerts
+from pytorchvideo_accelerate_tpu.obs import history as obs_history
+from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
+from pytorchvideo_accelerate_tpu.obs import profiler as obs_profiler
 from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.serving.admission import (
     DRAINING,
@@ -137,6 +143,33 @@ class _Handler(BaseHTTPRequestHandler):
             self.send_header("Content-Length", str(len(body)))
             self.end_headers()
             self.wfile.write(body)
+        elif self.path.split("?", 1)[0] == "/history":
+            # pva-tpu-hbm: the scrape-tick ring as JSON series. Optional
+            # query: ?window_s=SECONDS trims the trailing window, ?keys=a,b
+            # restricts to named flat scrape keys. 503 while the history
+            # ring is disarmed (obs.enabled=false / history_ticks=0) — a
+            # scraper must be able to tell "off" from "empty".
+            hist = obs_history.get_history()
+            if hist is None:
+                self._reply(503, {"error": "metrics history disarmed "
+                                           "(obs.history_ticks=0?)"})
+                return
+            try:
+                q = parse_qs(urlparse(self.path).query)
+                window_s = (float(q["window_s"][0])
+                            if "window_s" in q else None)
+                keys = (sorted({k for tok in q["keys"]
+                                for k in tok.split(",") if k})
+                        if "keys" in q else None)
+            except (ValueError, TypeError) as e:
+                self._reply(400, {"error": f"bad query: {e}"})
+                return
+            payload = hist.to_json(keys=keys, window_s=window_s)
+            engine = obs_alerts.get_engine()
+            if engine is not None:
+                payload["alerts"] = engine.snapshot()["rules"]
+                payload["alerts_active"] = engine.active()
+            self._reply(200, payload)
         else:
             self._reply(404, {"error": f"no route {self.path}"})
 
@@ -161,6 +194,9 @@ class _Handler(BaseHTTPRequestHandler):
             self._reply(200, {"draining": True,
                               "status": srv.admission.state(),
                               "queue_depth": srv.batcher.queue_depth()})
+            return
+        if self.path.split("?", 1)[0] == "/profile":
+            self._do_profile(srv)
             return
         if self.path != "/predict":
             self._reply(404, {"error": f"no route {self.path}"})
@@ -273,6 +309,40 @@ class _Handler(BaseHTTPRequestHandler):
                 "top1": int(np.argmax(logits)),
                 "latency_ms": round((time.monotonic() - t0) * 1e3, 3),
             }, headers=echo)
+
+    def _do_profile(self, srv: "InferenceServer") -> None:
+        """POST /profile?seconds=S — pva-tpu-hbm on-demand capture: start a
+        jax.profiler trace window on the LIVE server, stopped by a
+        background timer and published atomically as
+        <output_dir>/profile_<tag>/ (obs/profiler.py). 202 with the
+        pending tag when the capture starts, 409 while one is already in
+        flight (one capture at a time — traces are expensive), 503 when
+        the profiler is disarmed."""
+        length = int(self.headers.get("Content-Length", 0))
+        if length:  # keep the keep-alive stream clean
+            self.rfile.read(length)
+        prof = obs_profiler.get_profiler()
+        if prof is None:
+            self._reply(503, {"error": "profiler disarmed (obs.enabled "
+                                       "off or no output_dir)"})
+            return
+        try:
+            q = parse_qs(urlparse(self.path).query)
+            seconds = float(q.get("seconds", ["3"])[0])
+            if not 0 < seconds <= 120:
+                raise ValueError("seconds must be in (0, 120]")
+        except (ValueError, TypeError) as e:
+            self._reply(400, {"error": f"bad query: {e}"})
+            return
+        tag = prof.capture_for(seconds)
+        if tag is None:
+            self._reply(409, {"error": "a profile capture is already "
+                                       "running", "busy": True})
+            return
+        obs.get_recorder().record("profile", "capture-requested",
+                                  seconds=seconds, tag=tag)
+        self._reply(202, {"capturing": True, "seconds": seconds,
+                          "tag": tag})
 
     def _do_stream(self, srv: "InferenceServer") -> None:
         """POST /stream — one incremental session advance (docs/SERVING.md
@@ -422,6 +492,34 @@ class InferenceServer:
         self.httpd.daemon_threads = True
         self.httpd.owner = self  # handler back-reference
         self._thread = None
+        # pva-tpu-hbm: the burn-rate alert engine needs a control cadence;
+        # a ticker thread starts with the server when one is armed (each
+        # tick also appends a scrape to the /history ring). 0 disables.
+        self.alert_tick_s = 1.0
+        self._tick_stop: Optional[threading.Event] = None
+        self._tick_thread = None
+
+    def _start_alert_ticker(self) -> None:
+        engine = obs_alerts.get_engine()
+        if engine is None or self.alert_tick_s <= 0 \
+                or self._tick_thread is not None:
+            return
+        from pytorchvideo_accelerate_tpu.utils.sync import make_thread
+
+        stop = threading.Event()
+
+        def _loop():
+            while not stop.wait(self.alert_tick_s):
+                try:
+                    engine.tick()
+                except Exception:  # noqa: BLE001 - ticker must survive
+                    logger.exception("alert tick failed")
+
+        self._tick_stop = stop
+        self._tick_thread = make_thread(target=_loop,
+                                        name="pva-serve-alerts",
+                                        daemon=True)
+        self._tick_thread.start()
 
     @property
     def address(self) -> tuple:
@@ -457,6 +555,7 @@ class InferenceServer:
             target=self.httpd.serve_forever, name="pva-serve-http",
             daemon=True)
         self._thread.start()
+        self._start_alert_ticker()
         return self
 
     def drain(self, grace_s: Optional[float] = None) -> None:
@@ -497,6 +596,7 @@ class InferenceServer:
         """Serve on the calling thread (the CLI path)."""
         if drain_on_sigterm and self.drain_grace_s > 0:
             self._install_drain_handler()
+        self._start_alert_ticker()
         try:
             self.httpd.serve_forever()
         except KeyboardInterrupt:
@@ -510,6 +610,11 @@ class InferenceServer:
         if getattr(self, "_closed", False):
             return
         self._closed = True
+        if self._tick_stop is not None:
+            self._tick_stop.set()
+            if self._tick_thread is not None:
+                self._tick_thread.join(timeout=5.0)
+            self._tick_thread = self._tick_stop = None
         self.httpd.shutdown()
         self.httpd.server_close()
         if self._thread is not None:
@@ -559,6 +664,13 @@ def build_server(cfg) -> InferenceServer:
                 output_dir=cfg.checkpoint.output_dir,
                 recorder=obs.get_recorder(),
                 collector=obs.get_collector()).start()
+    # pva-tpu-hbm: on-demand profiler capture for the serving process
+    # (POST /profile); ledger + history/alerts arm just after stats is
+    # built below — their gauges belong in the ServingStats registry so
+    # /metrics and /history carry them (it is per-instance by design).
+    if cfg.obs.enabled:
+        obs_profiler.configure(output_dir=cfg.checkpoint.output_dir,
+                               recorder=obs.get_recorder())
     latency_buckets = None
     if s.latency_buckets_ms:
         try:
@@ -571,6 +683,19 @@ def build_server(cfg) -> InferenceServer:
                 "'5,10,25,50,100,250,1000'")
     stats = ServingStats(window=s.stats_window,
                          latency_buckets=latency_buckets)
+    if cfg.obs.enabled and cfg.obs.memory_ledger:
+        # the engines' weight pins / compiled caches / session rings
+        # register through the module hooks into this singleton; its
+        # pva_hbm_* gauges ride the serving /metrics + /history
+        obs_memory.configure(recorder=obs.get_recorder(),
+                             registry=stats.registry)
+    if cfg.obs.enabled and cfg.obs.history_ticks > 0:
+        # burn-rate SLO rules over the serving series (obs/alerts.py
+        # default_rules); the server's ticker thread drives the cadence
+        hist = obs_history.configure(capacity=cfg.obs.history_ticks,
+                                     registry=stats.registry)
+        obs_alerts.configure(history=hist, registry=stats.registry,
+                             recorder=obs.get_recorder())
     engine = InferenceEngine.from_artifact(
         s.checkpoint, max_batch_size=s.max_batch_size, stats=stats,
         quantization=s.quantization if s.quantization != "off" else None)

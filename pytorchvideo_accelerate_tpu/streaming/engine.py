@@ -89,6 +89,7 @@ from typing import Any, Dict, List, Optional
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
 from pytorchvideo_accelerate_tpu.streaming.session import (
     SessionAdmissionError,
     SessionError,
@@ -145,7 +146,10 @@ class StreamingEngine:
         self._lock = make_lock("StreamingEngine._lock")
         # pool_key -> {ring name: device array, "cap": int, "bytes": int}
         self._pools: Dict[tuple, Dict[str, Any]] = {}
-        self._committed = 0  # ring-pool bytes allocated against the budget
+        self._committed = 0  # declared ring-pool bytes against the budget
+        # MemoryLedger component for this engine's ring pools
+        # (docs/OBSERVABILITY.md § memory ledger)
+        self._mem_component = f"stream_rings:{name}"
         self._fns: Dict[tuple, Any] = {}  # (op, kind, geom, stride, bucket)
         model = engine.model
         if isinstance(model, VideoMAEClassifier):
@@ -406,12 +410,13 @@ class StreamingEngine:
             if pool is not None:
                 return pool
             ring = max(self.ring_bytes(geom), 1)
-            remaining = self.session_budget_bytes - self._committed
+            committed, src = self._budget_committed()
+            remaining = self.session_budget_bytes - committed
             cap = remaining // ring
             if cap < 1:
                 raise SessionAdmissionError(
                     f"session budget exhausted ({self.name}: "
-                    f"{self._committed / 1e6:.0f} MB committed of "
+                    f"{committed / 1e6:.0f} MB committed ({src}) of "
                     f"{self.session_budget_bytes / 1e6:.0f} MB; a "
                     f"{ring / 1e6:.1f} MB/session pool for {geom} does "
                     "not fit); retry later",
@@ -421,6 +426,16 @@ class StreamingEngine:
             pool = {"cap": int(cap), "bytes": int(cap + 1) * ring}
             for nm in self._ring_names:
                 pool[nm] = self._alloc_ring(nm, geom, int(cap) + 1)
+            # ledger the ACTUAL device bytes (padding/dtype promotion make
+            # them drift from the ring_bytes estimate — the drift gauge's
+            # whole point); admission above consumes the measured figure
+            # on hosts that measure
+            pool["measured_bytes"] = sum(
+                int(getattr(pool[nm], "nbytes", 0))
+                for nm in self._ring_names)
+            obs_memory.register(self._mem_component,
+                                pool["measured_bytes"],
+                                declared=pool["bytes"])
             self._pools[geom] = pool
             self._committed += pool["bytes"]
             self.table.register_pool(geom, int(cap))
@@ -431,11 +446,25 @@ class StreamingEngine:
                 self._committed / 1e6, self.session_budget_bytes / 1e6)
             return pool
 
+    def _budget_committed(self) -> tuple:
+        """(bytes, source) the admission math diffs against the budget:
+        *measured* ledger bytes on a host whose backend exposes
+        `memory_stats()`, the declared `ring_bytes` estimates otherwise
+        (the documented CPU/test fallback — estimates admit, but they
+        never impersonate device bytes)."""
+        led = obs_memory.get_ledger()
+        if led is not None:
+            measured = led.measured_bytes(self._mem_component)
+            if measured is not None:
+                return measured, "measured"
+        return self._committed, "declared"
+
     def _replicated(self, arr):
         import jax
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        return jax.device_put(arr, NamedSharding(self.mesh, P()))
+        return jax.device_put(  # pva: disable=ledger-discipline -- generic H2D helper; retained rings are ledgered by their owning scope (_pool_for registers the pool bytes), other callers move transient launch rows
+            arr, NamedSharding(self.mesh, P()))
 
     def _alloc_ring(self, name: str, geom: tuple, rows: int):
         t, h, w, c, dtype = geom
@@ -1311,6 +1340,11 @@ class StreamingEngine:
             pool = self._pools.pop(geom, None)
             if pool is not None:
                 self._committed -= pool["bytes"]
+        if pool is not None:
+            obs_memory.release(
+                self._mem_component,
+                pool.get("measured_bytes", pool["bytes"]),
+                declared=pool["bytes"])
         dropped = 0
         for s in self.table.sessions():
             if s.pool_key == geom and self.table.end(s.sid):
@@ -1531,11 +1565,24 @@ class StreamingEngine:
                 adopted[geom] = self._derive_rings(geom, pool)
             with self._lock:
                 for geom, mine in adopted.items():
+                    mine["measured_bytes"] = sum(
+                        int(getattr(mine[nm], "nbytes", 0))
+                        for nm in self._ring_names if nm in mine)
                     prior = self._pools.pop(geom, None)
                     if prior is not None:
                         self._committed -= prior["bytes"]
+                        obs_memory.release(
+                            self._mem_component,
+                            prior.get("measured_bytes", prior["bytes"]),
+                            declared=prior["bytes"])
                     self._pools[geom] = mine
                     self._committed += mine["bytes"]
+                    obs_memory.register(self._mem_component,
+                                        mine["measured_bytes"],
+                                        declared=mine["bytes"])
+        # the adopted raw rings (and blue's freed derived rings) now
+        # belong to THIS engine's ledger component; blue retires
+        obs_memory.release(blue._mem_component)
         logger.info("stream: carried %d session(s), %d pool(s) across "
                     "hot-swap", carried, len(blue_pools))
         return carried

@@ -18,6 +18,7 @@ import jax
 import numpy as np
 
 from pytorchvideo_accelerate_tpu import obs
+from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
 from pytorchvideo_accelerate_tpu.analysis.recompile_guard import RecompileGuard
 from pytorchvideo_accelerate_tpu.config import TrainConfig
 from pytorchvideo_accelerate_tpu.data.manifest import from_list, scan_directory
@@ -123,6 +124,21 @@ class Trainer:
         self.obs_on = cfg.obs.enabled
         obs.configure(enabled=cfg.obs.enabled,
                       capacity=cfg.obs.flight_recorder_events)
+        # pva-tpu-hbm: arm the device-memory ledger (allocation sites in the
+        # prefetch ring / engines / this file start accounting), the
+        # scrape-tick history ring, and — only when a window is requested —
+        # the on-demand profiler. All three are disarmed no-ops otherwise
+        # (one module-global read per hook, the sync.py discipline).
+        if self.obs_on and cfg.obs.memory_ledger:
+            obs.memory.configure(recorder=obs.get_recorder())
+        if self.obs_on and cfg.obs.history_ticks > 0:
+            obs.history.configure(capacity=cfg.obs.history_ticks)
+        # validate --obs.profile_steps at construction (a typo'd window must
+        # fail now, not 3 epochs in); run-relative step offsets A..B
+        self.profile_steps = obs.profiler.parse_steps(cfg.obs.profile_steps)
+        if self.profile_steps is not None:
+            obs.profiler.configure(output_dir=cfg.checkpoint.output_dir,
+                                   recorder=obs.get_recorder())
         self.watchdog: Optional[obs.Watchdog] = None
         if self.obs_on and cfg.obs.trace_sample_rate > 0:
             # distributed tracing (obs/trace.py): head-sample train steps;
@@ -254,6 +270,11 @@ class Trainer:
         # § divergence runbook): LKG ring + anomaly rollback + replay
         # bundles. None when disarmed — the step loop then does one
         # `is None` check (structural zero overhead).
+        # Deliberately NOT a MemoryLedger component: the LKG ring is an
+        # orbax checkpoint ring on DISK (<output_dir>/guard_lkg), and a
+        # rollback's transient restore buffer replaces the live TrainState
+        # already accounted above — registering either as HBM would fake
+        # device bytes (docs/OBSERVABILITY.md § memory ledger).
         self.train_guard: Optional[TrainGuard] = None
         if cfg.guard.enabled:
             self.train_guard = TrainGuard(
@@ -565,6 +586,12 @@ class Trainer:
         # (found by the pva_train_recompiles guard; parallel/sharding.py
         # shard_state)
         self.state = shard_state(self.mesh, self.state, tp=self._tp)
+        # pva-tpu-hbm ledger: the settled TrainState IS the trainer's
+        # standing device pin (params + optimizer moments + EMA +
+        # batch_stats) — measured leaf bytes, not an estimate. Pretrained
+        # loading below replaces values in the same tree, so the byte
+        # count registered here stays truthful.
+        obs_memory.register("train_state", obs_memory.tree_nbytes(self.state))
 
         if cfg.model.pretrained and not cfg.model.pretrained_path:
             # unlike the reference there is no runtime hub fetch (zero
@@ -1101,6 +1128,16 @@ class Trainer:
                             and gstep - run_start_step == 2):
                         jax.profiler.start_trace(cfg.profile_dir)
                         profiling = True
+                    # --obs.profile_steps A..B: run-relative capture window,
+                    # published atomically as <output_dir>/profile_<tag>/
+                    # (obs/profiler.py). Independent of cfg.profile above.
+                    if (self.profile_steps is not None
+                            and gstep - run_start_step
+                            == self.profile_steps[0]):
+                        prof = obs.profiler.get_profiler()
+                        if prof is not None and not prof.busy:
+                            prof.start(tag=f"steps_{self.profile_steps[0]}_"
+                                           f"{self.profile_steps[1]}")
                     # chaos hook: "delay" = a slow dispatch, "raise" = a
                     # failing one, "nan" = poison the dispatched batch
                     # (the numeric divergence the guard ladder recovers
@@ -1174,6 +1211,15 @@ class Trainer:
                         jax.profiler.stop_trace()
                         profiling = False
                         main_print(f"profile trace written to {cfg.profile_dir}")
+                    if (self.profile_steps is not None
+                            and gstep - run_start_step
+                            >= self.profile_steps[1]):
+                        prof = obs.profiler.get_profiler()
+                        if prof is not None and prof.busy:
+                            out = prof.stop()
+                            if out:
+                                main_print(
+                                    f"profile window written to {out}")
 
                     if use_tqdm:
                         progress.update(1)
@@ -1197,6 +1243,12 @@ class Trainer:
                                     window_wall=now - window_t0)
                         window_t0 = now
                         recompile_guard.sample()  # refresh the gauge
+                        # append a scrape tick to the bounded history ring
+                        # (obs/history.py) — the ledger's live gauges land
+                        # in the same tick, so hbm series accrue for free
+                        hist = obs.history.get_history()
+                        if hist is not None:
+                            hist.tick()
                     if (isinstance(self.checkpointing_steps, int)
                             and gstep % self.checkpointing_steps == 0):
                         self._save("step", epoch)
@@ -1426,6 +1478,12 @@ class Trainer:
             if profiling:
                 jax.profiler.stop_trace()
                 main_print(f"profile trace written to {cfg.profile_dir}")
+            # likewise an unfinished --obs.profile_steps window: stop() still
+            # publishes atomically (partial trace beats no trace on a crash)
+            if self.profile_steps is not None:
+                prof = obs.profiler.get_profiler()
+                if prof is not None and prof.busy:
+                    prof.stop()
             # the distributed-trace ring lands next to the flight record
             # (<output_dir>/trace_ring.json) on clean exit AND on a crash;
             # no-op when tracing is disarmed

@@ -421,6 +421,50 @@ def graphcheck_snapshot() -> dict:
     return out
 
 
+def memory_snapshot() -> dict:
+    """Device-memory ledger health (obs/memory.py — docs/OBSERVABILITY.md
+    § memory ledger): per-component registered bytes, the unattributed
+    residual against the backend's `bytes_in_use`, the declared-vs-
+    measured drift per component, and the measurement source ("measured"
+    on a backend with memory_stats, "estimate" elsewhere — a CPU doctor
+    run must say so, never fake device bytes). armed=False when no run
+    in this process configured the ledger."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.obs import memory as obs_memory
+
+        led = obs_memory.get_ledger()
+        out["armed"] = led is not None
+        if led is not None:
+            out.update(led.snapshot())
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
+def alerts_snapshot() -> dict:
+    """Metrics-history / burn-rate alert health (obs/history.py +
+    obs/alerts.py): history ring occupancy and span, per-rule state
+    (active, fire count, last fast/slow burn factors, last clear) and
+    the currently-firing set. armed=False when neither the history nor
+    the alert engine was configured in this process."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.obs import alerts as obs_alerts
+        from pytorchvideo_accelerate_tpu.obs import history as obs_history
+
+        engine = obs_alerts.get_engine()
+        hist = obs_history.get_history()
+        out["armed"] = engine is not None or hist is not None
+        if engine is not None:
+            out.update(engine.snapshot())
+        elif hist is not None:
+            out["history"] = hist.snapshot()
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def diagnose(timeout_s: int = 120, skip_init: bool = False,
              variants: bool = False, obs_dir: str = "") -> dict:
     rec = {
@@ -436,6 +480,8 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "tsan": tsan_snapshot(),
         "reliability": reliability_snapshot(obs_dir),
         "guard": guard_snapshot(obs_dir),
+        "memory": memory_snapshot(),
+        "alerts": alerts_snapshot(),
     }
     if not skip_init:
         rec["verbose_init"] = verbose_init_attempt(timeout_s)

@@ -23,8 +23,11 @@ AdmissionController, Watchdog, SpanCollector, FlightRecorder, TrackerHub,
 the distributed tracer (obs/trace.Tracer), the fleet tier's
 Scheduler / ReplicaPool / Router / LoadGen (fleet/*.py), the fleet
 control loops' Autoscaler / CanaryController / ModelBudget
-(fleet/control/*.py), and the data plane's RemoteClipFeed / DecodeWorker
-(dataplane/*.py — the credit/ack machinery) — new threaded classes MUST
+(fleet/control/*.py), the data plane's RemoteClipFeed / DecodeWorker
+(dataplane/*.py — the credit/ack machinery), and the pva-tpu-hbm layer's
+MemoryLedger / MetricsHistory / AlertEngine / ProfilerCapture
+(obs/memory.py, obs/history.py, obs/alerts.py, obs/profiler.py — ledger
+churn and alert flaps race scrape ticks) — new threaded classes MUST
 declare here so the pva-tpu-tsan stress scenario gates their concurrency
 like everything else's.
 

@@ -53,8 +53,10 @@ env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
 # fatal here — autoscaler converged, zero session failures across the
 # scale-down re-home, exactly one seeded-regression rollback with the
 # blues restored, the clean green promoted, both model families served
-# under the shared budget. The lane's perf numbers stay non-fatal (they
-# inform via the perfdiff report below, like every other lane's).
+# under the shared budget, zero burn-rate alert false positives and the
+# budget-lies admission flip held (pva-tpu-hbm). The lane's perf numbers
+# stay non-fatal (they inform via the perfdiff report below, like every
+# other lane's).
 env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python - "${ROOT}/bench.py" <<'PY'
@@ -76,6 +78,14 @@ checks = {
     "canary_promoted": out.get("canary_promoted") is True,
     "budget_shed_ok": out.get("budget_shed_ok") is True,
     "fleet_models_served": out.get("fleet_models_served", 0) >= 2,
+    # pva-tpu-hbm (docs/OBSERVABILITY.md): the seeded SLO breach fired
+    # its burn-rate rule exactly once and cleared -- zero fires outside
+    # the excursion -- and measured-byte admission refused the family
+    # the declared estimate would have admitted
+    "alert_false_positives": out.get("alert_false_positives") == 0,
+    "alert_fired_once": out.get("alert_fired_once") is True,
+    "alert_cleared": out.get("alert_cleared") is True,
+    "budget_lies_refused": out.get("budget_lies_refused") is True,
 }
 bad = sorted(k for k, ok in checks.items() if not ok)
 if proc.returncode or bad:

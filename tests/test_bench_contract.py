@@ -174,6 +174,9 @@ def test_finalize_line_fits_driver_capture():
         "canary_rollback": 1, "fleet_models_served": 2,
         "canary_promoted": True, "fleet_session_failures": 0,
         "fleet_auto_error": "no trustworthy device numbers " + "a" * 200,
+        "hbm_peak_bytes": 283289720, "hbm_attributed_frac": 0.9876,
+        "hbm_source": "estimate", "alert_false_positives": 0,
+        "budget_lies_refused": True,
         "kbench_platform": "cpu", "kbench_parity_ok": True,
         "kbench_best": "dw_x3d_res3:118.167x",
         "kbench_dw_x3d_res3_speedup": 118.167,
@@ -455,6 +458,54 @@ def test_finalize_fleet_auto_keys_ride_the_headline():
     # verdicts ride the refusal, like stream_parity does
     assert out["canary_promoted"] is True
     assert out["fleet_session_failures"] == 0
+
+
+def test_finalize_hbm_and_alert_keys_ride_the_headline():
+    """The pva-tpu-hbm keys: the memory-ledger triple (peak bytes,
+    attributed fraction, provenance label) plus the burn-rate and
+    budget-admission verdicts plumb through finalize — and, being
+    verdict-class keys, they ride even a fleet_auto_error refusal (an
+    alert false positive on a refused round is still a false positive)."""
+    extras = {"hbm_peak_bytes": 283289720, "hbm_attributed_frac": 1.0,
+              "hbm_source": "estimate", "alert_false_positives": 0,
+              "budget_lies_refused": True,
+              "autoscale_converge_s": 0.373}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["hbm_peak_bytes"] == 283289720
+    assert out["hbm_attributed_frac"] == 1.0
+    assert out["hbm_source"] == "estimate"
+    assert out["alert_false_positives"] == 0
+    assert out["budget_lies_refused"] is True
+
+    out = bench.finalize(
+        _model(), {**extras, "fleet_auto_error": "cpu fallback"},
+        user_smoke=False)
+    assert "autoscale_converge_s" not in out  # the perf key obeys refusal
+    assert out["hbm_source"] == "estimate"
+    assert out["alert_false_positives"] == 0
+    assert out["budget_lies_refused"] is True
+
+
+def test_finalize_hbm_shed_order_source_outlives_bytes():
+    """In the size-shed ladder the hbm triple drops as a unit-in-reverse:
+    the bytes shed before the provenance label that qualifies them — a
+    headline must never keep an unlabeled byte count that could read as
+    a device claim."""
+    import inspect
+
+    src = inspect.getsource(bench.finalize)
+    # locate the positions inside the shed tuple specifically (its first
+    # member anchors it past the hoist list earlier in the function)
+    shed_start = src.index('"probes", "trace_overhead_frac"')
+    i_frac = src.index('"hbm_attributed_frac"', shed_start)
+    i_peak = src.index('"hbm_peak_bytes"', shed_start)
+    i_src = src.index('"hbm_source"', shed_start)
+    assert i_frac < i_peak < i_src
+    # and the alert/budget verdicts shed with the FLEET_AUTO group,
+    # before any hbm key
+    i_alert = src.index('"alert_false_positives"', shed_start)
+    i_lies = src.index('"budget_lies_refused"', shed_start)
+    assert max(i_alert, i_lies) < i_frac
 
 
 def test_finalize_stream_trunk_quality_refusal():
