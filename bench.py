@@ -589,7 +589,60 @@ def bench_multichip(args) -> dict:
         optim=OptimConfig(num_epochs=1, lr=0.01),
         mixed_precision="bf16",
     )
-    res = Trainer(tcfg).fit()
+    # pva-tpu-spmdcheck dynamic half (docs/STATIC_ANALYSIS.md § spmdcheck):
+    # record the REAL fit's collective schedule through the hangcheck
+    # sections, then replay a deterministic probe segment (real host
+    # collectives) under two emulated host labels and diff — run-to-run
+    # schedule determinism is the property every pod host must have, so
+    # the emulation diffs the real mechanism and the lane headlines
+    # spmd_schedule_divergence == 0 forever
+    from pytorchvideo_accelerate_tpu.parallel import (
+        collectives,
+        schedule_recorder as sched_rec,
+    )
+    from pytorchvideo_accelerate_tpu.parallel.hangcheck import (
+        collective_section,
+    )
+
+    rec = sched_rec.CollectiveScheduleRecorder(host="fit")
+    sched_rec.install_schedule_recorder(rec)
+    try:
+        res = Trainer(tcfg).fit()
+        # non-vacuity: the real fit must have flowed through the watched
+        # sections (ckpt_save/ckpt_close at minimum ride every fit)
+        out["spmd_fit_sections"] = rec.counts().get("fit", 0)
+        for h in range(2):
+            with rec.as_host(f"host={h}/2"):
+                for i in range(3):
+                    with collective_section("step_dispatch", step=i):
+                        pass
+                    collectives.host_allgather(np.int32(i))
+                    collectives.host_broadcast(np.int32(i))
+        probe = {k: v for k, v in rec.schedules().items() if k != "fit"}
+        div = sched_rec.diff_schedules(probe)
+        sched_rec.publish_schedule_report(div)
+        out["spmd_schedule_divergence"] = int(div.get(
+            "divergence_count", 0))
+        # seeded counterpart, every run: one emulated host SKIPS a
+        # broadcast — the differ MUST name it, or the clean 0 above is
+        # vacuous
+        rec.clear()
+        for h in range(2):
+            with rec.as_host(f"host={h}/2"):
+                collectives.host_allgather(np.int32(0))
+                if h == 0:
+                    collectives.host_broadcast(np.int32(1))
+                with collective_section("epoch_sync"):
+                    pass
+        seeded = sched_rec.diff_schedules(rec.schedules())
+        first = seeded.get("first_divergence") or {}
+        seeded_ops = {k: (e[1] if e else None)
+                      for k, e in (first.get("hosts") or {}).items()}
+        out["spmd_divergence_detected"] = bool(
+            seeded.get("diverged")
+            and "host_broadcast" in seeded_ops.values())
+    finally:
+        sched_rec.uninstall_schedule_recorder()
     out["train_recompiles"] = res.get("train_recompiles")
     out["trainer_cps_chip"] = round(
         res.get("clips_per_sec", 0.0) / max(n, 1), 3)
@@ -2638,6 +2691,30 @@ def main():
             "donation pass reports declared-but-unaliased or "
             "undeclared-donatable state leaves (see "
             "docs/STATIC_ANALYSIS.md § donation)")
+        # collective-schedule divergence gate (docs/STATIC_ANALYSIS.md
+        # § spmdcheck): the static pass over the hot modules — collectives
+        # under host-divergent predicates, asymmetric branch arms, skip
+        # paths past a later collective, checkpoint-write discipline, and
+        # the collective_section coverage audit — must come back clean
+        # before any child spends minutes. The multi-host pod runtime's
+        # precondition rides the same lint/tsan/chaos/graphcheck pattern.
+        from pytorchvideo_accelerate_tpu.analysis.spmdcheck import (
+            finding_count as spmdcheck_finding_count,
+            format_report as spmdcheck_format,
+            run_spmdcheck,
+        )
+
+        spmdcheck_report = run_spmdcheck(log=log)
+        spmdcheck_findings = spmdcheck_finding_count(spmdcheck_report)
+        log(f"[spmdcheck] pva-tpu-spmdcheck: {spmdcheck_findings} "
+            f"finding(s) in {spmdcheck_report['elapsed_s']}s")
+        if spmdcheck_findings:
+            log(spmdcheck_format(spmdcheck_report))
+        assert spmdcheck_findings == 0, (
+            "bench --smoke requires an spmdcheck-clean tree; "
+            f"pva-tpu-spmdcheck found {spmdcheck_findings} finding(s) "
+            "(report logged above; see docs/STATIC_ANALYSIS.md "
+            "§ spmdcheck)")
 
     user_smoke = args.smoke
     probe_attempts: list = []
@@ -2648,6 +2725,7 @@ def main():
         extras["tsan_findings"] = tsan_findings
         extras["chaos_findings"] = chaos_findings
         extras["graphcheck_findings"] = graphcheck_findings
+        extras["spmdcheck_findings"] = spmdcheck_findings
 
     def flush_partial():
         try:
@@ -2809,6 +2887,14 @@ def main():
             if mc.get("train_recompiles") is not None:
                 extras["multichip_train_recompiles"] = int(
                     mc["train_recompiles"])
+            # spmdcheck dynamic verdicts ride like the numerics ones
+            # (verdicts, not perf — the suspect refusal never hides them)
+            if mc.get("spmd_schedule_divergence") is not None:
+                extras["spmd_schedule_divergence"] = int(
+                    mc["spmd_schedule_divergence"])
+            if mc.get("spmd_divergence_detected") is not None:
+                extras["spmd_divergence_detected"] = bool(
+                    mc["spmd_divergence_detected"])
             # perf numbers only when trustworthy: a non-smoke lane that
             # landed on CPU is a lying tunnel, not a scaling curve
             if mc.get("suspect"):
@@ -3175,6 +3261,11 @@ def main():
         assert extras.get("graphcheck_findings") == 0, (
             f"pva-tpu-graphcheck found {extras.get('graphcheck_findings')} "
             "finding(s) (see docs/STATIC_ANALYSIS.md)")
+        # collective-schedule contract: spmdcheck already gated at the
+        # top; the headline must carry its verdict too
+        assert extras.get("spmdcheck_findings") == 0, (
+            f"pva-tpu-spmdcheck found {extras.get('spmdcheck_findings')} "
+            "finding(s) (see docs/STATIC_ANALYSIS.md § spmdcheck)")
     if user_smoke and args.multichip:
         # 2-D-mesh contract (docs/PARALLELISM.md): the scaling lane must
         # produce its parity verdict and curve, parity must HOLD, and the
@@ -3193,6 +3284,19 @@ def main():
         assert extras.get("multichip_train_recompiles") in (0, None), (
             "steady-state recompiles under the 2-D mesh layout: "
             f"{extras.get('multichip_train_recompiles')}")
+        # collective-schedule contract (docs/STATIC_ANALYSIS.md
+        # § spmdcheck): the lane's emulated-host probe must replay an
+        # identical schedule on every host (zero divergence), and the
+        # seeded-divergence leg must PROVE the differ catches a real
+        # skew — a clean report from a blind recorder gates nothing
+        assert extras.get("spmd_schedule_divergence") == 0, (
+            "MULTICHIP collective schedules diverged across emulated "
+            f"hosts: {extras.get('spmd_schedule_divergence')} "
+            f"({extras.get('multichip')})")
+        assert extras.get("spmd_divergence_detected") is True, (
+            "seeded schedule divergence was NOT detected by the "
+            "recorder/differ — the divergence gate is blind "
+            f"({extras.get('multichip')})")
     if user_smoke and args.pipeline:
         # PIPELINE acceptance (docs/PARALLELISM.md § pipeline): the P=2/4
         # stage pipelines hold the P=1 fp32 loss trajectory at identical
@@ -3598,8 +3702,10 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
                 "obs_input_wait_frac", "obs_h2d_s", "train_recompiles",
                 "guard_rollbacks", "quarantined_clips",
                 "tsan_findings", "chaos_findings", "graphcheck_findings",
+                "spmdcheck_findings",
                 "mesh_parity",
                 "mesh_ckpt_portable", "multichip_train_recompiles",
+                "spmd_schedule_divergence", "spmd_divergence_detected",
                 "pipeline_parity", "pipeline_donation_verified",
                 "pipeline_train_recompiles",
                 "stream_parity", "stream_recompiles",
@@ -3697,7 +3803,11 @@ def finalize(results: dict, extras: dict, user_smoke: bool) -> dict:
               "multichip_mfu_peak_source", "multichip_mfu_analytic",
               "multichip_mfu", "multichip_forced_host",
               "multichip_train_recompiles", "multichip_error",
-              "multichip_cps_per_chip", "mesh_ckpt_portable", "mesh_parity",
+              "multichip_cps_per_chip",
+              # spmd schedule verdicts shed just before the mesh verdicts
+              # (the divergence gate is this arc's acceptance metric)
+              "spmd_divergence_detected", "spmd_schedule_divergence",
+              "mesh_ckpt_portable", "mesh_parity",
               # the PIPELINE lane sheds after the multichip curve (its
               # bubble-frac headline is this arc's acceptance metric) but
               # before the fleet/dataplane/kbench groups

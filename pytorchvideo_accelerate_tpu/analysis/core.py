@@ -171,6 +171,7 @@ def default_rules() -> List[Rule]:
     bench smoke gate, and the doctor all lint with identical rules)."""
     from pytorchvideo_accelerate_tpu.analysis.rules_dtype import DtypeLiteralRule
     from pytorchvideo_accelerate_tpu.analysis.rules_host_sync import HostSyncRule
+    from pytorchvideo_accelerate_tpu.analysis.rules_knob import KnobReadRule
     from pytorchvideo_accelerate_tpu.analysis.rules_ledger import (
         LedgerDisciplineRule,
     )
@@ -190,7 +191,7 @@ def default_rules() -> List[Rule]:
     return [HostSyncRule(), RecompileHazardRule(), LockDisciplineRule(),
             TracerLeakRule(), SpanDisciplineRule(), ThreadFactoryRule(),
             ThreadJoinRule(), MeshDisciplineRule(), TracePropagationRule(),
-            DtypeLiteralRule(), LedgerDisciplineRule()]
+            DtypeLiteralRule(), LedgerDisciplineRule(), KnobReadRule()]
 
 
 def parse_module(source: str, path: str) -> ModuleInfo:

@@ -1,0 +1,115 @@
+"""`knob-read`: every config knob must be read somewhere outside config.py.
+
+The config surface is 14 dataclass blocks and growing one per subsystem
+PR; a knob that nothing reads is worse than dead code — users set it,
+nothing changes, and the silence reads as "the feature is broken". This
+rule inverts the usual direction of a dead-code check: it fires ON
+`config.py` (one module, so the package scan below runs once per lint
+pass) and asks, for every field of every `*Config` dataclass, whether
+ANY module in the package outside `config.py` and `tests/` mentions that
+field name as an attribute read (`cfg.train.log_every_steps`, through
+whatever local alias the caller bound — alias-proof because attribute
+TAILS don't care about the receiver) or as a `getattr`/string-key
+constant.
+
+Name-presence is deliberately coarse (two knobs sharing a name are
+jointly satisfied by one reader) — coarse in the false-NEGATIVE
+direction, which is the right polarity for a `findings == 0` gate.
+A knob added ahead of its consumer carries a suppression with a reason::
+
+    new_knob: int = 0  # pva: disable=knob-read -- consumed by PR N's ...
+
+so forward declarations stay auditable instead of silently rotting.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, Iterable, Optional, Set
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+)
+
+_CONFIG_FILES = ("pytorchvideo_accelerate_tpu/config.py",)
+
+
+def _field_reads_in_tree(pkg_dir: str) -> Set[str]:
+    """Every attribute tail / getattr-string / subscript-string constant
+    mentioned by package modules other than config.py (tests never count:
+    a knob only a test reads is still dead)."""
+    reads: Set[str] = set()
+    for root, dirs, files in os.walk(pkg_dir):
+        dirs[:] = [d for d in dirs if d not in ("__pycache__", "tests")]
+        for fname in files:
+            if not fname.endswith(".py") or fname == "config.py":
+                continue
+            path = os.path.join(root, fname)
+            try:
+                with open(path, "r", encoding="utf-8") as fh:
+                    tree = ast.parse(fh.read())
+            except (OSError, SyntaxError):
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Attribute):
+                    reads.add(node.attr)
+                elif isinstance(node, ast.Call) \
+                        and call_name(node).rsplit(".", 1)[-1] in (
+                            "getattr", "get"):
+                    for arg in node.args:
+                        if isinstance(arg, ast.Constant) \
+                                and isinstance(arg.value, str):
+                            reads.add(arg.value)
+                elif isinstance(node, ast.Subscript) \
+                        and isinstance(node.slice, ast.Constant) \
+                        and isinstance(node.slice.value, str):
+                    reads.add(node.slice.value)
+    return reads
+
+
+class KnobReadRule(Rule):
+    name = "knob-read"
+    description = ("config dataclass field is never read outside "
+                   "config.py/tests — a dead knob users can set with no "
+                   "effect; wire it or suppress with the consuming PR")
+
+    def __init__(self) -> None:
+        # one package scan per lint run (the rule only fires on config.py,
+        # but keep a cache in case a run lints several copies)
+        self._scan_cache: Dict[str, Set[str]] = {}
+
+    def _reads_for(self, module: ModuleInfo) -> Optional[Set[str]]:
+        pkg_dir = os.path.dirname(os.path.abspath(module.path))
+        if not os.path.isdir(pkg_dir):
+            return None
+        if pkg_dir not in self._scan_cache:
+            self._scan_cache[pkg_dir] = _field_reads_in_tree(pkg_dir)
+        return self._scan_cache[pkg_dir]
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not module.matches(_CONFIG_FILES):
+            return
+        reads = self._reads_for(module)
+        if reads is None:  # fixture paths with no real package around them
+            reads = set()
+        for node in ast.walk(module.tree):
+            if not isinstance(node, ast.ClassDef) \
+                    or not node.name.endswith("Config"):
+                continue
+            for stmt in node.body:
+                if not isinstance(stmt, ast.AnnAssign) \
+                        or not isinstance(stmt.target, ast.Name):
+                    continue
+                fname = stmt.target.id
+                if fname.startswith("_") or fname in reads:
+                    continue
+                yield self.finding(
+                    module, stmt,
+                    f"config knob `{node.name}.{fname}` is never read "
+                    "outside config.py/tests — a user can set it and "
+                    "nothing changes; wire it into its subsystem or "
+                    "suppress with the PR that will consume it")

@@ -1,0 +1,586 @@
+"""`pva-tpu-spmdcheck` static rules: collective-schedule divergence.
+
+A TPU pod is N processes executing ONE program: every host must issue the
+identical ordered sequence of collectives, or the pod deadlocks with no
+evidence (the host that skipped a `psum` behind a `process_index()==0`
+branch sits in the next collective while everyone else waits in the
+previous one). These rules patrol the hot modules (`trainer/`,
+`parallel/`, `data/`, `launch.py`) for the statically-visible shapes of
+that bug class:
+
+`spmd-divergence` — four finding kinds, one suppression name
+(`# pva: disable=spmd-divergence -- reason`):
+
+- ``divergent-predicate``: a collective site reachable under a predicate
+  that can evaluate differently per host — `process_index()` /
+  `is_main_process()`, filesystem probes (`os.path.exists`, `listdir`,
+  `glob`), env reads, host RNG, wall-clock — in any enclosing
+  branch/loop, or inside an `except` handler (exception paths are
+  per-host by nature).
+- ``branch-asymmetry``: a collective in one arm of an `if` whose sibling
+  arm exists but issues none, when the test is not a static
+  configuration expression (uniform-by-construction tests — plain
+  names/attributes/constants and whitelisted builtins — stay clean).
+- ``skip-path``: an early `return`/`raise`/`continue`/`break` under a
+  host-divergent predicate (or inside an `except` handler) that can skip
+  — or, via a loop back-edge, repeat — a collective later in the same
+  function.
+- ``ckpt-discipline``: a checkpoint-artifact WRITE primitive
+  (`atomic_write`/`atomic_write_json`/`save_converted`) not guarded by
+  the process-0 discipline (`is_main_process()` /
+  `jax.process_index() == 0`): N hosts racing the same shared-dir file
+  is artifact corruption. (The inverse error — an orbax *collective*
+  save under a process-0 guard — is a ``divergent-predicate`` finding.)
+
+`spmd-coverage` — the hangcheck coverage audit: every RAW host-blocking
+collective primitive (`multihost_utils.process_allgather` /
+`broadcast_one_to_all` / `sync_global_devices`, the orbax manager's
+`save`/`restore`/`wait_until_finished` barriers) must sit lexically
+inside a `with collective_section(...)` so per-host stall attribution
+(parallel/hangcheck.py) and schedule recording
+(parallel/schedule_recorder.py) see it. The repo's wrapped helpers
+(`host_broadcast`, `Checkpointer.save`, `sync_global_devices` in
+`parallel/distributed.py`) satisfy this inside their own bodies; call
+sites need nothing.
+
+Alias-proof the thread-factory/dtype-literal way: detection keys on the
+distinctive call TAILS (`host_broadcast` however imported, dotted or
+bare, through `self.`-bound aliases), with receiver-name filters only
+where a tail is generic (`.save`/`.restore`/`.wait` count only on
+checkpoint-ish receivers). Interprocedural ONE level via the qualname
+helpers: a function that directly issues a collective marks every
+same-module call to it as a collective site too.
+
+POLARITY (the gc_sharding doctrine): every heuristic here errs toward
+false NEGATIVES — a missed site can only let a finding escape, never
+raise a false alarm — the right polarity for a gate that must hold
+`findings == 0` on the clean tree. Known limits (documented in
+docs/STATIC_ANALYSIS.md): predicates are judged as single expressions
+(no dataflow taint — `flag = os.path.exists(p)` ... `if flag:` escapes),
+and in-graph `lax` collectives are only flagged when issued from
+host-level code (anything under a `jit`/`shard_map`-traced function is
+one program by construction).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterable, Iterator, List, Optional, Set, Tuple
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    Finding,
+    ModuleInfo,
+    Rule,
+    call_name,
+    walk_with_qualname,
+)
+
+_PKG_MARKER = "pytorchvideo_accelerate_tpu/"
+
+# the modules the multi-host runtime will execute on every host; new
+# host-level collective callers join this list (ISSUE 20 / ROADMAP 4)
+_HOT_DIRS = ("/trainer/", "/parallel/", "/data/")
+_HOT_FILES = ("pytorchvideo_accelerate_tpu/launch.py",)
+
+# --- site vocabulary --------------------------------------------------------
+
+# repo helpers that wrap their own collective_section (coverage holds
+# inside their bodies; call sites are divergence-checked only)
+_WRAPPED_TAILS = ("host_broadcast", "host_allgather", "host_reduce_sum",
+                  "sync_global_devices", "check_desync")
+# raw multihost primitives: the coverage audit's targets
+_PRIMITIVE_TAILS = ("process_allgather", "broadcast_one_to_all")
+# generic barrier tails that count only on checkpoint-ish receivers
+_CKPT_BARRIER_TAILS = ("save", "restore", "wait", "wait_until_finished",
+                       "close")
+# in-graph collectives — host-issued only (traced scopes are exempt)
+_LAX_TAILS = ("psum", "pmean", "pmax", "pmin", "all_gather", "ppermute",
+              "ppermute_ring", "all_to_all")
+# checkpoint-artifact write primitives (reliability/atomic.py,
+# models/convert.py) — the process-0-discipline targets
+_WRITE_TAILS = ("atomic_write", "atomic_write_json", "save_converted")
+
+# call tails that build traced (single-program) scopes: a function handed
+# to any of these is compiled once for the whole pod and cannot diverge
+_TRACER_TAILS = ("jit", "pjit", "shard_map", "pmap", "vmap", "grad",
+                 "value_and_grad", "scan", "remat", "checkpoint",
+                 "eval_shape", "make_jaxpr", "named_call", "custom_vjp",
+                 "custom_jvp", "while_loop", "fori_loop", "cond", "switch")
+
+# --- host-divergent predicate atoms ----------------------------------------
+
+_IDENTITY_TAILS = ("process_index", "is_main_process")
+_FS_TAILS = ("exists", "isfile", "isdir", "islink", "getsize", "getmtime",
+             "listdir", "scandir", "glob", "iglob")
+_CLOCK_TAILS = ("time", "monotonic", "perf_counter", "time_ns")
+_RNG_HINT = "random"
+# calls that are uniform across hosts by construction — never divergent,
+# and uniform enough to keep a branch test "static" for the asymmetry
+# check
+_UNIFORM_CALL_TAILS = ("process_count", "device_count",
+                       "local_device_count", "isinstance", "issubclass",
+                       "len", "getattr", "hasattr", "callable", "int",
+                       "float", "str", "bool", "min", "max", "abs", "any",
+                       "all", "sorted", "tuple", "list", "dict", "set",
+                       "type", "round", "range", "enumerate", "zip")
+
+_KIND_DIVERGENT = "divergent-predicate"
+_KIND_ASYMMETRY = "branch-asymmetry"
+_KIND_SKIP = "skip-path"
+_KIND_CKPT = "ckpt-discipline"
+DIVERGENCE_KINDS = (_KIND_DIVERGENT, _KIND_ASYMMETRY, _KIND_SKIP,
+                    _KIND_CKPT)
+
+
+def _is_hot(module: ModuleInfo) -> bool:
+    p = module.posix_path
+    if _PKG_MARKER not in p:
+        return False
+    return any(d in p for d in _HOT_DIRS) or module.matches(_HOT_FILES)
+
+
+def _call_tail(node: ast.Call) -> str:
+    if isinstance(node.func, ast.Attribute):
+        return node.func.attr
+    if isinstance(node.func, ast.Name):
+        return node.func.id
+    return ""
+
+
+def _head_last(node: ast.Call) -> str:
+    """Last segment of the receiver chain ("self._mgr.save" -> "_mgr");
+    "" for bare names / call-valued receivers."""
+    dn = call_name(node)
+    if "." not in dn:
+        return ""
+    return dn.rsplit(".", 2)[-2]
+
+
+def _ckptish(name: str) -> bool:
+    low = name.lower()
+    return any(m in low for m in ("ckpt", "checkpoint", "mgr"))
+
+
+def _divergent_reason(expr: ast.AST) -> Optional[str]:
+    """Why this expression can evaluate differently per host, or None."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            tail = _call_tail(node)
+            dn = call_name(node)
+            if tail in _UNIFORM_CALL_TAILS:
+                continue
+            if tail in _IDENTITY_TAILS:
+                return f"per-host identity ({tail}())"
+            if tail in _FS_TAILS or dn == "open":
+                return f"per-host filesystem state ({dn or tail}())"
+            if tail == "getenv" or "environ" in dn:
+                return f"per-host environment ({dn or tail})"
+            if tail in _CLOCK_TAILS and dn.startswith("time."):
+                return f"wall clock ({dn}())"
+            if _RNG_HINT in dn.lower() and "jax" not in dn.lower():
+                return f"host RNG ({dn}())"
+        elif isinstance(node, ast.Attribute):
+            if node.attr in _IDENTITY_TAILS:
+                return f"per-host identity (.{node.attr})"
+            if node.attr == "environ":
+                return "per-host environment (environ)"
+        elif isinstance(node, ast.Name):
+            if node.id in _IDENTITY_TAILS:
+                return f"per-host identity ({node.id})"
+    return None
+
+
+def _test_is_static(expr: ast.AST) -> bool:
+    """True when a branch test is uniform-by-construction: constants,
+    names, attribute chains, and whitelisted uniform builtins only. Any
+    other call makes the test dynamic (the asymmetry check then demands
+    symmetric collectives)."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            if _call_tail(node) not in _UNIFORM_CALL_TAILS:
+                return False
+    return True
+
+
+# --- site collection --------------------------------------------------------
+
+@dataclass
+class _Site:
+    node: ast.AST
+    kind: str  # "wrapped" | "primitive" | "section" | "lax_host" | "derived"
+    label: str
+    scope: str
+
+
+def _parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    return {child: parent
+            for parent in ast.walk(tree)
+            for child in ast.iter_child_nodes(parent)}
+
+
+def _traced_scopes(tree: ast.AST) -> Tuple[Set[str], Set[ast.AST]]:
+    """(names of functions handed to tracers or jit-decorated,
+    lambda/def nodes appearing inline in tracer calls)."""
+    names: Set[str] = set()
+    nodes: Set[ast.AST] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and _call_tail(node) in _TRACER_TAILS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if isinstance(arg, ast.Name):
+                    names.add(arg.id)
+                elif isinstance(arg, ast.Lambda):
+                    nodes.add(arg)
+        elif isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                d = dec.func if isinstance(dec, ast.Call) else dec
+                dn = ""
+                if isinstance(d, (ast.Attribute, ast.Name)):
+                    dn = d.attr if isinstance(d, ast.Attribute) else d.id
+                if dn in _TRACER_TAILS:
+                    names.add(node.name)
+                elif dn == "partial" and isinstance(dec, ast.Call):
+                    for a in dec.args:
+                        if isinstance(a, (ast.Attribute, ast.Name)):
+                            t = (a.attr if isinstance(a, ast.Attribute)
+                                 else a.id)
+                            if t in _TRACER_TAILS:
+                                names.add(node.name)
+    return names, nodes
+
+
+def _in_traced_scope(node: ast.AST, parents: Dict[ast.AST, ast.AST],
+                     traced_names: Set[str],
+                     traced_nodes: Set[ast.AST], tail: str) -> bool:
+    anc = parents.get(node)
+    while anc is not None:
+        if isinstance(anc, ast.Lambda) and anc in traced_nodes:
+            return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if anc.name in traced_names:
+                return True
+            # thin-wrapper exemption: `def psum(...): return lax.psum(...)`
+            # (parallel/collectives.py) — the wrapper IS the public name
+            if anc.name.startswith(tail) or tail.startswith(anc.name):
+                return True
+        anc = parents.get(anc)
+    return False
+
+
+def _classify_call(node: ast.Call) -> Optional[Tuple[str, str]]:
+    """(kind, label) for one call, ignoring traced-scope analysis."""
+    tail = _call_tail(node)
+    if not tail:
+        return None
+    dn = call_name(node) or tail
+    if tail in _WRAPPED_TAILS:
+        # distributed.sync_global_devices wraps internally; the raw
+        # multihost_utils one is a primitive needing a lexical section
+        if tail == "sync_global_devices" and "multihost" in dn:
+            return "primitive", dn
+        return "wrapped", dn
+    if tail in _PRIMITIVE_TAILS:
+        return "primitive", dn
+    if tail in _CKPT_BARRIER_TAILS:
+        head = _head_last(node)
+        if not _ckptish(head):
+            return None
+        if "mgr" in head.lower():
+            return "primitive", dn
+        return "wrapped", dn
+    return None
+
+
+def _is_section_with(node: ast.With) -> bool:
+    for item in node.items:
+        ce = item.context_expr
+        if isinstance(ce, ast.Call) and _call_tail(ce) == "collective_section":
+            return True
+    return False
+
+
+def collect_sites(module: ModuleInfo) -> List[_Site]:
+    """Every host-blocking collective site in the module, including
+    one-level interprocedural call sites of functions that directly
+    issue collectives."""
+    parents = _parent_map(module.tree)
+    traced_names, traced_nodes = _traced_scopes(module.tree)
+    sites: List[_Site] = []
+    carrier_scopes: Set[str] = set()
+    for node, scope in walk_with_qualname(module.tree):
+        if isinstance(node, ast.With) and _is_section_with(node):
+            sites.append(_Site(node, "section", "collective_section", scope))
+            carrier_scopes.add(scope)
+            continue
+        if not isinstance(node, ast.Call):
+            continue
+        cls = _classify_call(node)
+        if cls is not None:
+            sites.append(_Site(node, cls[0], cls[1], scope))
+            carrier_scopes.add(scope)
+            continue
+        tail = _call_tail(node)
+        if tail in _LAX_TAILS and not _in_traced_scope(
+                node, parents, traced_names, traced_nodes, tail):
+            sites.append(_Site(node, "lax_host", call_name(node) or tail,
+                               scope))
+            carrier_scopes.add(scope)
+    # one-level interprocedural: calls to same-module functions that
+    # directly issue collectives are collective sites themselves
+    carriers = {s.rsplit(".", 1)[-1] for s in carrier_scopes if s}
+    if carriers:
+        direct_nodes = {s.node for s in sites}
+        for node, scope in walk_with_qualname(module.tree):
+            if (isinstance(node, ast.Call) and node not in direct_nodes
+                    and _call_tail(node) in carriers
+                    and scope.rsplit(".", 1)[-1] != _call_tail(node)):
+                sites.append(_Site(
+                    node, "derived",
+                    f"{_call_tail(node)}() [issues collectives]", scope))
+    return sites
+
+
+# --- ancestor-context checks ------------------------------------------------
+
+def _branch_ancestors(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                      ) -> Iterator[Tuple[ast.AST, ast.AST]]:
+    """(ancestor, direct-child-on-path) pairs up to the enclosing
+    function/class/module boundary. Lambdas are transparent (a guard
+    outside a `lambda: atomic_write(...)` still guards the write)."""
+    prev, anc = node, parents.get(node)
+    while anc is not None and not isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef,
+                  ast.Module)):
+        yield anc, prev
+        prev, anc = anc, parents.get(anc)
+
+
+def _divergent_context(node: ast.AST, parents: Dict[ast.AST, ast.AST]
+                       ) -> Optional[str]:
+    """The innermost host-divergent enclosing context, or None."""
+    for anc, prev in _branch_ancestors(node, parents):
+        if isinstance(anc, ast.ExceptHandler):
+            return "exception path (per-host exception handling diverges)"
+        if isinstance(anc, ast.If) and (prev in anc.body
+                                        or prev in anc.orelse):
+            reason = _divergent_reason(anc.test)
+            if reason:
+                return reason
+        elif isinstance(anc, ast.While) and prev in anc.body:
+            reason = _divergent_reason(anc.test)
+            if reason:
+                return reason
+        elif isinstance(anc, ast.For) and prev in anc.body:
+            reason = _divergent_reason(anc.iter)
+            if reason:
+                return f"loop over {reason}"
+    return None
+
+
+def _main_guarded(node: ast.AST, parents: Dict[ast.AST, ast.AST]) -> bool:
+    """Is this write under (or behind an early-return of) the process-0
+    discipline in its enclosing function?"""
+
+    def is_main_test(expr: ast.AST) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) \
+                    and _call_tail(n) in ("is_main_process",):
+                return True
+            if isinstance(n, ast.Compare):
+                sides = [n.left] + list(n.comparators)
+                has_pidx = any(isinstance(s, ast.Call)
+                               and _call_tail(s) == "process_index"
+                               for s in sides)
+                has_zero = any(isinstance(s, ast.Constant) and s.value == 0
+                               for s in sides)
+                if has_pidx and has_zero:
+                    return True
+        return False
+
+    func: Optional[ast.AST] = None
+    for anc, prev in _branch_ancestors(node, parents):
+        if isinstance(anc, ast.If) and is_main_test(anc.test):
+            return True
+    # early-bail pattern: `if not is_main_process(): return` earlier in
+    # the same function body
+    anc = parents.get(node)
+    while anc is not None and not isinstance(
+            anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+        anc = parents.get(anc)
+    func = anc
+    if func is None:
+        return False
+    line = getattr(node, "lineno", 0)
+    for stmt in ast.walk(func):
+        if (isinstance(stmt, ast.If) and getattr(stmt, "lineno", 1 << 30) < line
+                and any(isinstance(s, (ast.Return, ast.Raise))
+                        for s in stmt.body)
+                and is_main_test(stmt.test)
+                and isinstance(stmt.test, ast.UnaryOp) is False):
+            # `if process_index() != 0: return` / `if not is_main: return`
+            if _guard_excludes_nonzero(stmt.test):
+                return True
+    return False
+
+
+def _guard_excludes_nonzero(expr: ast.AST) -> bool:
+    """`not is_main_process()` or `process_index() != 0`-shaped tests."""
+    if isinstance(expr, ast.UnaryOp) and isinstance(expr.op, ast.Not):
+        for n in ast.walk(expr.operand):
+            if isinstance(n, ast.Call) \
+                    and _call_tail(n) == "is_main_process":
+                return True
+        return False
+    if isinstance(expr, ast.Compare) and len(expr.ops) == 1 \
+            and isinstance(expr.ops[0], ast.NotEq):
+        sides = [expr.left] + list(expr.comparators)
+        has_pidx = any(isinstance(s, ast.Call)
+                       and _call_tail(s) == "process_index" for s in sides)
+        has_zero = any(isinstance(s, ast.Constant) and s.value == 0
+                       for s in sides)
+        return has_pidx and has_zero
+    return False
+
+
+# --- rules ------------------------------------------------------------------
+
+class SpmdDivergenceRule(Rule):
+    name = "spmd-divergence"
+    description = ("collective schedule can diverge across hosts: a "
+                   "collective under a host-divergent predicate or in an "
+                   "asymmetric branch arm, an early exit skipping a later "
+                   "collective, or an unguarded checkpoint-artifact write "
+                   "(multi-host pod deadlock / artifact corruption)")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not _is_hot(module):
+            return
+        parents = _parent_map(module.tree)
+        sites = collect_sites(module)
+        site_nodes = {s.node: s for s in sites}
+
+        # (1) divergent-predicate reachability, per site
+        for s in sites:
+            reason = _divergent_context(s.node, parents)
+            if reason:
+                yield self.finding(
+                    module, s.node,
+                    f"{_KIND_DIVERGENT}: collective `{s.label}` is "
+                    f"reachable under a host-divergent predicate — "
+                    f"{reason} — one host can skip it and deadlock the "
+                    "pod; hoist the collective out of the branch (or "
+                    "suppress with a reason)")
+
+        # (2) collective in one branch arm but not the sibling
+        for node, scope in walk_with_qualname(module.tree):
+            if not isinstance(node, ast.If) or not node.orelse:
+                continue
+            if _test_is_static(node.test):
+                continue
+
+            def arm_sites(stmts) -> List[_Site]:
+                found = []
+                for st in stmts:
+                    for n in ast.walk(st):
+                        if n in site_nodes:
+                            found.append(site_nodes[n])
+                return found
+
+            body_s, else_s = arm_sites(node.body), arm_sites(node.orelse)
+            if bool(body_s) != bool(else_s):
+                present = body_s or else_s
+                arm = "if" if body_s else "else"
+                yield self.finding(
+                    module, node,
+                    f"{_KIND_ASYMMETRY}: collective "
+                    f"`{present[0].label}` in the `{arm}` arm has no "
+                    "counterpart in the sibling arm and the test is not "
+                    "a static config expression — hosts taking different "
+                    "arms issue different schedules; make the arms "
+                    "collective-symmetric (or suppress with a reason)")
+
+        # (3) early exit that can skip (or repeat) a later collective
+        sites_by_scope: Dict[str, List[_Site]] = {}
+        for s in sites:
+            sites_by_scope.setdefault(s.scope, []).append(s)
+        for node, scope in walk_with_qualname(module.tree):
+            if not isinstance(node, (ast.Return, ast.Raise, ast.Continue,
+                                     ast.Break)):
+                continue
+            in_scope = sites_by_scope.get(scope, ())
+            if not in_scope:
+                continue
+            line = getattr(node, "lineno", 0)
+            if isinstance(node, (ast.Continue, ast.Break)):
+                # a loop back-edge can REPEAT earlier sites in the loop
+                # body, so any site in the same scope counts
+                later = list(in_scope)
+            else:
+                later = [s for s in in_scope
+                         if getattr(s.node, "lineno", 0) > line]
+            if not later:
+                continue
+            reason = _divergent_context(node, parents)
+            if not reason:
+                continue
+            kw = {ast.Return: "return", ast.Raise: "raise",
+                  ast.Continue: "continue", ast.Break: "break"}[type(node)]
+            yield self.finding(
+                module, node,
+                f"{_KIND_SKIP}: early `{kw}` under {reason} can skip "
+                f"collective `{later[0].label}` (line "
+                f"{getattr(later[0].node, 'lineno', '?')}) on some hosts "
+                "while others issue it — the pod wedges in mismatched "
+                "collectives; make the exit uniform across hosts (or "
+                "suppress with a reason)")
+
+        # (4) checkpoint-artifact writes without the process-0 discipline
+        for node, scope in walk_with_qualname(module.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _call_tail(node) not in _WRITE_TAILS:
+                continue
+            if _main_guarded(node, parents):
+                continue
+            yield self.finding(
+                module, node,
+                f"{_KIND_CKPT}: checkpoint-artifact write "
+                f"`{call_name(node) or _call_tail(node)}(...)` is not "
+                "guarded by the process-0 discipline — N hosts racing "
+                "the same shared-directory file corrupt the artifact; "
+                "guard with `is_main_process()` (all hosts may still "
+                "compute, only process 0 writes) or suppress with a "
+                "reason")
+
+
+class SpmdCoverageRule(Rule):
+    name = "spmd-coverage"
+    description = ("raw host-blocking collective primitive outside any "
+                   "hangcheck collective_section — stall attribution and "
+                   "schedule recording cannot see it")
+
+    def check(self, module: ModuleInfo) -> Iterable[Finding]:
+        if not _is_hot(module):
+            return
+        parents = _parent_map(module.tree)
+        for s in collect_sites(module):
+            if s.kind != "primitive":
+                continue
+            covered = any(
+                isinstance(anc, ast.With) and _is_section_with(anc)
+                for anc, _ in _branch_ancestors(s.node, parents))
+            if not covered:
+                yield self.finding(
+                    module, s.node,
+                    f"raw collective primitive `{s.label}(...)` is not "
+                    "wrapped in a hangcheck `collective_section` — a "
+                    "wedge here is unattributable and the schedule "
+                    "recorder never sees the op; wrap it (or suppress "
+                    "with a reason)")
+
+
+def spmd_rules() -> List[Rule]:
+    """The spmdcheck rule set (analysis/spmdcheck.py runs these; they are
+    NOT in `default_rules` — the spmdcheck CLI/gate owns them, the
+    graphcheck precedent)."""
+    return [SpmdDivergenceRule(), SpmdCoverageRule()]

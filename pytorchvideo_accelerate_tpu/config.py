@@ -90,8 +90,8 @@ class DataConfig:
     synthetic: bool = False  # synthetic clips (test/bench fixture; SURVEY §4.4)
     synthetic_num_videos: int = 64
     num_frames: int = 8  # run.py:374 default; 32 in run_slowfast_r50.sh
-    sampling_rate: int = 8
-    frames_per_second: int = 30
+    sampling_rate: int = 8  # pva: disable=knob-read -- read via the clip_duration property below (the one derived config value)
+    frames_per_second: int = 30  # pva: disable=knob-read -- read via the clip_duration property below (the one derived config value)
     batch_size: int = 8  # per data-parallel shard, matching per-rank semantics
     # auto | thread | process (native shm decode workers). auto = threads:
     # cv2/numpy release the GIL and threads won every measurement made
@@ -139,7 +139,7 @@ class DataConfig:
     # TrainConfig.mixed_precision; "fp32" keeps float32 clips.
     host_cast: str = "auto"  # auto (bf16 host cast) | fp32 | u8 (ship raw
     # uint8, normalize in-graph on device: 4x less host->HBM transfer)
-    decode_audio: bool = False
+    decode_audio: bool = False  # pva: disable=knob-read -- reference-API parity knob; the audio pathway is not implemented yet
     # multi-view val: views/video with view-averaged logits (the reference's
     # uniform clip-tiling eval, run.py:163); 1 = single center clip
     eval_num_clips: int = 1
@@ -559,7 +559,7 @@ class TrainConfig:
     tracking: TrackingConfig = field(default_factory=TrackingConfig)
     serve: ServeConfig = field(default_factory=ServeConfig)
     fleet: FleetConfig = field(default_factory=FleetConfig)
-    control: ControlConfig = field(default_factory=ControlConfig)
+    control: ControlConfig = field(default_factory=ControlConfig)  # pva: disable=knob-read -- control-plane dials ride TrainConfig for dotted-key CLI parsing; the fleet runner (ROADMAP 4/5) consumes the block
     obs: ObsConfig = field(default_factory=ObsConfig)
     reliability: ReliabilityConfig = field(default_factory=ReliabilityConfig)
     guard: GuardConfig = field(default_factory=GuardConfig)

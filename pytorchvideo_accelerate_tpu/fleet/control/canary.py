@@ -55,9 +55,21 @@ class CanaryController:
     # counter keys whose DELTA since cutover feeds the verdict
     _DELTA_KEYS = ("requests", "errors", "shed", "rejected")
 
-    def __init__(self, router, *, fraction: float = 0.25,
-                 threshold: float = 0.2, rollback_after: int = 2,
+    def __init__(self, router, *, fraction: Optional[float] = None,
+                 threshold: Optional[float] = None,
+                 rollback_after: Optional[int] = None,
                  prewarm: bool = True, fleet=None):
+        # dial defaults are the control.* config block's (single source
+        # of truth for the canary discipline)
+        from pytorchvideo_accelerate_tpu.config import ControlConfig
+
+        dials = ControlConfig()
+        if fraction is None:
+            fraction = dials.canary_fraction
+        if threshold is None:
+            threshold = dials.canary_threshold
+        if rollback_after is None:
+            rollback_after = dials.canary_rollback_after
         if not 0.0 < fraction <= 1.0:
             raise ValueError(f"canary fraction must be in (0, 1], "
                              f"got {fraction}")

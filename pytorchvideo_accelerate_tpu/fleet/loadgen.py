@@ -38,6 +38,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
+from pytorchvideo_accelerate_tpu.config import FleetConfig
 from pytorchvideo_accelerate_tpu.obs import trace
 from pytorchvideo_accelerate_tpu.serving.batcher import QueueFullError
 from pytorchvideo_accelerate_tpu.serving.stats import _percentile
@@ -535,9 +536,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                     "tier; see docs/SERVING.md § load harness")
     ap.add_argument("--url", default="",
                     help="endpoint base URL (e.g. http://127.0.0.1:8100)")
-    ap.add_argument("--rps", type=float, default=50.0,
+    # harness defaults are the fleet.* config block's (single source of
+    # truth; the block documents itself as "load harness defaults")
+    fleet_defaults = FleetConfig()
+    ap.add_argument("--rps", type=float, default=fleet_defaults.loadgen_rps,
                     help="offered arrival rate (Poisson)")
-    ap.add_argument("--duration", type=float, default=10.0,
+    ap.add_argument("--duration", type=float,
+                    default=fleet_defaults.loadgen_duration_s,
                     help="arrival window seconds")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--priority", choices=("realtime", "batch"),

@@ -71,9 +71,14 @@ class Router:
     # with a 400-shaped ValueError the client sees)
     supports_sessions = True
 
-    def __init__(self, pool: ReplicaPool, *, retries: int = 2,
+    def __init__(self, pool: ReplicaPool, *, retries: Optional[int] = None,
                  retry_after_s: float = 1.0, registry=None):
         self.pool = pool
+        if retries is None:
+            # single source of truth for the tier default: fleet.route_retries
+            from pytorchvideo_accelerate_tpu.config import FleetConfig
+
+            retries = FleetConfig().route_retries
         self.retries = max(int(retries), 0)
         self.retry_after_s = float(retry_after_s)
         self.registry = registry if registry is not None else obs.get_registry()

@@ -114,9 +114,14 @@ def check_desync(fingerprint: float, name: str = "train_state") -> None:
     import numpy as np
     from jax.experimental import multihost_utils
 
-    vals = np.asarray(
-        multihost_utils.process_allgather(np.float32(fingerprint))
-    ).reshape(-1)
+    from pytorchvideo_accelerate_tpu.parallel.hangcheck import (
+        collective_section,
+    )
+
+    with collective_section("desync_check", name=name):
+        vals = np.asarray(
+            multihost_utils.process_allgather(np.float32(fingerprint))
+        ).reshape(-1)
     # equal_nan: all-NaN means the run diverged IDENTICALLY everywhere —
     # that's a NaN problem (debug_nans territory), not a desync
     agree = np.all((vals == vals[0]) | (np.isnan(vals) & np.isnan(vals[0])))
@@ -134,4 +139,9 @@ def sync_global_devices(name: str = "barrier") -> None:
     if jax.process_count() > 1:
         from jax.experimental import multihost_utils
 
-        multihost_utils.sync_global_devices(name)
+        from pytorchvideo_accelerate_tpu.parallel.hangcheck import (
+            collective_section,
+        )
+
+        with collective_section("barrier", name=name):
+            multihost_utils.sync_global_devices(name)

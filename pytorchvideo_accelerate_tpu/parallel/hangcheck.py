@@ -19,11 +19,17 @@ collective from slow input (whose stall attributes to the prefetchers'
 components instead). See docs/RELIABILITY.md § collective hangs.
 
 Disarmed (no watchdog installed — the default), `collective_section` costs
-two module-global reads (the watchdog slot and the `collective.sync` fault
-point) and yields straight through: the `utils/sync.py` / `faults.py`
-zero-overhead discipline. The `collective.sync` fault point (kind
-``delay``) is how `pva-tpu-chaos`'s wedged-collective leg manufactures the
-straggler deterministically.
+three module-global reads (the watchdog slot, the schedule-recorder slot,
+and the `collective.sync` fault point) and yields straight through: the
+`utils/sync.py` / `faults.py` zero-overhead discipline. The
+`collective.sync` fault point (kind ``delay``) is how `pva-tpu-chaos`'s
+wedged-collective leg manufactures the straggler deterministically.
+
+The schedule-recorder slot is `pva-tpu-spmdcheck`'s dynamic half
+(parallel/schedule_recorder.py): armed, every section entry appends one
+(tick, op, detail) record under the current host label, so a cross-host
+diff can name the first collective one host issued that another never
+did — the divergence evidence the static pass cannot produce.
 """
 
 from __future__ import annotations
@@ -39,6 +45,18 @@ from pytorchvideo_accelerate_tpu.reliability.faults import fault_point
 COMPONENT = "collective"
 
 _watchdog = None
+# pva-tpu-spmdcheck's schedule recorder (parallel/schedule_recorder.py);
+# installed/uninstalled ONLY through that module's install helpers
+_recorder = None
+
+
+def _set_schedule_recorder(recorder) -> None:
+    global _recorder
+    _recorder = recorder
+
+
+def _schedule_recorder():
+    return _recorder
 
 
 def install_collective_watch(watchdog) -> None:
@@ -79,6 +97,11 @@ def collective_section(op: str, **info):
     indistinguishable from a real straggler to the detector — the chaos
     leg's whole point."""
     wd = _watchdog
+    rec = _recorder
+    if rec is not None:
+        # record at ENTRY: issue order (not completion order) is the
+        # schedule a pod's hosts must agree on
+        rec.record(op, "".join(f" {k}={v}" for k, v in info.items()).strip())
     if wd is None:
         fault_point("collective.sync")
         yield

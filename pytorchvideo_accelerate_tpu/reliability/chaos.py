@@ -1802,11 +1802,35 @@ finally:
     faults.disarm()
 elapsed = time.monotonic() - t0
 wd.stop()
+# second diagnostic on the SAME op: the schedule recorder
+# (parallel/schedule_recorder.py) must name the psum one emulated host
+# skips — the watchdog says WHERE the pod wedged, the recorder says WHY
+from pytorchvideo_accelerate_tpu.parallel import schedule_recorder as sr
+rec = sr.CollectiveScheduleRecorder()
+sr.install_schedule_recorder(rec)
+try:
+    for h in range(2):
+        with rec.as_host(f"host={{h}}/2"):
+            with hangcheck.collective_section("step_dispatch", step=1):
+                pass
+            if h == 0:  # host 1 SKIPS the psum — the deadlock shape
+                with hangcheck.collective_section("psum", step=1):
+                    float(np.asarray(f(x)).ravel()[0])
+            with hangcheck.collective_section("epoch_sync"):
+                pass
+    div = sr.diff_schedules(rec.schedules())
+finally:
+    sr.uninstall_schedule_recorder()
+first = div.get("first_divergence") or {{}}
 print("\\n" + json.dumps({{
     "stalled": evidence.get("stalled"),
     "attribution": evidence.get("attribution"),
     "elapsed_s": round(elapsed, 3),
-    "fires": len(faults.fault_history()), "psum": out, "warm": warm}}))
+    "fires": len(faults.fault_history()), "psum": out, "warm": warm,
+    "sched_diverged": div.get("diverged"),
+    "sched_tick": first.get("tick"),
+    "sched_ops": {{h: (e[1] if e else None)
+                   for h, e in (first.get("hosts") or {{}}).items()}}}}))
 """
 
 _HANG_LEG_DEVICES = 4
@@ -1850,8 +1874,21 @@ def leg_collective_hang(report: dict, seed: int, log: Log) -> None:
     if out.get("psum") != float(_HANG_LEG_DEVICES):
         _finding(report, "collective_hang",
                  f"psum returned {out.get('psum')} after the wedge")
+    # the recorder's first-divergence must name the SAME op the watchdog
+    # attributed, on the host that skipped it — the two diagnostics are
+    # pinned to each other so they can't drift apart
+    ops = out.get("sched_ops") or {}
+    if not (out.get("sched_diverged") is True
+            and ops.get("host=0/2") == "psum"
+            and ops.get("host=1/2") != "psum"
+            and "host=1/2" in ops):
+        _finding(report, "collective_hang",
+                 f"schedule recorder did not name the skipped psum per "
+                 f"host: diverged={out.get('sched_diverged')} ops={ops}")
     log(f"[chaos] collective_hang: watchdog attributed the wedge to "
-        f"{detail!r} before the {_HANG_LEG_WEDGE_S}s delay released")
+        f"{detail!r} before the {_HANG_LEG_WEDGE_S}s delay released; "
+        f"recorder first-divergence at tick {out.get('sched_tick')} "
+        f"names {ops}")
 
 
 def leg_sigterm_plumbing(report: dict, log: Log) -> None:

@@ -32,6 +32,8 @@ from typing import Any, Optional, Tuple
 import jax
 import orbax.checkpoint as ocp
 
+from pytorchvideo_accelerate_tpu.parallel.distributed import is_main_process
+from pytorchvideo_accelerate_tpu.parallel.hangcheck import collective_section
 from pytorchvideo_accelerate_tpu.reliability.atomic import (
     atomic_write,
     atomic_write_json,
@@ -83,17 +85,16 @@ def export_inference(path: str, state, config=None,
         raise ValueError(
             f"export quantization must be one of {QUANT_MODES}, got "
             f"{quantization!r}")
-    os.makedirs(path, exist_ok=True)
+    # every host participates in the value fetch (device_get of sharded
+    # leaves is a collective), but the artifact hits the shared directory
+    # from process 0 ONLY — N hosts racing atomic_write on the same path
+    # is artifact corruption (spmd-divergence ckpt-discipline)
     params = state.ema_params if state.ema_params is not None else state.params
     tree = jax.device_get({"params": params,
                            "batch_stats": state.batch_stats or {}})
     if quantization == "int8":
         tree["params"], n_q = quantize_tree(tree["params"])
         logger.info("export: quantized %d weight leaves to int8", n_q)
-    retry_call(
-        lambda: atomic_write(os.path.join(path, _WEIGHTS_FILE),
-                             lambda tmp: save_converted(tree, tmp)),
-        name="ckpt.write", retry_on=(OSError,))
     info = {
         "format": INFERENCE_FORMAT,
         "step": int(jax.device_get(state.step)),
@@ -103,11 +104,17 @@ def export_inference(path: str, state, config=None,
     }
     if config is not None:
         info["config"] = config.to_dict()
-    retry_call(
-        lambda: atomic_write_json(os.path.join(path, _META_FILE), info),
-        name="ckpt.write", retry_on=(OSError,))
-    logger.info("exported inference artifact to %s (step %d, ema=%s)",
-                path, info["step"], info["ema_resolved"])
+    if is_main_process():
+        os.makedirs(path, exist_ok=True)
+        retry_call(
+            lambda: atomic_write(os.path.join(path, _WEIGHTS_FILE),
+                                 lambda tmp: save_converted(tree, tmp)),
+            name="ckpt.write", retry_on=(OSError,))
+        retry_call(
+            lambda: atomic_write_json(os.path.join(path, _META_FILE), info),
+            name="ckpt.write", retry_on=(OSError,))
+        logger.info("exported inference artifact to %s (step %d, ema=%s)",
+                    path, info["step"], info["ema_resolved"])
     return path
 
 
@@ -178,13 +185,17 @@ class Checkpointer:
             # mainly protects the sync path, e.g. the emergency save.)
             if step in (self._mgr.all_steps() or ()):
                 return
-            self._mgr.save(
-                step,
-                args=ocp.args.Composite(
-                    state=ocp.args.StandardSave(state),
-                    extra=ocp.args.JsonSave(extra or {}),
-                ),
-            )
+            # the orbax save dispatch is a cross-host barrier (all
+            # processes coordinate the composite write): attributed +
+            # schedule-recorded like every host-blocking collective
+            with collective_section("ckpt_save", step=step):
+                self._mgr.save(
+                    step,
+                    args=ocp.args.Composite(
+                        state=ocp.args.StandardSave(state),
+                        extra=ocp.args.JsonSave(extra or {}),
+                    ),
+                )
 
         retry_call(save_once, name="ckpt.save", attempts=self.retries,
                    retry_on=(OSError,),
@@ -268,16 +279,24 @@ class Checkpointer:
         first_err: Optional[BaseException] = None
         for i, s in enumerate(candidates):
             try:
-                restored = self._mgr.restore(
-                    int(s),
-                    args=ocp.args.Composite(
-                        state=ocp.args.StandardRestore(state_template),
-                        extra=ocp.args.JsonRestore(),
-                    ),
-                )
+                with collective_section("ckpt_restore", step=int(s)):
+                    restored = self._mgr.restore(
+                        int(s),
+                        args=ocp.args.Composite(
+                            state=ocp.args.StandardRestore(state_template),
+                            extra=ocp.args.JsonRestore(),
+                        ),
+                    )
             except Exception as e:  # noqa: BLE001 - classified below
                 first_err = first_err or e
-                if i + 1 < len(candidates):
+                # The walk-back is a SINGLE-PROCESS recovery: readability
+                # is per-host (a torn mount on one host), so on a pod the
+                # hosts could pick DIFFERENT fallback steps and wedge in
+                # mismatched ckpt_restore collectives. Multi-process, fail
+                # loudly instead — the gate is uniform (process_count), so
+                # every surviving host raises out of its own restore or
+                # times out attributably in the hangcheck section above.
+                if i + 1 < len(candidates) and jax.process_count() == 1:
                     logger.warning(
                         "checkpoint step %s in %s is unreadable (%s: %s); "
                         "falling back to step %s",
@@ -294,7 +313,7 @@ class Checkpointer:
                             error=f"{type(e).__name__}: {e}"[:200])
                     except Exception:  # pragma: no cover - obs optional
                         pass
-                    continue
+                    continue  # pva: disable=spmd-divergence -- single-process only: the process_count()==1 gate above is uniform, pods raise instead of walking back
                 if isinstance(first_err, (ValueError, KeyError)):
                     # a structure/shape mismatch usually means an older
                     # model layout (0.4 changed videomae_b/mvit_b trees) —
@@ -343,11 +362,13 @@ class Checkpointer:
         self._mgr.delete(int(step))
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        with collective_section("ckpt_wait"):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        with collective_section("ckpt_close"):
+            self._mgr.wait_until_finished()
+            self._mgr.close()
 
 
 def resolve_resume_path(resume: str, output_dir: str) -> Optional[str]:

@@ -1471,7 +1471,7 @@ class Trainer:
                 recorder.record("exception", type(e).__name__,
                                 message=str(e)[:500], step=gstep)
                 recorder.dump()  # install(output_dir) set the destination
-            raise
+            raise  # pva: disable=spmd-divergence -- crash path: this host is already dying; surviving hosts wedge ATTRIBUTABLY in their next hangcheck section
         finally:
             # flush a partial trace even when the run dies mid-window —
             # that trace is most valuable exactly when diagnosing a crash

@@ -421,6 +421,25 @@ def graphcheck_snapshot() -> dict:
     return out
 
 
+def spmd_snapshot() -> dict:
+    """Collective-schedule divergence health (analysis/spmdcheck.py —
+    docs/STATIC_ANALYSIS.md § spmdcheck): the last in-process static
+    pass's finding counts by rule/kind plus the live schedule recorder's
+    per-host record counts (recorder=None when disarmed). ran=False in a
+    fresh process — the static pass is cheap but the doctor reports
+    state, it doesn't mint it."""
+    out: dict = {"ts": _utcnow()}
+    try:
+        from pytorchvideo_accelerate_tpu.analysis.spmdcheck import (
+            spmd_snapshot as _snap,
+        )
+
+        out.update(_snap())
+    except Exception as e:  # the doctor must never die of its own probes
+        out["error"] = f"{type(e).__name__}: {e}"
+    return out
+
+
 def memory_snapshot() -> dict:
     """Device-memory ledger health (obs/memory.py — docs/OBSERVABILITY.md
     § memory ledger): per-component registered bytes, the unattributed
@@ -477,6 +496,7 @@ def diagnose(timeout_s: int = 120, skip_init: bool = False,
         "trace": trace_snapshot(),
         "lint": lint_snapshot(),
         "graphcheck": graphcheck_snapshot(),
+        "spmd": spmd_snapshot(),
         "tsan": tsan_snapshot(),
         "reliability": reliability_snapshot(obs_dir),
         "guard": guard_snapshot(obs_dir),

@@ -4,8 +4,9 @@
 # stress pass (lockset races + lock-order cycles over the threaded
 # data/train/serve layers), the pva-tpu-graphcheck jaxpr/HLO passes over
 # the real train/eval/serve steps (donation aliasing, dtype policy,
-# sharding propagation, analytic FLOPs), then the pva-tpu-chaos
-# fault-injection
+# sharding propagation, analytic FLOPs), the pva-tpu-spmdcheck
+# collective-schedule divergence pass (multi-host readiness), then the
+# pva-tpu-chaos fault-injection
 # scenario (retry/preemption/shedding recovery asserted under seeded
 # faults — including the PR-9 self-healing legs: guard_nan NaN-rollback,
 # corrupt-clip quarantine, and the wedged-collective hang detector).
@@ -31,6 +32,15 @@ env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
 env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
   JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
   python -m pytorchvideo_accelerate_tpu.analysis.graphcheck
+
+# collective-schedule divergence gate (docs/STATIC_ANALYSIS.md
+# § spmdcheck): the spmd-divergence kinds (divergent predicates,
+# asymmetric branches, skip paths, checkpoint-write discipline) plus the
+# collective_section coverage audit over the hot modules — the
+# multi-host pod runtime's precondition; exit 1 on any finding
+env PYTHONPATH="${ROOT}${PYTHONPATH:+:${PYTHONPATH}}" \
+  JAX_PLATFORMS="${JAX_PLATFORMS:-cpu}" \
+  python -m pytorchvideo_accelerate_tpu.analysis.spmdcheck
 
 # fused-kernel parity gate (docs/KERNELS.md): pva-tpu-kbench --smoke
 # asserts every fused Pallas/folded kernel matches its XLA reference

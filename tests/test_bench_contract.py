@@ -142,7 +142,8 @@ def test_finalize_line_fits_driver_capture():
         "mfu_analytic": 0.1234, "mfu_source": "costmodel",
         "mfu_peak_source": "measured",
         "multichip_mfu_peak_source": "measured",
-        "graphcheck_findings": 0,
+        "graphcheck_findings": 0, "spmdcheck_findings": 0,
+        "spmd_schedule_divergence": 0, "spmd_divergence_detected": True,
         "obs_step_s": 0.012345, "obs_input_wait_frac": 0.0123,
         "obs_h2d_s": 0.001234, "train_recompiles": 0, "tsan_findings": 0,
         "chaos_findings": 0, "guard_rollbacks": 0, "quarantined_clips": 0,
@@ -285,6 +286,62 @@ def test_finalize_multichip_keys_ride_the_headline():
         user_smoke=False)
     assert out["multichip_error"] == "cpu fallback"
     assert "multichip_cps_per_chip" not in out
+
+
+def test_finalize_spmdcheck_findings_ride_the_headline():
+    """The collective-schedule static verdict (pva-tpu-spmdcheck;
+    analysis/spmdcheck.py) plumbs through finalize onto the headline
+    line — the number `--smoke` asserts 0 at the gate site."""
+    out = bench.finalize(_model(), {"spmdcheck_findings": 0},
+                         user_smoke=False)
+    assert out["spmdcheck_findings"] == 0
+    out = bench.finalize(_model(), {"spmdcheck_findings": 5},
+                         user_smoke=False)
+    assert out["spmdcheck_findings"] == 5
+
+
+def test_finalize_spmd_schedule_verdicts_ride_the_headline():
+    """The MULTICHIP lane's dynamic schedule verdicts
+    (spmd_schedule_divergence — hosts that drifted, asserted 0 — and
+    spmd_divergence_detected — the seeded-skew proof the differ is not
+    blind, asserted True) plumb through finalize, and like mesh_parity
+    they are VERDICTS: a suspect lane's refusal sheds the perf keys but
+    never these."""
+    extras = {"spmd_schedule_divergence": 0,
+              "spmd_divergence_detected": True,
+              "multichip_cps_per_chip": {"1": 10.0, "8": 9.5}}
+    out = bench.finalize(_model(), extras, user_smoke=False)
+    assert out["spmd_schedule_divergence"] == 0
+    assert out["spmd_divergence_detected"] is True
+    # refusal: perf keys shed, the schedule verdicts retained
+    out = bench.finalize(
+        _model(), {"spmd_schedule_divergence": 0,
+                   "spmd_divergence_detected": True,
+                   "multichip_cps_per_chip": {"1": 10.0},
+                   "multichip_error": "cpu fallback"},
+        user_smoke=False)
+    assert out["multichip_error"] == "cpu fallback"
+    assert "multichip_cps_per_chip" not in out
+    assert out["spmd_schedule_divergence"] == 0
+    assert out["spmd_divergence_detected"] is True
+
+
+def test_finalize_spmd_keys_shed_before_mesh_verdicts():
+    """In the size-shed ladder the spmd schedule verdicts drop just
+    before the mesh verdicts (first-listed sheds first): a line too fat
+    for the capture window keeps mesh_parity longest, and the static
+    spmdcheck_findings count is not in the shed ladder at all — it rides
+    to the end like the other gate counts."""
+    import inspect
+
+    src = inspect.getsource(bench.finalize)
+    shed_start = src.index('"probes", "trace_overhead_frac"')
+    i_det = src.index('"spmd_divergence_detected"', shed_start)
+    i_div = src.index('"spmd_schedule_divergence"', shed_start)
+    i_port = src.index('"mesh_ckpt_portable"', shed_start)
+    i_par = src.index('"mesh_parity"', shed_start)
+    assert i_det < i_div < i_port < i_par
+    assert '"spmdcheck_findings"' not in src[shed_start:]
 
 
 def test_finalize_pipeline_keys_ride_the_headline():

@@ -1,0 +1,432 @@
+"""pva-tpu-spmdcheck (analysis/rules_spmd + analysis/spmdcheck +
+parallel/schedule_recorder): one seeded violation + one suppressed twin
+per static rule kind, the knob-read lint rule, the schedule recorder's
+seeded-divergence evidence payload, the clean-run non-vacuity check, the
+disarmed zero-overhead contract, CLI exit codes (incl. --selftest), the
+doctor snapshot, and the full-tree clean gate.
+
+Late-alphabet name on purpose: tier-1 is timeout-bound and kills
+mid-suite — the package-wide static pass lives behind ONE module-scoped
+fixture shared by every gate assertion.
+"""
+
+import os
+
+import pytest
+
+from pytorchvideo_accelerate_tpu.analysis.core import (
+    default_rules,
+    lint_source,
+    run_lint,
+)
+from pytorchvideo_accelerate_tpu.analysis.rules_knob import KnobReadRule
+from pytorchvideo_accelerate_tpu.analysis.rules_spmd import spmd_rules
+from pytorchvideo_accelerate_tpu.analysis.spmdcheck import (
+    finding_count,
+    main as spmdcheck_main,
+    run_spmdcheck,
+    spmd_snapshot,
+)
+from pytorchvideo_accelerate_tpu.parallel.hangcheck import collective_section
+from pytorchvideo_accelerate_tpu.parallel.schedule_recorder import (
+    CollectiveScheduleRecorder,
+    current_recorder,
+    diff_schedules,
+    format_divergence,
+    install_schedule_recorder,
+    uninstall_schedule_recorder,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+PKG = os.path.join(REPO, "pytorchvideo_accelerate_tpu")
+
+# a hot-module path anchors the fixtures inside the rules' gated surface
+FIX = "pytorchvideo_accelerate_tpu/trainer/_zspmd_fixture.py"
+
+
+def _kinds(findings):
+    return [f.message.split(":", 1)[0] for f in findings
+            if f.rule == "spmd-divergence"]
+
+
+def _lint(src):
+    return lint_source(src, FIX, spmd_rules())
+
+
+# --- static rules: one positive + one suppressed twin per kind --------------
+
+def test_divergent_predicate_positive_and_suppressed():
+    seed = (
+        "import jax\n"
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def resume(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        host_broadcast(x)\n")
+    assert "divergent-predicate" in _kinds(_lint(seed))
+    suppressed = seed.replace(
+        "host_broadcast(x)\n",
+        "host_broadcast(x)  # pva: disable=spmd-divergence -- test seed\n")
+    assert not _lint(suppressed)
+
+
+def test_divergent_predicate_uniform_guard_clean():
+    # the one guard every multi-host call site uses must NOT alarm
+    clean = (
+        "import jax\n"
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def resume(x):\n"
+        "    if jax.process_count() > 1:\n"
+        "        host_broadcast(x)\n")
+    assert not _lint(clean)
+
+
+def test_divergent_predicate_fs_env_clock_rng_atoms():
+    tmpl = (
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "{imports}"
+        "def go(x):\n"
+        "    if {test}:\n"
+        "        host_broadcast(x)\n")
+    cases = [
+        ("import os\n", "os.path.exists('/tmp/m')"),
+        ("import os\n", "os.environ.get('RANK')"),
+        ("import time\n", "time.time() > 0"),
+        ("import random\n", "random.random() < 0.5"),
+    ]
+    for imports, test in cases:
+        f = _lint(tmpl.format(imports=imports, test=test))
+        assert "divergent-predicate" in _kinds(f), test
+
+
+def test_exception_path_is_divergent():
+    src = (
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def go(x):\n"
+        "    try:\n"
+        "        load(x)\n"
+        "    except OSError:\n"
+        "        host_broadcast(x)\n")
+    assert "divergent-predicate" in _kinds(_lint(src))
+
+
+def test_branch_asymmetry_positive_suppressed_and_symmetric():
+    seed = (
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def maybe(x, m):\n"
+        "    if load_manifest(m):\n"
+        "        host_broadcast(x)\n"
+        "    else:\n"
+        "        log_skip(m)\n")
+    assert "branch-asymmetry" in _kinds(_lint(seed))
+    suppressed = seed.replace(
+        "    if load_manifest(m):",
+        "    if load_manifest(m):"
+        "  # pva: disable=spmd-divergence -- test seed")
+    assert not _lint(suppressed)
+    symmetric = seed.replace("log_skip(m)", "host_broadcast(x)")
+    assert not _lint(symmetric)
+
+
+def test_skip_path_positive_suppressed_and_uniform():
+    seed = (
+        "import os\n"
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def sync(x):\n"
+        "    if not os.path.exists('/tmp/marker'):\n"
+        "        return None\n"
+        "    host_broadcast(x)\n")
+    assert "skip-path" in _kinds(_lint(seed))
+    suppressed = seed.replace(
+        "        return None\n",
+        "        return None"
+        "  # pva: disable=spmd-divergence -- test seed\n")
+    assert not _lint(suppressed)
+    # a bare-name test is uniform-by-convention (no divergent atom)
+    uniform = (
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def sync(x, ready):\n"
+        "    if not ready:\n"
+        "        return None\n"
+        "    host_broadcast(x)\n")
+    assert not _lint(uniform)
+
+
+def test_ckpt_discipline_positive_suppressed_and_guarded():
+    seed = (
+        "from pytorchvideo_accelerate_tpu.reliability.atomic import"
+        " atomic_write_json\n"
+        "def export(tree, path):\n"
+        "    atomic_write_json(path, tree)\n")
+    f = _lint(seed)
+    assert "ckpt-discipline" in _kinds(f)
+    suppressed = seed.replace(
+        "    atomic_write_json(path, tree)\n",
+        "    atomic_write_json(path, tree)"
+        "  # pva: disable=spmd-divergence -- test seed\n")
+    assert not _lint(suppressed)
+    guarded = (
+        "from pytorchvideo_accelerate_tpu.parallel.distributed import"
+        " is_main_process\n"
+        "from pytorchvideo_accelerate_tpu.reliability.atomic import"
+        " atomic_write_json\n"
+        "def export(tree, path):\n"
+        "    if is_main_process():\n"
+        "        atomic_write_json(path, tree)\n")
+    assert not _lint(guarded)
+
+
+def test_interprocedural_carrier_one_level():
+    src = (
+        "import jax\n"
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def _bcast_helper(x):\n"
+        "    host_broadcast(x)\n"
+        "def run(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        _bcast_helper(x)\n")
+    f = _lint(src)
+    assert any("_bcast_helper" in x.message for x in f)
+
+
+def test_coverage_positive_suppressed_and_wrapped():
+    seed = (
+        "from jax.experimental import multihost_utils\n"
+        "def barrier():\n"
+        "    multihost_utils.sync_global_devices('fence')\n")
+    f = _lint(seed)
+    assert any(x.rule == "spmd-coverage" for x in f)
+    suppressed = seed.replace(
+        "    multihost_utils.sync_global_devices('fence')\n",
+        "    multihost_utils.sync_global_devices('fence')"
+        "  # pva: disable=spmd-coverage -- test seed\n")
+    assert not any(x.rule == "spmd-coverage"
+                   for x in _lint(suppressed))
+    wrapped = (
+        "from jax.experimental import multihost_utils\n"
+        "from pytorchvideo_accelerate_tpu.parallel.hangcheck import"
+        " collective_section\n"
+        "def barrier():\n"
+        "    with collective_section('barrier', name='fence'):\n"
+        "        multihost_utils.sync_global_devices('fence')\n")
+    assert not _lint(wrapped)
+
+
+def test_non_hot_module_not_gated():
+    # the rules patrol the hot modules only; utility code stays out
+    seed = (
+        "import jax\n"
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def resume(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        host_broadcast(x)\n")
+    cold = lint_source(
+        seed, "pytorchvideo_accelerate_tpu/utils/_zspmd_fixture.py",
+        spmd_rules())
+    assert not cold
+
+
+def test_traced_scope_exempt_from_lax_host():
+    src = (
+        "import jax\n"
+        "from jax import lax\n"
+        "@jax.jit\n"
+        "def step(x):\n"
+        "    return lax.psum(x, 'data')\n")
+    assert not _lint(src)
+
+
+# --- knob-read lint rule ----------------------------------------------------
+
+KNOB_FIX = "/nonexistent_zspmd_fixture/pytorchvideo_accelerate_tpu/config.py"
+
+
+def test_knob_read_unread_field_flagged_and_suppressed():
+    seed = (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class TrainConfig:\n"
+        "    dead_knob: int = 0\n")
+    f = lint_source(seed, KNOB_FIX, [KnobReadRule()])
+    assert any(x.rule == "knob-read" and "dead_knob" in x.message
+               for x in f)
+    suppressed = seed.replace(
+        "    dead_knob: int = 0\n",
+        "    dead_knob: int = 0"
+        "  # pva: disable=knob-read -- consumed by a later PR\n")
+    assert not lint_source(suppressed, KNOB_FIX, [KnobReadRule()])
+
+
+def test_knob_read_private_and_non_config_classes_exempt():
+    src = (
+        "from dataclasses import dataclass\n"
+        "@dataclass\n"
+        "class TrainConfig:\n"
+        "    _internal: int = 0\n"
+        "@dataclass\n"
+        "class NotAKnobBlock:\n"
+        "    unread: int = 0\n")
+    assert not lint_source(src, KNOB_FIX, [KnobReadRule()])
+
+
+def test_knob_read_in_default_rules_and_real_config_clean():
+    assert any(r.name == "knob-read" for r in default_rules())
+    findings = run_lint([os.path.join(PKG, "config.py")],
+                        [KnobReadRule()])
+    assert findings == [], [f.format() for f in findings]
+
+
+# --- dynamic: schedule recorder + differ ------------------------------------
+
+def test_recorder_clean_run_non_vacuous():
+    rec = CollectiveScheduleRecorder()
+    install_schedule_recorder(rec)
+    try:
+        for h in range(3):
+            with rec.as_host(f"host={h}/3"):
+                for i in range(5):
+                    with collective_section("step_dispatch", step=i):
+                        pass
+                with collective_section("epoch_sync"):
+                    pass
+        report = diff_schedules(rec.schedules())
+    finally:
+        uninstall_schedule_recorder()
+    assert report["diverged"] is False
+    assert report["divergence_count"] == 0
+    # non-vacuity: a clean verdict over an empty recorder gates nothing
+    assert all(n >= 6 for n in report["lengths"].values())
+    assert len(report["hosts"]) == 3
+    assert "identical" in format_divergence(report)
+
+
+def test_seeded_divergence_detected_with_evidence():
+    rec = CollectiveScheduleRecorder()
+    install_schedule_recorder(rec)
+    try:
+        for h in range(2):
+            with rec.as_host(f"host={h}/2"):
+                with collective_section("step_dispatch", step=0):
+                    pass
+                if h == 0:  # host 1 skips — the pod-deadlock shape
+                    with collective_section("epoch_sync"):
+                        pass
+                with collective_section("ckpt_save", step=0):
+                    pass
+        report = diff_schedules(rec.schedules())
+    finally:
+        uninstall_schedule_recorder()
+    assert report["diverged"] is True
+    first = report["first_divergence"]
+    assert first["tick"] == 1
+    assert first["hosts"]["host=0/2"][1] == "epoch_sync"
+    assert first["hosts"]["host=1/2"][1] == "ckpt_save"
+    # the trailing windows carry enough context to read the drift
+    assert len(first["window"]["host=0/2"]) >= 2
+    text = format_divergence(report)
+    assert "epoch_sync" in text and "tick 1" in text
+
+
+def test_short_schedule_counts_as_divergence():
+    # a host whose schedule simply ENDS early is the skipped-collective
+    # deadlock, not a benign short run
+    sched = {
+        "host=0/2": [(0, "step_dispatch", ""), (1, "epoch_sync", "")],
+        "host=1/2": [(0, "step_dispatch", "")],
+    }
+    report = diff_schedules(sched)
+    assert report["diverged"] is True
+    assert report["first_divergence"]["tick"] == 1
+    assert report["first_divergence"]["hosts"]["host=1/2"] is None
+    assert "schedule ended" in format_divergence(report)
+
+
+def test_detail_mismatch_is_divergence():
+    sched = {
+        "host=0/2": [(0, "ckpt_save", "step=10")],
+        "host=1/2": [(0, "ckpt_save", "step=20")],
+    }
+    assert diff_schedules(sched)["diverged"] is True
+
+
+def test_disarmed_section_records_nothing():
+    assert current_recorder() is None
+    rec = CollectiveScheduleRecorder()
+    with collective_section("step_dispatch", step=0):
+        pass
+    assert rec.counts() == {}  # never installed, never recorded
+    # and install/uninstall round-trips the hook slot
+    install_schedule_recorder(rec)
+    try:
+        assert current_recorder() is rec
+        with collective_section("step_dispatch", step=1):
+            pass
+    finally:
+        uninstall_schedule_recorder()
+    assert current_recorder() is None
+    assert sum(rec.counts().values()) == 1
+
+
+# --- gates: full tree, CLI, selftest, doctor --------------------------------
+
+@pytest.fixture(scope="module")
+def tree_report():
+    """ONE package-wide static pass shared by the gate assertions."""
+    return run_spmdcheck(paths=[PKG])
+
+
+def test_full_tree_clean(tree_report):
+    assert finding_count(tree_report) == 0, tree_report["findings"]
+
+
+def test_report_shape(tree_report):
+    assert tree_report["by_rule"] == {}
+    assert tree_report["by_kind"] == {}
+    assert tree_report["elapsed_s"] >= 0
+
+
+def test_doctor_snapshot(tree_report):
+    snap = spmd_snapshot()
+    assert snap["ran"] is True
+    assert snap["findings_total"] == 0
+    from pytorchvideo_accelerate_tpu.utils.device_doctor import (
+        spmd_snapshot as doctor_snap,
+    )
+    d = doctor_snap()
+    assert d.get("ran") is True and "ts" in d
+
+
+def test_cli_exit_codes(tmp_path, capsys):
+    # 0: clean file
+    clean_dir = tmp_path / "pytorchvideo_accelerate_tpu" / "trainer"
+    clean_dir.mkdir(parents=True)
+    clean = clean_dir / "clean.py"
+    clean.write_text("def ok():\n    return 1\n")
+    assert spmdcheck_main([str(clean)]) == 0
+    capsys.readouterr()
+    # 1: seeded violation at a hot path
+    bad = clean_dir / "bad.py"
+    bad.write_text(
+        "import jax\n"
+        "from pytorchvideo_accelerate_tpu.parallel.collectives import"
+        " host_broadcast\n"
+        "def resume(x):\n"
+        "    if jax.process_index() == 0:\n"
+        "        host_broadcast(x)\n")
+    assert spmdcheck_main([str(bad)]) == 1
+    out = capsys.readouterr().out
+    assert "divergent-predicate" in out
+    # 2: usage error
+    assert spmdcheck_main(["--format", "bogus"]) == 2
+
+
+def test_cli_selftest_detects_every_seed(capsys):
+    assert spmdcheck_main(["--selftest"]) == 0
